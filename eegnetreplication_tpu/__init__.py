@@ -1,0 +1,14 @@
+"""eegnetreplication_tpu: a TPU-native (JAX/XLA/Pallas) EEG decoding framework.
+
+Re-implements the full capability surface of the reference EEGNet replication
+(BCI Competition IV 2a motor imagery; within- and cross-subject protocols;
+reports; GUI; filter visualisation) as an idiomatic JAX framework: jitted
+epoch-fused training, fold-vmapped protocols, and mesh-sharded execution.
+
+Like the reference package init (``src/eegnet_repl/__init__.py:1-5``) we
+re-export the shared ``logger``.
+"""
+
+from eegnetreplication_tpu.utils.logging import logger  # noqa: F401
+
+__version__ = "0.1.0"

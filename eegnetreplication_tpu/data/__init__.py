@@ -1,0 +1,3 @@
+"""Data subpackage: containers, acquisition, preprocessing, epoching, splits."""
+
+from eegnetreplication_tpu.data.containers import BCICI2ADataset, concat_datasets  # noqa: F401

@@ -1,0 +1,6 @@
+"""TPU compute ops: standardization, filtering, resampling, Pallas kernels."""
+
+from eegnetreplication_tpu.ops.ems import (  # noqa: F401
+    exponential_moving_standardize,
+    raw_exponential_moving_standardize,
+)

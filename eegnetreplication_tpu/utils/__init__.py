@@ -1,0 +1,3 @@
+"""Utility subpackage: logging, profiling."""
+
+from eegnetreplication_tpu.utils.logging import logger  # noqa: F401

@@ -1,0 +1,47 @@
+"""Application logger.
+
+Mirrors the reference's observability contract (``src/eegnet_repl/logger.py``):
+a root logger at DEBUG with dual sinks (``app.log`` + console) and the exact
+format string, so log-scraping consumers (the GUI Logs tab) see identical
+lines.  Unlike the reference we configure lazily and idempotently so importing
+the package inside tests or other applications does not clobber an existing
+logging setup; set ``EEGTPU_NO_LOG_FILE=1`` to skip the file sink.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+LOG_FORMAT = "%(asctime)s - %(filename)s - %(funcName)s - %(levelname)s - %(message)s"
+
+_configured = False
+
+
+def configure(log_file: str = "app.log", level: int = logging.DEBUG) -> logging.Logger:
+    """Configure the root logger once; return it."""
+    global _configured
+    root = logging.getLogger()
+    if _configured:
+        return root
+    if not root.handlers:
+        handlers: list[logging.Handler] = [logging.StreamHandler()]
+        if not os.environ.get("EEGTPU_NO_LOG_FILE"):
+            try:
+                handlers.insert(0, logging.FileHandler(log_file))
+            except OSError:
+                pass
+        formatter = logging.Formatter(LOG_FORMAT)
+        for h in handlers:
+            h.setFormatter(formatter)
+            root.addHandler(h)
+        root.setLevel(level)
+    # A DEBUG root logger would otherwise stream every JAX-internal dispatch
+    # line; keep the framework's own logs at DEBUG but quiet the libraries.
+    for noisy in ("jax", "jax._src", "orbax", "absl"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
+    _configured = True
+    return root
+
+
+logger = configure()

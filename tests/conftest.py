@@ -1,0 +1,25 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths are
+exercised without TPU hardware (the JAX analog of a fake process group; the
+reference has no distributed tests at all, SURVEY.md §4).
+
+Note: the environment's site startup pins ``jax_platforms`` to ``axon,cpu``
+(tunneled TPU), overriding the ``JAX_PLATFORMS`` env var — so we force CPU via
+``jax.config`` before any backend initializes.  Set ``EEGTPU_TEST_TPU=1`` to
+run the suite on the real chip instead.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("EEGTPU_NO_LOG_FILE", "1")
+
+if not os.environ.get("EEGTPU_TEST_TPU"):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

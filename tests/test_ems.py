@@ -1,0 +1,102 @@
+"""Tests for the EMS op.
+
+Mirrors and extends the reference's EMS property tests
+(``tests/test_dataset.py:53-106``), and adds golden parity against a float64
+numpy evaluation of the recurrences the reference defines at
+``dataset.py:45-70``.
+"""
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.ops.ems import (
+    exponential_moving_standardize,
+    raw_exponential_moving_standardize,
+)
+
+
+def numpy_ems_reference(x, factor_new=1e-3, init_block_size=1000, eps=1e-10):
+    """Sequential float64 evaluation of the EMS recurrences (ground truth)."""
+    x = np.asarray(x, dtype=np.float64)
+    out = np.zeros_like(x)
+    mean = np.mean(x[..., :init_block_size], axis=-1)
+    var = np.var(x[..., :init_block_size], axis=-1)
+    a = factor_new
+    for t in range(x.shape[-1]):
+        mean = (1 - a) * mean + a * x[..., t]
+        var = (1 - a) * var + a * (x[..., t] - mean) ** 2
+        out[..., t] = (x[..., t] - mean) / np.sqrt(var + eps)
+    return out
+
+
+@pytest.fixture
+def signal():
+    rng = np.random.RandomState(0)
+    return rng.randn(4, 3000).astype(np.float32) * 5.0 + 2.0
+
+
+class TestEMSParity:
+    def test_associative_matches_float64_loop(self, signal):
+        got = np.asarray(exponential_moving_standardize(signal, init_block_size=1000))
+        want = numpy_ems_reference(signal, init_block_size=1000)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_scan_matches_float64_loop(self, signal):
+        got = np.asarray(
+            exponential_moving_standardize(signal, init_block_size=1000, method="scan")
+        )
+        want = numpy_ems_reference(signal, init_block_size=1000)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_associative_matches_scan(self, signal):
+        a = np.asarray(exponential_moving_standardize(signal))
+        b = np.asarray(exponential_moving_standardize(signal, method="scan"))
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_numpy_shim_signature(self, signal):
+        out = raw_exponential_moving_standardize(signal)
+        assert isinstance(out, np.ndarray)
+        assert out.shape == signal.shape
+
+
+class TestEMSProperties:
+    """Property tests mirroring reference tests/test_dataset.py:53-106."""
+
+    def test_shape_preserved(self, signal):
+        assert exponential_moving_standardize(signal).shape == signal.shape
+
+    def test_tail_approximately_standardized(self):
+        rng = np.random.RandomState(1)
+        x = (rng.randn(2, 20000) * 7.0 + 3.0).astype(np.float32)
+        out = np.asarray(exponential_moving_standardize(x))
+        tail = out[:, -5000:]
+        assert np.all(np.abs(tail.mean(axis=1)) < 0.15)
+        assert np.all(np.abs(tail.std(axis=1) - 1.0) < 0.2)
+
+    def test_sensitive_to_factor_new(self, signal):
+        a = np.asarray(exponential_moving_standardize(signal, factor_new=1e-3))
+        b = np.asarray(exponential_moving_standardize(signal, factor_new=1e-1))
+        assert not np.allclose(a, b)
+
+    def test_sensitive_to_init_block_size(self, signal):
+        a = np.asarray(exponential_moving_standardize(signal, init_block_size=10))
+        b = np.asarray(exponential_moving_standardize(signal, init_block_size=1000))
+        assert not np.allclose(a, b)
+
+    def test_single_channel(self):
+        x = np.random.RandomState(2).randn(1, 500).astype(np.float32)
+        out = np.asarray(exponential_moving_standardize(x, init_block_size=100))
+        want = numpy_ems_reference(x, init_block_size=100)
+        np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
+
+    def test_constant_signal_is_finite(self):
+        x = np.full((3, 400), 5.0, dtype=np.float32)
+        out = np.asarray(exponential_moving_standardize(x, init_block_size=100))
+        assert np.all(np.isfinite(out))
+        np.testing.assert_allclose(out, 0.0, atol=1e-3)
+
+    def test_init_block_larger_than_signal(self):
+        x = np.random.RandomState(3).randn(2, 50).astype(np.float32)
+        out = np.asarray(exponential_moving_standardize(x, init_block_size=1000))
+        want = numpy_ems_reference(x, init_block_size=50)
+        np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
