@@ -1,0 +1,72 @@
+"""Processed-trial storage and loading.
+
+The reference persists preprocessed continuous recordings as MNE ``.fif``
+files and re-epochs them at every training run (``dataset.py:127-130,
+239-281``).  This framework's native processed format is one ``.npz`` per
+subject/session holding the already-epoched trials — ``X: (n, C, T)``,
+``y: (n,)`` — which loads in milliseconds and needs no MNE at train time.
+When MNE is installed, ``.fif`` files produced by the reference pipeline are
+also readable for drop-in compatibility.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.config import Paths
+from eegnetreplication_tpu.data.containers import BCICI2ADataset, concat_datasets
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def trials_filename(subject: int, mode: str) -> str:
+    """Native processed-trials filename for a subject/session."""
+    session = "T" if mode == "Train" else "E"
+    return f"A{int(subject):02d}{session}-trials.npz"
+
+
+def save_trials(dataset: BCICI2ADataset, path: str | Path) -> Path:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(path, X=dataset.X.astype(np.float32),
+                        y=dataset.y.astype(np.int64))
+    return path
+
+
+def load_trials(path: str | Path) -> BCICI2ADataset:
+    with np.load(Path(path)) as data:
+        return BCICI2ADataset(X=data["X"], y=data["y"])
+
+
+def load_subject_dataset(subject: int | str = "all", mode: str = "Train",
+                         paths: Paths | None = None) -> BCICI2ADataset:
+    """Load processed trials for a subject (or all subjects) and session.
+
+    API counterpart of ``build_dataset_from_preprocessed``
+    (``dataset.py:239-281``): looks for native ``*-trials.npz`` under
+    ``data/processed/{mode}``; falls back to epoching reference-layout
+    ``*-preprocessed.fif`` files if MNE is available.
+    """
+    paths = paths or Paths.from_here()
+    root = paths.data_processed / mode
+    if subject != "all":
+        files = sorted(root.glob(trials_filename(int(subject), mode)))
+    else:
+        files = sorted(root.glob("*-trials.npz"))
+    if files:
+        logger.info("Loading %d processed trial files from %s", len(files), root)
+        return concat_datasets([load_trials(f) for f in files])
+
+    # Reference-layout fallback: epoch .fif files (requires MNE).
+    if list(root.glob("*-preprocessed.fif")):
+        from eegnetreplication_tpu.data.epoching import build_dataset_from_fif_dir
+
+        return build_dataset_from_fif_dir(root, subject=subject, mode=mode,
+                                          paths=paths)
+
+    raise FileNotFoundError(
+        f"No processed trials found in {root} for subject {subject!r}. "
+        f"Run `python -m eegnetreplication_tpu.dataset` first (or place "
+        f"*-trials.npz / *-preprocessed.fif files there)."
+    )

@@ -1,0 +1,73 @@
+"""Deterministic split logic reproducing the reference's protocols exactly.
+
+- ``kfold_indices``: sklearn ``KFold(n_splits, shuffle=True, random_state)``
+  semantics (used at ``train.py:70-73``) implemented directly so the
+  framework does not depend on sklearn at runtime; a parity test checks
+  against sklearn when it is installed.
+- ``inner_train_val_split``: the reference's 80/20 split of the train-val ids
+  (``train.py:77-79``): first fifth -> validation, rest -> train.
+- ``cross_subject_fold_subjects``: the seeded 5-train/3-val subject
+  permutation per fold (``train.py:199-202``), including the reference's
+  seeding scheme ``RandomState(42 + fold_count)`` with ``fold_count``
+  starting at 1.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def kfold_indices(n_samples: int, n_splits: int = 4, seed: int = 42,
+                  shuffle: bool = True) -> list[tuple[np.ndarray, np.ndarray]]:
+    """Yield (train_ids, test_ids) pairs with sklearn KFold semantics.
+
+    sklearn permutes ``arange(n)`` with ``RandomState(seed)`` and slices
+    consecutive chunks of size ``n//k`` (+1 for the first ``n % k`` folds) as
+    test sets.
+    """
+    if n_splits < 2:
+        raise ValueError("n_splits must be >= 2")
+    if n_splits > n_samples:
+        raise ValueError(
+            f"Cannot have n_splits={n_splits} > n_samples={n_samples}"
+        )
+    order = np.arange(n_samples)
+    if shuffle:
+        order = np.random.RandomState(seed).permutation(n_samples)
+    indices = np.arange(n_samples)
+    fold_sizes = np.full(n_splits, n_samples // n_splits, dtype=int)
+    fold_sizes[: n_samples % n_splits] += 1
+    splits = []
+    current = 0
+    for size in fold_sizes:
+        # sklearn materializes test/train through a boolean mask, so both come
+        # out sorted ascending — order matters because the reference's inner
+        # split takes the *first* fifth of the train ids (train.py:77-79).
+        test_mask = np.zeros(n_samples, dtype=bool)
+        test_mask[order[current:current + size]] = True
+        splits.append((indices[~test_mask], indices[test_mask]))
+        current += size
+    return splits
+
+
+def inner_train_val_split(train_val_ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Reference inner split (``train.py:77-79``): 20% val from the front."""
+    val_size = len(train_val_ids) // 5
+    return train_val_ids[val_size:], train_val_ids[:val_size]
+
+
+def cross_subject_fold_subjects(test_subject: int, fold_count: int,
+                                subjects: tuple[int, ...] = tuple(range(1, 10)),
+                                n_train: int = 5,
+                                seed_base: int = 42) -> tuple[np.ndarray, np.ndarray]:
+    """Seeded train/val subject draw for one cross-subject fold.
+
+    ``fold_count`` is 1-based and global over the 90 folds, matching
+    ``train.py:195-202``: ``RandomState(seed_base + fold_count)`` permutes the
+    non-test subject *labels* (not positions, so arbitrary subject subsets
+    work); the first ``n_train`` train, the rest validate.
+    """
+    other = np.array([s for s in subjects if s != test_subject])
+    rng = np.random.RandomState(seed_base + fold_count)
+    shuffled = rng.permutation(other)
+    return shuffled[:n_train], shuffled[n_train:]

@@ -1,0 +1,5 @@
+"""Model zoo: EEGNet (+wide), ShallowConvNet, DeepConvNet."""
+
+from eegnetreplication_tpu.models.convnets import DeepConvNet, ShallowConvNet  # noqa: F401
+from eegnetreplication_tpu.models.eegnet import EEGNet, eegnet_wide  # noqa: F401
+from eegnetreplication_tpu.models.registry import get_model, MODEL_REGISTRY  # noqa: F401

@@ -1,0 +1,127 @@
+"""ShallowConvNet and DeepConvNet baselines (Schirrmeister et al. 2017).
+
+BASELINE.json's config list includes "ShallowConvNet / DeepConvNet baselines
+(braindecode parity) cross-subject"; the reference repo itself only *evaluates*
+braindecode models in a notebook (``notebooks/03``), so these are fresh Flax
+implementations of the published architectures, with kernel/pool sizes scaled
+for the pipeline's 128 Hz sampling rate (braindecode's defaults assume 250 Hz).
+
+Both consume ``(B, C, T)`` trials and return ``(B, n_classes)`` logits, the
+same contract as :class:`~eegnetreplication_tpu.models.eegnet.EEGNet`.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from eegnetreplication_tpu.models.eegnet import torch_kernel_init
+
+
+def _safe_log(x: jnp.ndarray, eps: float = 1e-6) -> jnp.ndarray:
+    return jnp.log(jnp.maximum(x, eps))
+
+
+class ShallowConvNet(nn.Module):
+    """Shallow FBCSP-style ConvNet: temporal conv -> spatial conv -> square ->
+    mean-pool -> log -> dense.
+
+    Default kernel (13) and pool (35/stride 7) are the braindecode 250 Hz
+    defaults (25, 75/15) scaled to 128 Hz.
+    """
+
+    n_channels: int = 22
+    n_times: int = 257
+    n_classes: int = 4
+    n_filters_time: int = 40
+    n_filters_spat: int = 40
+    filter_time_length: int = 13
+    pool_time_length: int = 35
+    pool_time_stride: int = 7
+    dropout_rate: float = 0.5
+    momentum: float = 0.9
+    dtype: jnp.dtype = jnp.float32
+    # Named mesh axis for cross-device BatchNorm stat sync under data
+    # parallelism (None = local-batch stats, the single-device semantics).
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        use_ra = not train
+        x = x.astype(self.dtype)[..., None]  # (B, C, T, 1)
+        x = nn.Conv(self.n_filters_time, (1, self.filter_time_length),
+                    padding="VALID", use_bias=False,
+                    kernel_init=torch_kernel_init, dtype=self.dtype,
+                    name="temporal_conv")(x)
+        x = nn.Conv(self.n_filters_spat, (self.n_channels, 1), padding="VALID",
+                    use_bias=False, kernel_init=torch_kernel_init,
+                    dtype=self.dtype, name="spatial_conv")(x)
+        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
+                         axis_name=self.bn_axis_name,
+                         dtype=self.dtype, name="bn")(x)
+        x = jnp.square(x)
+        x = nn.avg_pool(x, (1, self.pool_time_length),
+                        strides=(1, self.pool_time_stride))
+        x = _safe_log(x)
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.n_classes, kernel_init=torch_kernel_init,
+                     dtype=self.dtype, name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+class DeepConvNet(nn.Module):
+    """Deep4-style ConvNet: 4 conv-maxpool blocks with widths 25/50/100/200.
+
+    Temporal kernels (1,5) and pools (1,2) are the braindecode 250 Hz defaults
+    ((1,10)/(1,3)) scaled to 128 Hz so four blocks fit in T=257 samples.
+    """
+
+    n_channels: int = 22
+    n_times: int = 257
+    n_classes: int = 4
+    filters: tuple[int, ...] = (25, 50, 100, 200)
+    kernel_length: int = 5
+    pool_length: int = 2
+    dropout_rate: float = 0.5
+    momentum: float = 0.9
+    dtype: jnp.dtype = jnp.float32
+    # Named mesh axis for cross-device BatchNorm stat sync under data
+    # parallelism (None = local-batch stats, the single-device semantics).
+    bn_axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        use_ra = not train
+        x = x.astype(self.dtype)[..., None]  # (B, C, T, 1)
+
+        # Block 1: temporal conv + spatial conv + BN + ELU + maxpool.
+        x = nn.Conv(self.filters[0], (1, self.kernel_length), padding="VALID",
+                    use_bias=False, kernel_init=torch_kernel_init,
+                    dtype=self.dtype, name="temporal_conv")(x)
+        x = nn.Conv(self.filters[0], (self.n_channels, 1), padding="VALID",
+                    use_bias=False, kernel_init=torch_kernel_init,
+                    dtype=self.dtype, name="spatial_conv")(x)
+        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
+                         axis_name=self.bn_axis_name,
+                         dtype=self.dtype, name="bn_0")(x)
+        x = nn.elu(x)
+        x = nn.max_pool(x, (1, self.pool_length), strides=(1, self.pool_length))
+
+        # Blocks 2-4: dropout + conv + BN + ELU + maxpool.
+        for i, width in enumerate(self.filters[1:], start=1):
+            x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+            x = nn.Conv(width, (1, self.kernel_length), padding="VALID",
+                        use_bias=False, kernel_init=torch_kernel_init,
+                        dtype=self.dtype, name=f"conv_{i}")(x)
+            x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
+                         axis_name=self.bn_axis_name,
+                             dtype=self.dtype, name=f"bn_{i}")(x)
+            x = nn.elu(x)
+            x = nn.max_pool(x, (1, self.pool_length),
+                            strides=(1, self.pool_length))
+
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.n_classes, kernel_init=torch_kernel_init,
+                     dtype=self.dtype, name="classifier")(x)
+        return x.astype(jnp.float32)

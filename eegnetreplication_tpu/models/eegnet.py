@@ -1,0 +1,135 @@
+"""EEGNet (Lawhern et al. 2018) in Flax, laid out for the TPU.
+
+Architectural twin of the reference's PyTorch model
+(``src/eegnet_repl/model.py:12-99``), re-designed NHWC-first so XLA tiles the
+convolutions onto the MXU:
+
+- input trials ``(B, C, T)`` become ``(B, H=C, W=T, feat=1)``;
+- Block 1: temporal ``Conv(1x32, SAME)`` -> BN -> depthwise spatial
+  ``Conv(Cx1, VALID, groups=F1)`` -> BN -> ELU -> AvgPool(1,4) -> Dropout;
+- Block 2: separable conv (depthwise ``1x16 SAME`` + pointwise ``1x1``) -> BN
+  -> ELU -> AvgPool(1,8) -> Dropout -> Flatten;
+- classifier: ``Dense(F2*(T//32) -> n_classes)``, logits out (loss applies the
+  softmax, as in the reference's CrossEntropyLoss contract, ``model.py:86-87``).
+
+Padding parity: XLA ``SAME`` for even kernels pads (k//2 - ... ) exactly like
+torch's ``padding='same'`` ((15,16) for k=32, (7,8) for k=16), so feature maps
+align sample-for-sample with the reference.
+
+Weight init reproduces torch's conv/linear default (kaiming-uniform with
+a=sqrt(5), i.e. U(+-1/sqrt(fan_in))) so training dynamics are comparable.
+
+The one deliberate layout difference: flattening happens in NHWC order
+``(1, T', F2)`` instead of torch's NCHW ``(F2, 1, T')``; checkpoint
+import/export permutes the classifier input features accordingly
+(see ``training/checkpoint.py``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.nn import initializers
+
+# torch's default Conv2d/Linear weight init: kaiming_uniform(a=sqrt(5))
+# == U(-1/sqrt(fan_in), 1/sqrt(fan_in)) == variance_scaling(1/3, fan_in, uniform).
+torch_kernel_init = initializers.variance_scaling(1.0 / 3.0, "fan_in", "uniform")
+
+
+def _torch_bias_init(fan_in: int):
+    """torch Linear bias init: U(+-1/sqrt(fan_in))."""
+    bound = 1.0 / (fan_in ** 0.5)
+
+    def init(key, shape, dtype=jnp.float32):
+        from jax import random
+
+        return random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+class EEGNet(nn.Module):
+    """EEGNet CNN for (B, C, T) EEG trials; returns (B, n_classes) logits.
+
+    Defaults mirror the reference (``model.py:13,21``): F1=8 temporal filters,
+    depth multiplier D=2, F2=F1*D pointwise filters, dropout p=0.5
+    (within-subject) or 0.25 (cross-subject).
+    """
+
+    n_channels: int = 22
+    n_times: int = 257
+    n_classes: int = 4
+    F1: int = 8
+    D: int = 2
+    dropout_rate: float = 0.5
+    momentum: float = 0.9  # = 1 - torch BatchNorm2d momentum (0.1)
+    bn_epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    # Named mesh axis for cross-device BatchNorm stat sync under data
+    # parallelism (None = local-batch stats, the single-device semantics).
+    bn_axis_name: str | None = None
+
+    @property
+    def F2(self) -> int:
+        return self.F1 * self.D
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        if x.shape[-2:] != (self.n_channels, self.n_times):
+            raise ValueError(
+                f"Expected input (..., {self.n_channels}, {self.n_times}); got {x.shape}"
+            )
+        use_ra = not train
+        x = x.astype(self.dtype)[..., None]  # (B, C, T, 1) NHWC
+
+        # --- Block 1: temporal filter bank + depthwise spatial filters ---
+        x = nn.Conv(self.F1, (1, 32), padding="SAME", use_bias=False,
+                    kernel_init=torch_kernel_init, dtype=self.dtype,
+                    name="temporal_conv")(x)
+        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
+                         axis_name=self.bn_axis_name,
+                         epsilon=self.bn_epsilon, dtype=self.dtype,
+                         name="temporal_bn")(x)
+        x = nn.Conv(self.D * self.F1, (self.n_channels, 1), padding="VALID",
+                    feature_group_count=self.F1, use_bias=False,
+                    kernel_init=torch_kernel_init, dtype=self.dtype,
+                    name="spatial_conv")(x)
+        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
+                         axis_name=self.bn_axis_name,
+                         epsilon=self.bn_epsilon, dtype=self.dtype,
+                         name="spatial_bn")(x)
+        x = nn.elu(x)
+        x = nn.avg_pool(x, (1, 4), strides=(1, 4))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        # --- Block 2: separable conv ---
+        x = nn.Conv(self.D * self.F1, (1, 16), padding="SAME",
+                    feature_group_count=self.D * self.F1, use_bias=False,
+                    kernel_init=torch_kernel_init, dtype=self.dtype,
+                    name="separable_depthwise")(x)
+        x = nn.Conv(self.F2, (1, 1), padding="SAME", use_bias=False,
+                    kernel_init=torch_kernel_init, dtype=self.dtype,
+                    name="separable_pointwise")(x)
+        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
+                         axis_name=self.bn_axis_name,
+                         epsilon=self.bn_epsilon, dtype=self.dtype,
+                         name="block2_bn")(x)
+        x = nn.elu(x)
+        x = nn.avg_pool(x, (1, 8), strides=(1, 8))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+
+        # --- Classifier ---
+        x = x.reshape((x.shape[0], -1))
+        fan_in = x.shape[-1]
+        x = nn.Dense(self.n_classes, use_bias=True,
+                     kernel_init=torch_kernel_init,
+                     bias_init=_torch_bias_init(fan_in), dtype=self.dtype,
+                     name="classifier")(x)
+        return x.astype(jnp.float32)
+
+
+def eegnet_wide(n_channels: int = 22, n_times: int = 257,
+                dropout_rate: float = 0.25, **kw) -> EEGNet:
+    """EEGNet-wide (F1=16, D=4, F2=64) — BASELINE.json config #4."""
+    return EEGNet(n_channels=n_channels, n_times=n_times, F1=16, D=4,
+                  dropout_rate=dropout_rate, **kw)

@@ -1,0 +1,33 @@
+"""Model registry: name -> constructor for CLI/config-driven model choice.
+
+The reference hard-codes a single model class; the registry covers the
+BASELINE.json config matrix (EEGNet, EEGNet-wide, ShallowConvNet, DeepConvNet)
+behind one factory.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import flax.linen as nn
+
+from eegnetreplication_tpu.models.convnets import DeepConvNet, ShallowConvNet
+from eegnetreplication_tpu.models.eegnet import EEGNet, eegnet_wide
+
+MODEL_REGISTRY: dict[str, Callable[..., nn.Module]] = {
+    "eegnet": EEGNet,
+    "eegnet_wide": eegnet_wide,
+    "shallow_convnet": ShallowConvNet,
+    "deep_convnet": DeepConvNet,
+}
+
+
+def get_model(name: str, **kwargs) -> nn.Module:
+    """Construct a model by registry name."""
+    try:
+        ctor = MODEL_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown model {name!r}; available: {sorted(MODEL_REGISTRY)}"
+        ) from None
+    return ctor(**kwargs)

@@ -1,0 +1,13 @@
+"""Parallel execution: device meshes, fold sharding, data-parallel steps."""
+
+from eegnetreplication_tpu.parallel.dp import (  # noqa: F401
+    make_dp_eval_step,
+    make_dp_train_step,
+)
+from eegnetreplication_tpu.parallel.mesh import (  # noqa: F401
+    DATA_AXIS,
+    FOLD_AXIS,
+    make_hybrid_mesh,
+    make_mesh,
+    mesh_size,
+)

@@ -1,0 +1,72 @@
+"""Device mesh construction (ICI within a slice, DCN across hosts).
+
+The reference has no distributed backend at all (SURVEY.md rows P1-P3: no
+NCCL/MPI/Gloo, single process, single device).  This module is its TPU-native
+replacement: meshes over which the framework shards (a) the embarrassingly
+parallel fold axis of the protocols and (b) the batch axis within a fold
+(pure data parallelism with gradient ``psum`` over ICI).
+
+Axis convention:
+- ``"fold"`` — independent training runs (KFold folds, CS repeats, subjects,
+  ensemble members).  No collectives cross this axis.
+- ``"data"`` — batch shards within one run.  Gradients/BN stats are reduced
+  over this axis every step, so it should map to the fastest links (ICI);
+  ``make_mesh`` orders it as the *minor* (last) mesh dimension, which
+  ``mesh_utils.create_device_mesh`` assigns to nearest-neighbour devices.
+
+For multi-host slices, ``make_hybrid_mesh`` places a leading DCN axis over
+hosts (fold-parallelism across hosts — zero cross-host traffic during
+training) and ICI axes within each host's slice, following the
+"How to Scale Your Model" recipe of keeping per-step collectives on ICI.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+FOLD_AXIS = "fold"
+DATA_AXIS = "data"
+
+
+def make_mesh(n_fold: int | None = None, n_data: int = 1,
+              devices=None) -> Mesh:
+    """Build a (fold, data) mesh over the available devices.
+
+    With defaults, all devices go to the fold axis (run-parallelism, the
+    dominant regime for this workload's 36/90 independent folds).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n_dev = len(devices)
+    if n_fold is None:
+        n_fold = n_dev // n_data
+    if n_fold * n_data != n_dev:
+        raise ValueError(
+            f"mesh shape ({n_fold} fold x {n_data} data) != {n_dev} devices"
+        )
+    arr = mesh_utils.create_device_mesh((n_fold, n_data),
+                                        devices=np.asarray(devices))
+    return Mesh(arr, (FOLD_AXIS, DATA_AXIS))
+
+
+def make_hybrid_mesh(n_data_per_host: int = 1) -> Mesh:
+    """Multi-host mesh: fold axis spans DCN (across hosts), data axis stays
+    on ICI within each host's devices."""
+    n_proc = jax.process_count()
+    local = jax.local_device_count()
+    if n_proc == 1:
+        return make_mesh(n_data=n_data_per_host)
+    n_fold_per_host = local // n_data_per_host
+    arr = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(n_fold_per_host, n_data_per_host),
+        dcn_mesh_shape=(n_proc, 1),
+    )
+    return Mesh(arr, (FOLD_AXIS, DATA_AXIS))
+
+
+def mesh_size(mesh: Mesh) -> int:
+    return math.prod(mesh.shape.values())

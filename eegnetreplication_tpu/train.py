@@ -1,0 +1,105 @@
+"""Training CLI: ``python -m eegnetreplication_tpu.train``.
+
+Flag-compatible with the reference CLI (``src/eegnet_repl/train.py:491-512``):
+``--trainingType {Within-Subject,Cross-Subject}``, ``--epochs``,
+``--generateReport`` — the plugin boundary the GUI drives via subprocess.
+
+Fixes quirk Q5: the reference declares ``--generateReport type=bool``
+(``train.py:496``), so ``--generateReport False`` was truthy and still wrote a
+report; here the same flag parses true/false strings properly.
+
+TPU-native extensions: ``--model`` (registry name), ``--seed``,
+``--meshFold/--meshData`` (device mesh shape; default all devices on the fold
+axis), ``--maxnormMode`` (quirk Q1 choice).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from eegnetreplication_tpu.config import DEFAULT_TRAINING
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def str2bool(value: str | bool) -> bool:
+    """``--generateReport False`` must actually mean false (quirk Q5)."""
+    if isinstance(value, bool):
+        return value
+    if value.lower() in ("true", "1", "yes", "y"):
+        return True
+    if value.lower() in ("false", "0", "no", "n"):
+        return False
+    raise argparse.ArgumentTypeError(f"Expected a boolean, got {value!r}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(description="Train a EEGNet model.")
+    parser.add_argument("--trainingType", type=str, default="Within-Subject",
+                        help="Training type [Cross-Subject, Within-Subject].")
+    parser.add_argument("--epochs", type=int, default=DEFAULT_TRAINING.epochs,
+                        help="Number of training epochs.")
+    parser.add_argument("--generateReport", type=str2bool, default=True,
+                        help="Generate report after training.")
+    parser.add_argument("--model", type=str, default="eegnet",
+                        help="Model registry name (eegnet, eegnet_wide, ...).")
+    parser.add_argument("--seed", type=int, default=0, help="PRNG seed.")
+    parser.add_argument("--meshFold", type=int, default=None,
+                        help="Fold-axis size of the device mesh.")
+    parser.add_argument("--meshData", type=int, default=1,
+                        help="Data-axis size of the device mesh.")
+    parser.add_argument("--maxnormMode", type=str, default="reference",
+                        choices=["reference", "paper"],
+                        help="Max-norm behaviour: reference grad-clamp (Q1) "
+                             "or true paper weight projection.")
+    return parser
+
+
+def main() -> None:
+    """CLI entrypoint."""
+    args = build_parser().parse_args()
+
+    from eegnetreplication_tpu.parallel import make_mesh
+    from eegnetreplication_tpu.training.protocols import (
+        cross_subject_training,
+        within_subject_training,
+    )
+    from eegnetreplication_tpu.training.report import (
+        generate_cs_report,
+        generate_ws_report,
+    )
+
+    config = DEFAULT_TRAINING.replace(maxnorm_mode=args.maxnormMode)
+    mesh = None
+    import jax
+
+    if len(jax.devices()) > 1 or args.meshFold is not None:
+        mesh = make_mesh(n_fold=args.meshFold, n_data=args.meshData)
+        logger.info("Using device mesh %s", dict(mesh.shape))
+
+    if args.trainingType == "Within-Subject":
+        logger.info("Training Within-Subject models for all subjects...")
+        result = within_subject_training(epochs=args.epochs, config=config,
+                                         seed=args.seed, mesh=mesh,
+                                         model_name=args.model)
+        logger.info("Epoch throughput: %.1f fold-epochs/s",
+                    result.epoch_throughput)
+        if args.generateReport:
+            generate_ws_report(result.per_subject_test_acc,
+                               result.avg_test_acc, result.best_states,
+                               epochs=args.epochs, config=config)
+    else:
+        logger.info("Training Cross-Subject model...")
+        result = cross_subject_training(epochs=args.epochs, config=config,
+                                        seed=args.seed, mesh=mesh,
+                                        model_name=args.model)
+        logger.info("Epoch throughput: %.1f fold-epochs/s",
+                    result.epoch_throughput)
+        if args.generateReport:
+            generate_cs_report(result.best_states[0],
+                               result.per_subject_test_acc,
+                               result.avg_test_acc, epochs=args.epochs,
+                               config=config)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+"""Training subpackage: steps, fused fold loops, protocols, reports, checkpoints."""
+
+from eegnetreplication_tpu.training.loop import (  # noqa: F401
+    FoldResult,
+    FoldSpec,
+    evaluate_pool,
+    init_fold_states,
+    make_fold_spec,
+    make_fold_trainer,
+    make_multi_fold_trainer,
+)
+from eegnetreplication_tpu.training.steps import (  # noqa: F401
+    TrainState,
+    eval_step,
+    make_optimizer,
+    train_step,
+)

@@ -1,0 +1,180 @@
+"""Checkpointing: native ``.npz`` format + reference-compatible ``.pth`` export.
+
+The reference persists bare torch ``state_dict``s with no metadata
+(``train.py:136-138,286-288``) and hard-codes ``EEGNet(C=22, T=256)`` at load
+time (``ui.py:26-36`` — quirk Q4: trained with T=257, loaded with T=256).
+Here:
+
+- The native format is a flat ``.npz`` of params + batch stats (+ optionally
+  optimizer state) together with a JSON metadata record carrying the model
+  hyperparameters *including T*, fixing Q4.
+- ``to_torch_state_dict`` / ``from_torch_state_dict`` convert between the
+  Flax NHWC parameter tree and the reference's NCHW ``state_dict`` naming
+  (``temporal.0.weight``, ``spatial.weight``, ``block_2.*``,
+  ``classifier.*``) so the reference's GUI/visualisation stack can load our
+  checkpoints and vice versa.  The classifier input features are permuted
+  between flatten orders (NHWC ``w*F2+f`` vs NCHW ``f*T'+w``).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+SEP = "/"
+
+
+def _flatten(tree: Any, prefix: str) -> dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+        key = prefix + SEP.join(p.key for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def _unflatten(flat: dict[str, np.ndarray], prefix: str) -> dict:
+    tree: dict = {}
+    for key, value in flat.items():
+        if not key.startswith(prefix):
+            continue
+        parts = key[len(prefix):].split(SEP)
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = value
+    return tree
+
+
+def save_checkpoint(path: str | Path, params: Any, batch_stats: Any,
+                    metadata: dict | None = None) -> Path:
+    """Save params + batch stats + JSON metadata into one ``.npz``."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    flat = _flatten(params, "params" + SEP)
+    flat.update(_flatten(batch_stats, "batch_stats" + SEP))
+    flat["__metadata__"] = np.frombuffer(
+        json.dumps(metadata or {}).encode(), dtype=np.uint8
+    )
+    np.savez(path, **flat)
+    return path
+
+
+def load_checkpoint(path: str | Path) -> tuple[dict, dict, dict]:
+    """Load a native checkpoint; returns (params, batch_stats, metadata)."""
+    with np.load(Path(path), allow_pickle=False) as data:
+        flat = {k: data[k] for k in data.files}
+    metadata = json.loads(bytes(flat.pop("__metadata__")).decode())
+    return (_unflatten(flat, "params" + SEP),
+            _unflatten(flat, "batch_stats" + SEP), metadata)
+
+
+def _classifier_nhwc_to_nchw(kernel: np.ndarray, f2: int, t_prime: int) -> np.ndarray:
+    """(T'*F2, n_cls) flax kernel -> (n_cls, F2*T') torch weight."""
+    n_cls = kernel.shape[1]
+    k = kernel.reshape(t_prime, f2, n_cls)         # [w, f, cls]
+    return np.transpose(k, (2, 1, 0)).reshape(n_cls, f2 * t_prime)
+
+
+def _classifier_nchw_to_nhwc(weight: np.ndarray, f2: int, t_prime: int) -> np.ndarray:
+    """(n_cls, F2*T') torch weight -> (T'*F2, n_cls) flax kernel."""
+    n_cls = weight.shape[0]
+    w = weight.reshape(n_cls, f2, t_prime)         # [cls, f, w]
+    return np.transpose(w, (2, 1, 0)).reshape(t_prime * f2, n_cls)
+
+
+def _conv_nhwc_to_nchw(kernel: np.ndarray) -> np.ndarray:
+    """Flax (kh, kw, in/g, out) -> torch (out, in/g, kh, kw)."""
+    return np.transpose(kernel, (3, 2, 0, 1))
+
+
+def _conv_nchw_to_nhwc(weight: np.ndarray) -> np.ndarray:
+    return np.transpose(weight, (2, 3, 1, 0))
+
+
+# Flax module name -> (torch prefix, is_bn) in the reference state_dict
+# (reference layer names from model.py:22-84).
+_LAYER_MAP = [
+    ("temporal_conv", "temporal.0", False),
+    ("temporal_bn", "temporal.1", True),
+    ("spatial_conv", "spatial", False),
+    ("spatial_bn", "aggregation.0", True),
+    ("separable_depthwise", "block_2.0", False),
+    ("separable_pointwise", "block_2.1", False),
+    ("block2_bn", "block_2.2", True),
+]
+
+
+def to_torch_state_dict(params: Any, batch_stats: Any, f2: int,
+                        t_prime: int) -> dict[str, np.ndarray]:
+    """Export flax EEGNet variables as a reference-named state_dict (numpy)."""
+    params = jax.tree_util.tree_map(np.asarray, params)
+    batch_stats = jax.tree_util.tree_map(np.asarray, batch_stats)
+    sd: dict[str, np.ndarray] = {}
+    for flax_name, torch_prefix, is_bn in _LAYER_MAP:
+        if is_bn:
+            sd[f"{torch_prefix}.weight"] = params[flax_name]["scale"]
+            sd[f"{torch_prefix}.bias"] = params[flax_name]["bias"]
+            sd[f"{torch_prefix}.running_mean"] = batch_stats[flax_name]["mean"]
+            sd[f"{torch_prefix}.running_var"] = batch_stats[flax_name]["var"]
+            sd[f"{torch_prefix}.num_batches_tracked"] = np.asarray(0, np.int64)
+        else:
+            sd[f"{torch_prefix}.weight"] = _conv_nhwc_to_nchw(
+                params[flax_name]["kernel"])
+    sd["classifier.weight"] = _classifier_nhwc_to_nchw(
+        params["classifier"]["kernel"], f2, t_prime)
+    sd["classifier.bias"] = params["classifier"]["bias"]
+    return sd
+
+
+def from_torch_state_dict(sd: dict, f2: int, t_prime: int) -> tuple[dict, dict]:
+    """Import a reference-named state_dict into (params, batch_stats)."""
+    def arr(v):
+        return np.asarray(getattr(v, "numpy", lambda: v)())
+
+    params: dict = {}
+    batch_stats: dict = {}
+    for flax_name, torch_prefix, is_bn in _LAYER_MAP:
+        if is_bn:
+            params[flax_name] = {
+                "scale": arr(sd[f"{torch_prefix}.weight"]),
+                "bias": arr(sd[f"{torch_prefix}.bias"]),
+            }
+            batch_stats[flax_name] = {
+                "mean": arr(sd[f"{torch_prefix}.running_mean"]),
+                "var": arr(sd[f"{torch_prefix}.running_var"]),
+            }
+        else:
+            params[flax_name] = {
+                "kernel": _conv_nchw_to_nhwc(arr(sd[f"{torch_prefix}.weight"]))
+            }
+    params["classifier"] = {
+        "kernel": _classifier_nchw_to_nhwc(arr(sd["classifier.weight"]), f2,
+                                           t_prime),
+        "bias": arr(sd["classifier.bias"]),
+    }
+    return params, batch_stats
+
+
+def save_pth(path: str | Path, params: Any, batch_stats: Any, f2: int,
+             t_prime: int) -> Path:
+    """Save a reference-loadable ``.pth`` (requires torch)."""
+    import torch
+
+    sd = to_torch_state_dict(params, batch_stats, f2, t_prime)
+    tensors = {k: torch.tensor(v) for k, v in sd.items()}
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    torch.save(tensors, path)
+    return path
+
+
+def load_pth(path: str | Path, f2: int, t_prime: int) -> tuple[dict, dict]:
+    """Load a reference ``.pth`` into (params, batch_stats) (requires torch)."""
+    import torch
+
+    sd = torch.load(Path(path), map_location="cpu")
+    return from_torch_state_dict(sd, f2, t_prime)

@@ -1,0 +1,290 @@
+"""Epoch-fused fold training: the whole training run is ONE compiled program.
+
+The reference trains with a Python epoch loop of ~4-22 tiny batches, paying a
+host->device copy per batch and a device->host sync per step
+(``model.py:130-168``, per-step ``loss.item()`` at ``model.py:143``) — pure
+dispatch overhead for a 1.7K-parameter model.  Here the entire run (epochs x
+steps, validation included, best-model tracking included) is a single
+``lax.scan`` under ``jit``:
+
+- The dataset lives on device once, as a shared pool ``(N_pool, C, T)``.
+- A fold is an *index set* into the pool (:class:`FoldSpec`), so the 36
+  within-subject and 90 cross-subject folds all reference one pool with no
+  data duplication, and folds ``vmap``/shard over a mesh axis (SURVEY.md §7
+  build-plan step 6).
+- Per-epoch shuffling happens on device (sort of random keys), padded batch
+  slots wrap around to real samples so BatchNorm only ever sees real trials;
+  wrapped duplicates carry loss-weight 0 so each sample counts exactly once
+  per epoch, like the reference's ``DataLoader(shuffle=True)``.
+- Best-model selection is a functional deep copy inside the scan carry
+  (fixes quirk Q2), by max validation accuracy with strict ``>`` like
+  ``model.py:180`` (ties keep the earlier epoch).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eegnetreplication_tpu.training import steps as steps_lib
+from eegnetreplication_tpu.training.steps import TrainState
+
+
+@flax.struct.dataclass
+class FoldSpec:
+    """Index-based description of one train/val/test fold over a data pool.
+
+    Index arrays are padded to a static length with any value (conventionally
+    0); ``*_n`` gives the real count.  All leaves are stackable across folds
+    for ``vmap``.
+    """
+
+    train_idx: jnp.ndarray  # (Ntr_pad,) int32
+    train_n: jnp.ndarray    # () int32
+    val_idx: jnp.ndarray    # (Nva_pad,) int32
+    val_n: jnp.ndarray      # () int32
+    test_idx: jnp.ndarray   # (Nte_pad,) int32
+    test_n: jnp.ndarray     # () int32
+
+
+@flax.struct.dataclass
+class FoldResult:
+    """Outcome of one fold's full training run (cf. ``model.py:189``)."""
+
+    best_state: TrainState        # best-by-val-accuracy snapshot
+    best_val_acc: jnp.ndarray     # () f32, percentage
+    min_val_loss: jnp.ndarray     # () f32 (CS selection, train.py:269)
+    train_losses: jnp.ndarray     # (epochs,)
+    val_losses: jnp.ndarray       # (epochs,)
+    val_accuracies: jnp.ndarray   # (epochs,) percentage
+    test_accuracy: jnp.ndarray    # () f32, percentage (best model on test set)
+
+
+def pad_indices(idx: np.ndarray, pad_to: int) -> np.ndarray:
+    """Pad an index vector to a static length (content of padding unused)."""
+    out = np.zeros(pad_to, dtype=np.int32)
+    out[: len(idx)] = idx
+    return out
+
+
+def make_fold_spec(train_idx, val_idx, test_idx, *, train_pad, val_pad,
+                   test_pad) -> FoldSpec:
+    """Host-side constructor from ragged numpy index vectors."""
+    return FoldSpec(
+        train_idx=jnp.asarray(pad_indices(np.asarray(train_idx), train_pad)),
+        train_n=jnp.asarray(len(train_idx), jnp.int32),
+        val_idx=jnp.asarray(pad_indices(np.asarray(val_idx), val_pad)),
+        val_n=jnp.asarray(len(val_idx), jnp.int32),
+        test_idx=jnp.asarray(pad_indices(np.asarray(test_idx), test_pad)),
+        test_n=jnp.asarray(len(test_idx), jnp.int32),
+    )
+
+
+def _shuffled_slots(key, idx, n, n_slots):
+    """Device-side epoch shuffle with wraparound padding.
+
+    Returns ``(slot_indices, weights)`` of length ``n_slots``: the first ``n``
+    slots enumerate the real entries of ``idx`` in random order; remaining
+    slots wrap around to real samples (weight 0) so every batch is made of
+    real trials.
+    """
+    n_pad = idx.shape[0]
+    r = jax.random.uniform(key, (n_pad,))
+    r = jnp.where(jnp.arange(n_pad) < n, r, 2.0)  # padding sorts last
+    order = jnp.argsort(r)
+    slots = jnp.arange(n_slots)
+    pos = jnp.where(n > 0, slots % jnp.maximum(n, 1), 0)
+    weights = (slots < n).astype(jnp.float32)
+    return idx[order[pos]], weights
+
+
+def _linear_slots(idx, n, n_slots):
+    """Deterministic (validation/test) slot layout with wraparound padding."""
+    slots = jnp.arange(n_slots)
+    pos = jnp.where(n > 0, slots % jnp.maximum(n, 1), 0)
+    weights = (slots < n).astype(jnp.float32)
+    return idx[pos], weights
+
+
+def evaluate_pool(model, state: TrainState, pool_x, pool_y, idx, n,
+                  batch_size: int) -> jnp.ndarray:
+    """Accuracy (percentage) of ``state`` on pool[idx[:n]].
+
+    TPU-native counterpart of ``evaluate_model`` (``model.py:191-226``).
+    """
+    n_pad = idx.shape[0]
+    n_steps = max(1, math.ceil(n_pad / batch_size))
+    gather_idx, weights = _linear_slots(idx, n, n_steps * batch_size)
+
+    def body(carry, sl):
+        batch_idx, w = sl
+        _, correct = steps_lib.eval_step(
+            model, state, pool_x[batch_idx], pool_y[batch_idx], w
+        )
+        return carry + correct, None
+
+    total_correct, _ = jax.lax.scan(
+        body, jnp.float32(0.0),
+        (gather_idx.reshape(n_steps, batch_size),
+         weights.reshape(n_steps, batch_size)),
+    )
+    return 100.0 * total_correct / jnp.maximum(n, 1)
+
+
+def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
+                      train_pad: int, val_pad: int, test_pad: int,
+                      maxnorm_mode: str = "reference"):
+    """Build ``fold_trainer(pool_x, pool_y, spec, init_state, key) -> FoldResult``.
+
+    All sizes are static so one compilation serves every fold of a protocol;
+    ``vmap`` the returned function over (spec, init_state, key) to train many
+    folds in one XLA program.
+    """
+    train_steps = math.ceil(train_pad / batch_size)
+    val_steps = max(1, math.ceil(val_pad / batch_size))
+
+    def run_epoch(pool_x, pool_y, spec: FoldSpec, state: TrainState, key):
+        shuffle_key, dropout_key = jax.random.split(key)
+        gather_idx, weights = _shuffled_slots(
+            shuffle_key, spec.train_idx, spec.train_n, train_steps * batch_size
+        )
+        step_rngs = jax.random.split(dropout_key, train_steps)
+
+        def train_body(state, inp):
+            batch_idx, w, rng = inp
+            state, loss = steps_lib.train_step(
+                model, tx, state, pool_x[batch_idx], pool_y[batch_idx], w,
+                rng, maxnorm_mode=maxnorm_mode,
+            )
+            return state, loss
+
+        state, step_losses = jax.lax.scan(
+            train_body, state,
+            (gather_idx.reshape(train_steps, batch_size),
+             weights.reshape(train_steps, batch_size), step_rngs),
+        )
+        # epoch_train_loss = running_loss / len(train_loader)  (model.py:171)
+        n_real_train_batches = jnp.maximum(
+            jnp.ceil(spec.train_n / batch_size), 1
+        ).astype(jnp.float32)
+        train_loss = jnp.sum(step_losses) / n_real_train_batches
+
+        # Validation pass (eval mode; running BN stats, like model.py:151-168).
+        val_gather, val_w = _linear_slots(
+            spec.val_idx, spec.val_n, val_steps * batch_size
+        )
+
+        def val_body(carry, sl):
+            batch_idx, w = sl
+            loss, correct = steps_lib.eval_step(
+                model, state, pool_x[batch_idx], pool_y[batch_idx], w
+            )
+            has_real = jnp.sum(w) > 0
+            loss_sum, correct_sum = carry
+            return (loss_sum + jnp.where(has_real, loss, 0.0),
+                    correct_sum + correct), None
+
+        (val_loss_sum, correct), _ = jax.lax.scan(
+            val_body, (jnp.float32(0.0), jnp.float32(0.0)),
+            (val_gather.reshape(val_steps, batch_size),
+             val_w.reshape(val_steps, batch_size)),
+        )
+        n_real_val_batches = jnp.maximum(
+            jnp.ceil(spec.val_n / batch_size), 1
+        ).astype(jnp.float32)
+        val_loss = val_loss_sum / n_real_val_batches
+        val_acc = 100.0 * correct / jnp.maximum(spec.val_n, 1)
+        return state, train_loss, val_loss, val_acc
+
+    def fold_trainer(pool_x, pool_y, spec: FoldSpec, init_state: TrainState,
+                     key) -> FoldResult:
+        def epoch_body(carry, epoch_key):
+            state, best_state, best_acc, min_loss = carry
+            state, train_loss, val_loss, val_acc = run_epoch(
+                pool_x, pool_y, spec, state, epoch_key
+            )
+            improved = val_acc > best_acc  # strict >, model.py:180
+            best_state = jax.tree_util.tree_map(
+                lambda n, o: jnp.where(improved, n, o), state, best_state
+            )
+            best_acc = jnp.maximum(best_acc, val_acc)
+            min_loss = jnp.minimum(min_loss, val_loss)
+            return ((state, best_state, best_acc, min_loss),
+                    (train_loss, val_loss, val_acc))
+
+        epoch_keys = jax.random.split(key, epochs)
+        init_carry = (init_state, init_state, jnp.float32(0.0),
+                      jnp.float32(jnp.inf))
+        (state, best_state, best_acc, min_loss), per_epoch = jax.lax.scan(
+            epoch_body, init_carry, epoch_keys
+        )
+        train_losses, val_losses, val_accs = per_epoch
+        test_acc = evaluate_pool(
+            model, best_state, pool_x, pool_y, spec.test_idx, spec.test_n,
+            batch_size,
+        )
+        return FoldResult(
+            best_state=best_state,
+            best_val_acc=best_acc,
+            min_val_loss=min_loss,
+            train_losses=train_losses,
+            val_losses=val_losses,
+            val_accuracies=val_accs,
+            test_accuracy=test_acc,
+        )
+
+    return fold_trainer
+
+
+def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
+                            train_pad: int, val_pad: int, test_pad: int,
+                            maxnorm_mode: str = "reference",
+                            mesh=None, fold_axis: str = "fold"):
+    """Vmap the fold trainer over a leading fold axis and jit it.
+
+    ``specs``/``init_states``/``keys`` carry a leading fold dimension; the
+    data pool is shared (broadcast).  With ``mesh`` given, folds are sharded
+    across devices over ``fold_axis`` with explicit SPMD (``shard_map``): each
+    device trains its fold shard locally with a replicated pool and zero
+    cross-device traffic — run-level parallelism, the TPU answer to the
+    reference's sequential 36/90-fold loops (SURVEY rows P1-P3).  The fold
+    count must be a multiple of the mesh's fold-axis size (callers pad).
+    """
+    fold_trainer = make_fold_trainer(
+        model, tx, batch_size=batch_size, epochs=epochs, train_pad=train_pad,
+        val_pad=val_pad, test_pad=test_pad, maxnorm_mode=maxnorm_mode,
+    )
+    vmapped = jax.vmap(fold_trainer, in_axes=(None, None, 0, 0, 0))
+
+    if mesh is None:
+        return jax.jit(vmapped)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    mapped = shard_map(
+        vmapped, mesh=mesh,
+        in_specs=(P(), P(), P(fold_axis), P(fold_axis), P(fold_axis)),
+        out_specs=P(fold_axis),
+        check_rep=False,
+    )
+    return jax.jit(mapped)
+
+
+def init_fold_states(model, tx, n_folds: int, sample_shape, seed: int = 0):
+    """Initialize ``n_folds`` independent model/optimizer states (stacked).
+
+    Fresh per-fold init mirrors the reference's fresh ``EEGNet()`` per fold
+    (``train.py:92``, ``train.py:234``) — each fold gets its own params drawn
+    from its own key, stacked along a leading fold axis for ``vmap``.
+    """
+    def init_one(key):
+        variables = model.init(key, jnp.zeros((1, *sample_shape)), train=False)
+        return TrainState.create(variables, tx)
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_folds)
+    return jax.vmap(init_one)(keys)

@@ -1,0 +1,298 @@
+"""Evaluation protocols: within-subject and cross-subject training.
+
+Protocol twins of ``within_subject_training`` / ``cross_subject_training``
+(``src/eegnet_repl/train.py:30-291``), re-architected for the TPU: the
+reference runs its 36 (9 subjects x 4 folds) and 90 (9 x 10 repeats) training
+runs *sequentially* on one device; here every fold is an index set over one
+shared device-resident pool and all folds train simultaneously under one
+``vmap``-ed, jitted program (optionally sharded over a device mesh's fold
+axis — SURVEY.md inventory rows P1-P3).
+
+Protocol-defining details reproduced exactly:
+
+- Within-subject: Train+Eval sessions concatenated per subject
+  (``train.py:58-59``); ``KFold(4, shuffle=True, random_state=42)``
+  (``train.py:70-71``); inner 80/20 val/train split of the train-val ids
+  (``train.py:77-79``); dropout 0.5; per-subject best fold by max validation
+  accuracy with strict ``>`` in fold order (``train.py:126-128``).
+- Cross-subject: per fold, ``RandomState(42+fold_count)`` permutes the 8
+  non-test subjects into 5 train / 3 val (``train.py:199-202``); training
+  data is the *Train session only* of those subjects, test is the held-out
+  subject's *Eval session* (``train.py:188,258``); dropout 0.25; global best
+  model by min validation loss in fold order (``train.py:269-271``).
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths, TrainingConfig
+from eegnetreplication_tpu.data.containers import BCICI2ADataset
+from eegnetreplication_tpu.data.splits import (
+    cross_subject_fold_subjects,
+    inner_train_val_split,
+    kfold_indices,
+)
+from eegnetreplication_tpu.models import EEGNet, get_model
+from eegnetreplication_tpu.training import checkpoint as ckpt_lib
+from eegnetreplication_tpu.training.loop import (
+    FoldSpec,
+    init_fold_states,
+    make_fold_spec,
+    make_multi_fold_trainer,
+)
+from eegnetreplication_tpu.training.steps import make_optimizer
+from eegnetreplication_tpu.utils.logging import logger
+
+LoadFn = Callable[[int, str], BCICI2ADataset]
+
+
+def _default_loader(subject: int, mode: str) -> BCICI2ADataset:
+    from eegnetreplication_tpu.data.io import load_subject_dataset
+
+    return load_subject_dataset(subject=subject, mode=mode)
+
+
+@dataclass
+class ProtocolResult:
+    per_subject_test_acc: list[float]
+    avg_test_acc: float
+    best_states: list[Any]          # per-subject (WS) or single-element (CS)
+    fold_test_acc: np.ndarray       # all folds' test accuracies
+    wall_seconds: float
+    epochs: int
+
+    @property
+    def epoch_throughput(self) -> float:
+        """Total fold-epochs trained per second (the BASELINE.json metric)."""
+        return len(self.fold_test_acc) * self.epochs / max(self.wall_seconds, 1e-9)
+
+
+def _build_pool(datasets: list[BCICI2ADataset]) -> tuple[np.ndarray, np.ndarray, list[np.ndarray]]:
+    """Concatenate datasets into one pool; return per-dataset global indices."""
+    offsets, cursor = [], 0
+    for d in datasets:
+        offsets.append(np.arange(cursor, cursor + len(d)))
+        cursor += len(d)
+    pool_x = np.concatenate([d.X for d in datasets]).astype(np.float32)
+    pool_y = np.concatenate([d.y for d in datasets]).astype(np.int32)
+    return pool_x, pool_y, offsets
+
+
+def _stack_specs(specs: list[FoldSpec]) -> FoldSpec:
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *specs)
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return multiple * math.ceil(max(n, 1) / multiple)
+
+
+def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
+               config: TrainingConfig, epochs: int, seed: int, mesh=None):
+    """Train all folds in one compiled program; returns stacked FoldResult."""
+    tx = make_optimizer(config.learning_rate, config.adam_eps)
+    n_folds = len(specs)
+    train_pad = specs[0].train_idx.shape[0]
+    val_pad = specs[0].val_idx.shape[0]
+    test_pad = specs[0].test_idx.shape[0]
+
+    stacked = _stack_specs(specs)
+    states = init_fold_states(model, tx, n_folds,
+                              (pool_x.shape[1], pool_x.shape[2]), seed=seed)
+    keys = jax.random.split(jax.random.PRNGKey(seed + 1), n_folds)
+
+    trainer = make_multi_fold_trainer(
+        model, tx, batch_size=config.batch_size, epochs=epochs,
+        train_pad=train_pad, val_pad=val_pad, test_pad=test_pad,
+        maxnorm_mode=config.maxnorm_mode, mesh=mesh,
+    )
+
+    if mesh is not None:
+        # Pad the fold axis to a multiple of the mesh's fold-axis size so the
+        # shard is even; surplus folds repeat fold 0 and are dropped after.
+        from eegnetreplication_tpu.parallel.mesh import FOLD_AXIS
+
+        n_dev = mesh.shape[FOLD_AXIS]
+        padded = _round_up(n_folds, n_dev)
+        if padded != n_folds:
+            def pad_leaf(leaf):
+                reps = jnp.concatenate(
+                    [leaf, jnp.repeat(leaf[:1], padded - n_folds, axis=0)])
+                return reps
+            stacked = jax.tree_util.tree_map(pad_leaf, stacked)
+            states = jax.tree_util.tree_map(pad_leaf, states)
+            keys = pad_leaf(keys)
+
+    t0 = time.perf_counter()
+    results = trainer(jnp.asarray(pool_x), jnp.asarray(pool_y), stacked,
+                      states, keys)
+    results = jax.block_until_ready(results)
+    wall = time.perf_counter() - t0
+    if mesh is not None and padded != n_folds:
+        results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds], results)
+    return results, wall
+
+
+def _fold_state(results, fold: int):
+    """Extract one fold's best TrainState (host copy) from stacked results."""
+    return jax.tree_util.tree_map(lambda leaf: np.asarray(leaf[fold]),
+                                  results.best_state)
+
+
+def _save_model(state, model, model_name: str, path) -> None:
+    if isinstance(model, EEGNet):
+        try:
+            ckpt_lib.save_pth(path, state.params, state.batch_stats,
+                              f2=model.F2, t_prime=model.n_times // 32)
+        except ImportError:  # torch unavailable: native format only
+            logger.warning("torch unavailable; skipping .pth export for %s",
+                           path)
+    metadata = {"model": model_name, "n_channels": model.n_channels,
+                "n_times": model.n_times}
+    if isinstance(model, EEGNet):
+        metadata.update(F1=model.F1, D=model.D)
+    ckpt_lib.save_checkpoint(str(path).replace(".pth", ".npz"), state.params,
+                             state.batch_stats, metadata=metadata)
+
+
+def within_subject_training(epochs: int | None = None, *,
+                            config: TrainingConfig = DEFAULT_TRAINING,
+                            loader: LoadFn = _default_loader,
+                            subjects: tuple[int, ...] = tuple(range(1, 10)),
+                            seed: int = 0, mesh=None,
+                            paths: Paths | None = None,
+                            model_name: str = "eegnet",
+                            save_models: bool = True) -> ProtocolResult:
+    """Within-subject protocol: per subject, 4-fold CV over both sessions."""
+    epochs = epochs if epochs is not None else config.epochs
+    paths = paths or Paths.from_here()
+
+    datasets = []
+    for s in subjects:
+        logger.info("Loading Subject %d", s)
+        datasets.append(loader(s, "Train").concat(loader(s, "Eval")))
+    pool_x, pool_y, offsets = _build_pool(datasets)
+    n_ch, n_t = pool_x.shape[1], pool_x.shape[2]
+    model = get_model(model_name, n_channels=n_ch, n_times=n_t,
+                      dropout_rate=config.dropout_within_subject)
+
+    # Build the 4 folds per subject (reference fold order preserved).
+    raw_folds: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+    for subj_idx, s in enumerate(subjects):
+        n = len(offsets[subj_idx])
+        for train_val_ids, test_ids in kfold_indices(
+                n, config.kfold_splits, config.kfold_seed):
+            train_ids, val_ids = inner_train_val_split(train_val_ids)
+            g = offsets[subj_idx]
+            raw_folds.append((g[train_ids], g[val_ids], g[test_ids]))
+
+    train_pad = max(len(f[0]) for f in raw_folds)
+    val_pad = max(len(f[1]) for f in raw_folds)
+    test_pad = max(len(f[2]) for f in raw_folds)
+    specs = [make_fold_spec(tr, va, te, train_pad=train_pad, val_pad=val_pad,
+                            test_pad=test_pad) for tr, va, te in raw_folds]
+
+    logger.info("Training %d folds (%d subjects x %d) for %d epochs, "
+                "fused+vmapped", len(specs), len(subjects),
+                config.kfold_splits, epochs)
+    results, wall = _run_folds(model, specs, pool_x, pool_y, config=config,
+                               epochs=epochs, seed=seed, mesh=mesh)
+
+    fold_test = np.asarray(results.test_accuracy)  # (n_subjects*4,)
+    fold_best_val = np.asarray(results.best_val_acc)
+    k = config.kfold_splits
+    per_subject_test_acc, best_states = [], []
+    for i, s in enumerate(subjects):
+        accs = fold_test[i * k:(i + 1) * k]
+        per_subject_test_acc.append(float(np.mean(accs)))
+        best_fold = i * k + int(np.argmax(fold_best_val[i * k:(i + 1) * k]))
+        best_states.append(_fold_state(results, best_fold))
+        logger.info("Subject %d - Average Test Accuracy: %.2f%%", s,
+                    per_subject_test_acc[-1])
+        if save_models:
+            paths.models.mkdir(parents=True, exist_ok=True)
+            _save_model(best_states[-1], model, model_name,
+                        paths.models / f"subject_{s:02d}_best_model.pth")
+
+    avg = float(np.mean(per_subject_test_acc))
+    logger.info("Overall Average Test Accuracy across all subjects: %.2f%%", avg)
+    return ProtocolResult(per_subject_test_acc, avg, best_states, fold_test,
+                          wall, epochs)
+
+
+def cross_subject_training(epochs: int | None = None, *,
+                           config: TrainingConfig = DEFAULT_TRAINING,
+                           loader: LoadFn = _default_loader,
+                           subjects: tuple[int, ...] = tuple(range(1, 10)),
+                           seed: int = 0, mesh=None,
+                           paths: Paths | None = None,
+                           model_name: str = "eegnet",
+                           save_models: bool = True) -> ProtocolResult:
+    """Cross-subject protocol: 5-train/3-val/1-test subjects, 10 repeats."""
+    epochs = epochs if epochs is not None else config.epochs
+    paths = paths or Paths.from_here()
+    n_subjects = len(subjects)
+
+    logger.info("Loading data for all subjects...")
+    train_sets = [loader(s, "Train") for s in subjects]
+    eval_sets = [loader(s, "Eval") for s in subjects]
+    pool_x, pool_y, offsets = _build_pool(train_sets + eval_sets)
+    train_off = {s: offsets[i] for i, s in enumerate(subjects)}
+    eval_off = {s: offsets[n_subjects + i] for i, s in enumerate(subjects)}
+    n_ch, n_t = pool_x.shape[1], pool_x.shape[2]
+    model = get_model(model_name, n_channels=n_ch, n_times=n_t,
+                      dropout_rate=config.dropout_cross_subject)
+
+    raw_folds = []
+    fold_count = 0
+    for s in subjects:
+        for _ in range(config.cs_repeats_per_subject):
+            fold_count += 1
+            tr_subj, va_subj = cross_subject_fold_subjects(
+                s, fold_count, subjects=subjects,
+                n_train=config.cs_train_subjects)
+            tr = np.concatenate([train_off[t] for t in tr_subj])
+            va = np.concatenate([train_off[v] for v in va_subj])
+            raw_folds.append((tr, va, eval_off[s]))
+
+    train_pad = max(len(f[0]) for f in raw_folds)
+    val_pad = max(len(f[1]) for f in raw_folds)
+    test_pad = max(len(f[2]) for f in raw_folds)
+    specs = [make_fold_spec(tr, va, te, train_pad=train_pad, val_pad=val_pad,
+                            test_pad=test_pad) for tr, va, te in raw_folds]
+
+    logger.info("Training %d cross-subject folds for %d epochs, fused+vmapped",
+                len(specs), epochs)
+    results, wall = _run_folds(model, specs, pool_x, pool_y, config=config,
+                               epochs=epochs, seed=seed, mesh=mesh)
+
+    fold_test = np.asarray(results.test_accuracy)
+    min_val_loss = np.asarray(results.min_val_loss)
+    r = config.cs_repeats_per_subject
+    per_subject_test_acc = [
+        float(np.mean(fold_test[i * r:(i + 1) * r]))
+        for i in range(n_subjects)
+    ]
+    for s, acc in zip(subjects, per_subject_test_acc):
+        logger.info("Subject %d - Average Test Accuracy: %.2f%%", s, acc)
+    avg_all = float(np.mean(fold_test))
+    std_err = float(np.std(fold_test) / np.sqrt(len(fold_test)))
+    logger.info("Overall Average Test Accuracy: %.2f%% +- %.2f%%", avg_all,
+                std_err)
+
+    best_fold = int(np.argmin(min_val_loss))
+    best_state = _fold_state(results, best_fold)
+    if save_models:
+        paths.models.mkdir(parents=True, exist_ok=True)
+        _save_model(best_state, model, model_name,
+                    paths.models / "cross_subject_best_model.pth")
+
+    return ProtocolResult(per_subject_test_acc, avg_all, [best_state],
+                          fold_test, wall, epochs)

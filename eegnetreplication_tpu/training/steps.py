@@ -1,0 +1,167 @@
+"""Optimizer, loss, and single-step train/eval building blocks.
+
+Replaces the reference's per-batch torch loop kernels
+(``src/eegnet_repl/model.py:101-226``) with pure jitted functions over an
+explicit :class:`TrainState`.  Differences by design:
+
+- The optimizer is ``optax.adam(lr, eps=1e-7)`` matching the reference's
+  ``optim.Adam(..., eps=1e-07)`` (``train.py:94-101``); torch's
+  ``m_hat / (sqrt(v_hat) + eps)`` form corresponds to optax's default
+  ``eps_root=0``.
+- "Max-norm" regularization is explicit and selectable (quirk Q1): the
+  reference's hooks clamp *gradients* elementwise (``model.py:43-44,83-84``);
+  ``maxnorm_mode="reference"`` reproduces that, ``"paper"`` applies the true
+  per-filter L2 max-norm projection from Lawhern et al. after each update.
+- Best-model snapshots are deep copies by construction (functional params fix
+  quirk Q2's aliased ``state_dict().copy()``, ``model.py:182``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax
+import jax
+import jax.numpy as jnp
+import optax
+
+# Parameter-tree paths subject to max-norm treatment, with their limits
+# (reference: clamp values 1.0 and 0.25 at model.py:43-44,83-84).
+MAXNORM_LIMITS = {"spatial_conv": 1.0, "classifier": 0.25}
+
+
+@flax.struct.dataclass
+class TrainState:
+    """Functional training state: params + BN stats + optimizer state."""
+
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, variables: dict, tx: optax.GradientTransformation) -> "TrainState":
+        return cls(
+            params=variables["params"],
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(variables["params"]),
+        )
+
+
+def make_optimizer(learning_rate: float = 1e-3, eps: float = 1e-7) -> optax.GradientTransformation:
+    """Adam exactly as the reference configures it (``train.py:94-101``)."""
+    return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=eps)
+
+
+def clamp_reference_maxnorm(grads: Any) -> Any:
+    """Quirk-Q1 'reference' mode: clamp selected layers' *gradients*.
+
+    The reference's ``register_hook`` on the Parameter fires on the gradient,
+    so its "max-norm constraint" is an elementwise gradient clamp to +-1.0
+    (spatial conv) and +-0.25 (classifier kernel); biases/BN are untouched.
+    """
+    def maybe_clamp(path, g):
+        top = path[0].key if path else None
+        limit = MAXNORM_LIMITS.get(top)
+        # torch hooks are registered on the weights only (not classifier bias:
+        # the hook at model.py:84 targets classifier.weight).
+        leaf = path[-1].key if path else None
+        if limit is not None and leaf in ("kernel",):
+            return jnp.clip(g, -limit, limit)
+        return g
+
+    return jax.tree_util.tree_map_with_path(maybe_clamp, grads)
+
+
+def project_paper_maxnorm(params: Any) -> Any:
+    """True max-norm weight projection (Lawhern et al. 2018, and the Keras
+    reference implementation): renormalize each spatial filter's L2 norm to
+    <= 1.0 and each classifier unit's incoming-weight norm to <= 0.25.
+    """
+    def maybe_project(path, w):
+        top = path[0].key if path else None
+        leaf = path[-1].key if path else None
+        limit = MAXNORM_LIMITS.get(top)
+        if limit is None or leaf != "kernel":
+            return w
+        if top == "spatial_conv":
+            # (C, 1, in/g, out): norm over the receptive field per out filter.
+            norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=(0, 1, 2), keepdims=True))
+        else:  # classifier (fan_in, n_classes): per output unit.
+            norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=0, keepdims=True))
+        scale = jnp.minimum(1.0, limit / jnp.maximum(norms, 1e-12))
+        return w * scale
+
+    return jax.tree_util.tree_map_with_path(maybe_project, params)
+
+
+def weighted_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                           weights: jnp.ndarray) -> jnp.ndarray:
+    """Mean softmax cross-entropy over samples with weight > 0.
+
+    Equals torch ``CrossEntropyLoss()`` (mean reduction) on the real samples
+    of a padded batch.
+    """
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+    denom = jnp.maximum(jnp.sum(weights), 1.0)
+    return jnp.sum(ce * weights) / denom
+
+
+def apply_model(model, params, batch_stats, x, *, train: bool,
+                dropout_rng=None):
+    """Forward pass; returns (logits, new_batch_stats)."""
+    variables = {"params": params, "batch_stats": batch_stats}
+    if train:
+        logits, updates = model.apply(
+            variables, x, train=True, mutable=["batch_stats"],
+            rngs={"dropout": dropout_rng},
+        )
+        return logits, updates["batch_stats"]
+    logits = model.apply(variables, x, train=False)
+    return logits, batch_stats
+
+
+def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
+               maxnorm_mode: str = "reference"):
+    """One optimization step on a (possibly padding-weighted) batch.
+
+    Returns ``(new_state, batch_loss)``.  If the batch contains no real
+    samples (all weights zero), the state is returned unchanged — the
+    reference never runs empty batches, so neither do we (and Adam moments
+    must not decay on phantom steps).
+    """
+    def loss_fn(params):
+        logits, new_bs = apply_model(model, params, state.batch_stats, x,
+                                     train=True, dropout_rng=dropout_rng)
+        return weighted_cross_entropy(logits, y, w), new_bs
+
+    (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+
+    if maxnorm_mode == "reference":
+        grads = clamp_reference_maxnorm(grads)
+    updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+    new_params = optax.apply_updates(state.params, updates)
+    if maxnorm_mode == "paper":
+        new_params = project_paper_maxnorm(new_params)
+
+    has_real = jnp.sum(w) > 0
+
+    def select(new, old):
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(has_real, n, o), new, old
+        )
+
+    new_state = TrainState(
+        params=select(new_params, state.params),
+        batch_stats=select(new_bs, state.batch_stats),
+        opt_state=select(new_opt_state, state.opt_state),
+    )
+    return new_state, jnp.where(has_real, loss, 0.0)
+
+
+def eval_step(model, state: TrainState, x, y, w):
+    """Eval-mode forward: returns (batch_loss, n_correct) on real samples."""
+    logits, _ = apply_model(model, state.params, state.batch_stats, x, train=False)
+    loss = weighted_cross_entropy(logits, y, w)
+    pred = jnp.argmax(logits, axis=-1)
+    correct = jnp.sum((pred == y) * w)
+    return loss, correct

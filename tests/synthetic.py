@@ -1,0 +1,30 @@
+"""Synthetic BCI-IV-2a-shaped data for tests (no real data in CI, like the
+reference's all-synthetic test suite, SURVEY.md §4)."""
+
+import numpy as np
+
+from eegnetreplication_tpu.data.containers import BCICI2ADataset
+
+
+def synthetic_subject(subject: int, mode: str, n_trials: int = 48,
+                      n_channels: int = 8, n_times: int = 64,
+                      class_sep: float = 1.0) -> BCICI2ADataset:
+    """Deterministic per-subject dataset with class-dependent sinusoids."""
+    seed = subject * 100 + (0 if mode == "Train" else 1)
+    rng = np.random.RandomState(seed)
+    t = np.arange(n_times) / 64.0
+    y = rng.randint(0, 4, size=n_trials)
+    X = rng.randn(n_trials, n_channels, n_times).astype(np.float32) * 0.5
+    for k in range(4):
+        sig = class_sep * np.sin(2 * np.pi * (4.0 + 4.0 * k) * t)
+        X[y == k] += sig[None, None, :].astype(np.float32)
+    return BCICI2ADataset(X=X, y=y.astype(np.int64))
+
+
+def make_loader(n_trials=48, n_channels=8, n_times=64, class_sep=1.0):
+    def loader(subject: int, mode: str) -> BCICI2ADataset:
+        return synthetic_subject(subject, mode, n_trials=n_trials,
+                                 n_channels=n_channels, n_times=n_times,
+                                 class_sep=class_sep)
+
+    return loader

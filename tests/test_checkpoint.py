@@ -1,0 +1,105 @@
+"""Checkpoint tests: native npz roundtrip + reference .pth interop.
+
+The .pth interop test is the strong one: exported state_dicts must produce
+identical logits when loaded into an independent torch EEGNet, and a torch
+state_dict must roundtrip back into flax bit-exactly.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.models import EEGNet
+from eegnetreplication_tpu.training import checkpoint as ckpt
+
+
+@pytest.fixture
+def eegnet_vars():
+    model = EEGNet()
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 22, 257)),
+                           train=False)
+    return model, variables
+
+
+class TestNativeFormat:
+    def test_roundtrip(self, tmp_path, eegnet_vars):
+        model, variables = eegnet_vars
+        meta = {"model": "eegnet", "n_times": 257}
+        p = ckpt.save_checkpoint(tmp_path / "ck.npz", variables["params"],
+                                 variables["batch_stats"], meta)
+        params, batch_stats, metadata = ckpt.load_checkpoint(p)
+        assert metadata == meta
+        for (ka, a), (kb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(variables["params"]),
+                jax.tree_util.tree_leaves_with_path(params)):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        restored = {"params": params, "batch_stats": batch_stats}
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 22, 257), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(model.apply(variables, x, train=False)),
+            np.asarray(model.apply(restored, x, train=False)))
+
+    def test_metadata_records_T(self, tmp_path, eegnet_vars):
+        _, variables = eegnet_vars
+        p = ckpt.save_checkpoint(tmp_path / "ck.npz", variables["params"],
+                                 variables["batch_stats"],
+                                 {"n_times": 257})
+        _, _, meta = ckpt.load_checkpoint(p)
+        assert meta["n_times"] == 257  # quirk Q4 fixed: T is explicit
+
+
+class TestTorchInterop:
+    def test_state_dict_keys_match_reference_naming(self, eegnet_vars):
+        _, variables = eegnet_vars
+        sd = ckpt.to_torch_state_dict(variables["params"],
+                                      variables["batch_stats"], 16, 8)
+        # the exact keys the reference GUI reads (ui.py:518, ui.py:548)
+        assert "temporal.0.weight" in sd
+        assert "spatial.weight" in sd
+        assert sd["temporal.0.weight"].shape == (8, 1, 1, 32)
+        assert sd["spatial.weight"].shape == (16, 1, 22, 1)
+        assert sd["classifier.weight"].shape == (4, 128)
+
+    def test_flax_torch_flax_roundtrip_bitexact(self, eegnet_vars):
+        _, variables = eegnet_vars
+        sd = ckpt.to_torch_state_dict(variables["params"],
+                                      variables["batch_stats"], 16, 8)
+        params, batch_stats = ckpt.from_torch_state_dict(sd, 16, 8)
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(variables["params"]),
+                jax.tree_util.tree_leaves_with_path(params)):
+            np.testing.assert_array_equal(np.asarray(a), b, err_msg=str(pa))
+
+    def test_pth_loads_into_torch_model_with_same_logits(self, tmp_path,
+                                                         eegnet_vars):
+        torch = pytest.importorskip("torch")
+        from test_parity_torch import build_torch_eegnet
+
+        model, variables = eegnet_vars
+        p = ckpt.save_pth(tmp_path / "m.pth", variables["params"],
+                          variables["batch_stats"], 16, 8)
+        tmodel = build_torch_eegnet()
+        tmodel.load_state_dict(torch.load(p, map_location="cpu"))
+        tmodel.eval()
+
+        x = np.random.RandomState(1).randn(4, 22, 257).astype(np.float32)
+        flax_out = np.asarray(model.apply(variables, jnp.asarray(x),
+                                          train=False))
+        with torch.no_grad():
+            torch_out = tmodel(torch.tensor(x)).numpy()
+        np.testing.assert_allclose(flax_out, torch_out, rtol=1e-4, atol=1e-5)
+
+    def test_load_pth_back_to_flax(self, tmp_path, eegnet_vars):
+        pytest.importorskip("torch")
+        model, variables = eegnet_vars
+        p = ckpt.save_pth(tmp_path / "m.pth", variables["params"],
+                          variables["batch_stats"], 16, 8)
+        params, batch_stats = ckpt.load_pth(p, 16, 8)
+        x = jnp.asarray(np.random.RandomState(2).randn(2, 22, 257), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(model.apply(variables, x, train=False)),
+            np.asarray(model.apply({"params": params,
+                                    "batch_stats": batch_stats}, x,
+                                   train=False)),
+            rtol=1e-6)

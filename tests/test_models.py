@@ -1,0 +1,188 @@
+"""Model wiring/shape/gradient tests.
+
+Mirrors the reference's synthetic-tensor model suite
+(``tests/test_model.py:21-185``) under JAX: structure of the parameter tree,
+output shapes across batch sizes and (C, T) combinations, dtype, and gradient
+presence after one backward pass.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.models import (
+    DeepConvNet,
+    EEGNet,
+    ShallowConvNet,
+    eegnet_wide,
+    get_model,
+)
+
+
+def init_model(model, C=22, T=257, batch=2, seed=0):
+    x = jnp.zeros((batch, C, T), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(seed), x, train=False)
+    return variables, x
+
+
+class TestEEGNetStructure:
+    def test_parameter_tree_layers(self):
+        model = EEGNet()
+        variables, _ = init_model(model)
+        params = variables["params"]
+        assert set(params) == {
+            "temporal_conv", "temporal_bn", "spatial_conv", "spatial_bn",
+            "separable_depthwise", "separable_pointwise", "block2_bn",
+            "classifier",
+        }
+
+    def test_kernel_shapes_default(self):
+        variables, _ = init_model(EEGNet())
+        p = variables["params"]
+        # Flax NHWC kernels: (kh, kw, in/groups, out).
+        assert p["temporal_conv"]["kernel"].shape == (1, 32, 1, 8)
+        assert p["spatial_conv"]["kernel"].shape == (22, 1, 1, 16)
+        assert p["separable_depthwise"]["kernel"].shape == (1, 16, 1, 16)
+        assert p["separable_pointwise"]["kernel"].shape == (1, 1, 16, 16)
+        assert p["classifier"]["kernel"].shape == (16 * 8, 4)
+        assert p["classifier"]["bias"].shape == (4,)
+
+    def test_no_conv_bias(self):
+        variables, _ = init_model(EEGNet())
+        for layer in ("temporal_conv", "spatial_conv", "separable_depthwise",
+                      "separable_pointwise"):
+            assert "bias" not in variables["params"][layer]
+
+    def test_custom_f1_d_wiring(self):
+        model = EEGNet(F1=4, D=3)
+        variables, _ = init_model(model)
+        p = variables["params"]
+        assert p["temporal_conv"]["kernel"].shape == (1, 32, 1, 4)
+        assert p["spatial_conv"]["kernel"].shape == (22, 1, 1, 12)
+        assert p["classifier"]["kernel"].shape == (12 * 8, 4)
+
+    def test_wide_variant(self):
+        model = eegnet_wide()
+        assert model.F1 == 16 and model.D == 4 and model.F2 == 64
+
+    def test_batch_stats_collection_exists(self):
+        variables, _ = init_model(EEGNet())
+        assert set(variables["batch_stats"]) == {
+            "temporal_bn", "spatial_bn", "block2_bn"
+        }
+
+    def test_param_count_matches_reference_scale(self):
+        variables, _ = init_model(EEGNet())
+        n = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+        # conv kernels 256+352+256+256, BN 16+32+32, classifier 516 = 1716
+        assert n == 1716
+
+
+class TestEEGNetBehavior:
+    @pytest.mark.parametrize("batch", [1, 2, 7, 64])
+    def test_output_shape_batches(self, batch):
+        model = EEGNet()
+        variables, _ = init_model(model)
+        x = jnp.zeros((batch, 22, 257))
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (batch, 4)
+
+    @pytest.mark.parametrize("C,T", [(22, 257), (22, 256), (10, 128), (3, 64)])
+    def test_output_shape_ct(self, C, T):
+        model = EEGNet(n_channels=C, n_times=T)
+        variables, _ = init_model(model, C=C, T=T)
+        out = model.apply(variables, jnp.zeros((5, C, T)), train=False)
+        assert out.shape == (5, 4)
+
+    def test_wrong_input_shape_raises(self):
+        model = EEGNet()
+        variables, _ = init_model(model)
+        with pytest.raises(ValueError, match="Expected input"):
+            model.apply(variables, jnp.zeros((2, 21, 257)), train=False)
+
+    def test_output_dtype_float32(self):
+        variables, _ = init_model(EEGNet())
+        out = EEGNet().apply(variables, jnp.zeros((2, 22, 257)), train=False)
+        assert out.dtype == jnp.float32
+
+    def test_logits_not_softmaxed(self):
+        variables, x = init_model(EEGNet())
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 22, 257))
+        out = EEGNet().apply(variables, x, train=False)
+        sums = jnp.sum(jax.nn.softmax(out, axis=1), axis=1)
+        np.testing.assert_allclose(np.asarray(sums), 1.0, rtol=1e-5)
+        assert not np.allclose(np.asarray(jnp.sum(out, axis=1)), 1.0)
+
+    def test_gradients_nonzero_everywhere(self):
+        model = EEGNet()
+        variables, _ = init_model(model)
+        x = jax.random.normal(jax.random.PRNGKey(2), (8, 22, 257))
+        y = jnp.array([0, 1, 2, 3, 0, 1, 2, 3])
+
+        def loss_fn(params):
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": variables["batch_stats"]},
+                x, train=True, rngs={"dropout": jax.random.PRNGKey(3)},
+                mutable=["batch_stats"],
+            )
+            onehot = jax.nn.one_hot(y, 4)
+            return -jnp.mean(jnp.sum(onehot * jax.nn.log_softmax(logits), axis=1))
+
+        grads = jax.grad(loss_fn)(variables["params"])
+        for path, g in jax.tree_util.tree_leaves_with_path(grads):
+            assert np.all(np.isfinite(np.asarray(g))), path
+            assert float(jnp.max(jnp.abs(g))) > 0.0, path
+
+    def test_dropout_stochastic_in_train_mode(self):
+        model = EEGNet(dropout_rate=0.5)
+        variables, _ = init_model(model)
+        x = jax.random.normal(jax.random.PRNGKey(4), (4, 22, 257))
+        outs = []
+        for seed in (0, 1):
+            out, _ = model.apply(
+                variables, x, train=True,
+                rngs={"dropout": jax.random.PRNGKey(seed)},
+                mutable=["batch_stats"],
+            )
+            outs.append(np.asarray(out))
+        assert not np.allclose(outs[0], outs[1])
+
+    def test_eval_mode_deterministic(self):
+        model = EEGNet()
+        variables, _ = init_model(model)
+        x = jax.random.normal(jax.random.PRNGKey(5), (4, 22, 257))
+        a = model.apply(variables, x, train=False)
+        b = model.apply(variables, x, train=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestConvNets:
+    @pytest.mark.parametrize("cls", [ShallowConvNet, DeepConvNet])
+    def test_forward_shape(self, cls):
+        model = cls()
+        variables, _ = init_model(model)
+        out = model.apply(variables, jnp.zeros((3, 22, 257)), train=False)
+        assert out.shape == (3, 4)
+
+    @pytest.mark.parametrize("cls", [ShallowConvNet, DeepConvNet])
+    def test_train_mode_runs(self, cls):
+        model = cls()
+        variables, _ = init_model(model)
+        x = jax.random.normal(jax.random.PRNGKey(6), (4, 22, 257))
+        out, updates = model.apply(
+            variables, x, train=True,
+            rngs={"dropout": jax.random.PRNGKey(0)}, mutable=["batch_stats"],
+        )
+        assert out.shape == (4, 4)
+        assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestRegistry:
+    def test_lookup(self):
+        model = get_model("eegnet", F1=4)
+        assert isinstance(model, EEGNet) and model.F1 == 4
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError, match="Unknown model"):
+            get_model("transformer9000")

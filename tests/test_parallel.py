@@ -1,0 +1,135 @@
+"""Multi-device tests on the virtual 8-CPU-device mesh.
+
+The key invariants: (a) the DP step is numerically equivalent to the same
+global batch on one device (sync-BN + psum grads), and (b) fold-sharded
+protocol runs produce the same results as unsharded ones.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.models import EEGNet
+from eegnetreplication_tpu.parallel import (
+    DATA_AXIS,
+    make_dp_eval_step,
+    make_dp_train_step,
+    make_mesh,
+)
+from eegnetreplication_tpu.training import TrainState, make_optimizer, train_step
+from eegnetreplication_tpu.training.protocols import within_subject_training
+from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
+from synthetic import make_loader
+
+C, T = 8, 64
+
+
+@pytest.fixture(scope="module")
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs
+
+
+class TestMesh:
+    def test_fold_only_mesh(self, devices8):
+        mesh = make_mesh()
+        assert mesh.shape == {"fold": 8, "data": 1}
+
+    def test_fold_data_mesh(self, devices8):
+        mesh = make_mesh(n_fold=4, n_data=2)
+        assert mesh.shape == {"fold": 4, "data": 2}
+
+    def test_bad_shape_raises(self, devices8):
+        with pytest.raises(ValueError, match="mesh shape"):
+            make_mesh(n_fold=3, n_data=3)
+
+
+class TestDataParallelStep:
+    def test_dp_matches_single_device(self, devices8):
+        """psum-grads + sync-BN DP step == single-device full-batch step."""
+        mesh = make_mesh(n_fold=1, n_data=8)
+        tx = make_optimizer()
+        dp_model = EEGNet(n_channels=C, n_times=T, dropout_rate=0.0,
+                          bn_axis_name=DATA_AXIS)
+        sd_model = EEGNet(n_channels=C, n_times=T, dropout_rate=0.0)
+        variables = sd_model.init(jax.random.PRNGKey(0),
+                                  jnp.zeros((1, C, T)), train=False)
+        state = TrainState.create(variables, tx)
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, C, T))
+        y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 4)
+        w = jnp.ones(64)
+        rng = jax.random.PRNGKey(3)
+
+        dp_step = make_dp_train_step(dp_model, tx, mesh)
+        dp_state, dp_loss = dp_step(state, x, y, w, rng)
+        sd_state, sd_loss = train_step(sd_model, tx, state, x, y, w, rng)
+
+        np.testing.assert_allclose(float(dp_loss), float(sd_loss), rtol=1e-5)
+        # Gradients agree to f32 rounding (~1e-8), but Adam's first step is
+        # ~sign(g)*lr, so a parameter whose true gradient is ~0 (temporal_bn
+        # bias: a BN shift immediately re-normalized by the next BN) amplifies
+        # rounding noise to ~1e-4.  Compare params at a tolerance above that
+        # noise floor, and additionally require the *second* step's loss to
+        # match, which compounds any genuine semantic divergence.
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(dp_state.params),
+                jax.tree_util.tree_leaves_with_path(sd_state.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-3, err_msg=str(pa))
+        for (pa, a), (pb, b) in zip(
+                jax.tree_util.tree_leaves_with_path(dp_state.batch_stats),
+                jax.tree_util.tree_leaves_with_path(sd_state.batch_stats)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-6, err_msg=str(pa))
+
+        x2 = jax.random.normal(jax.random.PRNGKey(9), (64, C, T))
+        y2 = jax.random.randint(jax.random.PRNGKey(10), (64,), 0, 4)
+        _, dp_loss2 = dp_step(dp_state, x2, y2, w, rng)
+        _, sd_loss2 = train_step(sd_model, tx, sd_state, x2, y2, w, rng)
+        np.testing.assert_allclose(float(dp_loss2), float(sd_loss2), rtol=1e-3)
+
+    def test_dp_requires_bn_axis(self, devices8):
+        mesh = make_mesh(n_fold=1, n_data=8)
+        model = EEGNet(n_channels=C, n_times=T)  # no bn_axis_name
+        with pytest.raises(ValueError, match="bn_axis_name"):
+            make_dp_train_step(model, make_optimizer(), mesh)
+
+    def test_dp_eval_counts(self, devices8):
+        mesh = make_mesh(n_fold=1, n_data=8)
+        model = EEGNet(n_channels=C, n_times=T, bn_axis_name=DATA_AXIS)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, C, T)),
+                               train=False)
+        state = TrainState.create(variables, make_optimizer())
+        x = jax.random.normal(jax.random.PRNGKey(1), (32, C, T))
+        y = jax.random.randint(jax.random.PRNGKey(2), (32,), 0, 4)
+        w = jnp.ones(32)
+        eval_step = make_dp_eval_step(model, mesh)
+        loss_sum, correct = eval_step(state, x, y, w)
+        assert 0 <= float(correct) <= 32
+        assert np.isfinite(float(loss_sum))
+
+
+class TestFoldSharding:
+    def test_ws_protocol_sharded_matches_unsharded(self, devices8, tmp_path):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        cfg = DEFAULT_TRAINING.replace(batch_size=16)
+        kw = dict(epochs=3, config=cfg, loader=loader, subjects=(1, 2),
+                  save_models=False, seed=0, paths=Paths.from_root(tmp_path))
+        plain = within_subject_training(**kw)
+        sharded = within_subject_training(mesh=make_mesh(), **kw)
+        np.testing.assert_allclose(sharded.fold_test_acc,
+                                   plain.fold_test_acc, atol=1e-3)
+
+    def test_fold_count_not_divisible_by_devices(self, devices8, tmp_path):
+        """8 folds from 3 subjects x 4 = 12 folds over 8 devices: padding."""
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        cfg = DEFAULT_TRAINING.replace(batch_size=16)
+        result = within_subject_training(
+            epochs=2, config=cfg, loader=loader, subjects=(1, 2, 3),
+            save_models=False, seed=0, mesh=make_mesh(),
+            paths=Paths.from_root(tmp_path))
+        assert result.fold_test_acc.shape == (12,)

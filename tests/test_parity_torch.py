@@ -1,0 +1,136 @@
+"""Golden-value parity: Flax EEGNet vs an independent PyTorch EEGNet.
+
+The reference has no cross-framework parity tests; SURVEY.md §4 calls for
+them.  A PyTorch EEGNet is built here from the published architecture
+(Lawhern et al. 2018; reference layer spec at ``model.py:22-84``), the Flax
+parameters are transplanted into it, and eval-mode forward passes are
+compared.  This pins down padding semantics, BN eps, ELU, pooling and the
+NHWC-vs-NCHW flatten permutation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+
+
+def build_torch_eegnet(C=22, T=257, F1=8, D=2, p=0.5):
+    """Independent torch EEGNet matching the published architecture."""
+    F2 = F1 * D
+
+    class TorchEEGNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.temporal = tnn.Sequential(
+                tnn.Conv2d(1, F1, (1, 32), padding="same", bias=False),
+                tnn.BatchNorm2d(F1),
+            )
+            self.spatial = tnn.Conv2d(F1, D * F1, (C, 1), padding="valid",
+                                      groups=F1, bias=False)
+            self.aggregation = tnn.Sequential(
+                tnn.BatchNorm2d(D * F1), tnn.ELU(), tnn.AvgPool2d((1, 4)),
+                tnn.Dropout(p),
+            )
+            self.block_2 = tnn.Sequential(
+                tnn.Conv2d(D * F1, D * F1, (1, 16), padding="same",
+                           groups=D * F1, bias=False),
+                tnn.Conv2d(D * F1, F2, (1, 1), padding="same", bias=False),
+                tnn.BatchNorm2d(F2), tnn.ELU(), tnn.AvgPool2d((1, 8)),
+                tnn.Dropout(p), tnn.Flatten(),
+            )
+            self.classifier = tnn.Linear(F2 * (T // 32), 4, bias=True)
+
+        def forward(self, x):
+            x = torch.unsqueeze(x, 1)
+            x = self.temporal(x)
+            x = self.spatial(x)
+            x = self.aggregation(x)
+            x = self.block_2(x)
+            return self.classifier(x)
+
+    return TorchEEGNet()
+
+
+def transplant_flax_to_torch(variables, tmodel, F2, t_prime):
+    """Copy flax params/batch_stats into the torch model in-place."""
+    p = jax.tree_util.tree_map(np.asarray, variables["params"])
+    bs = jax.tree_util.tree_map(np.asarray, variables["batch_stats"])
+
+    def conv_w(kernel):  # (kh, kw, in/g, out) -> (out, in/g, kh, kw)
+        return torch.tensor(np.transpose(kernel, (3, 2, 0, 1)))
+
+    sd = tmodel.state_dict()
+    sd["temporal.0.weight"] = conv_w(p["temporal_conv"]["kernel"])
+    sd["temporal.1.weight"] = torch.tensor(p["temporal_bn"]["scale"])
+    sd["temporal.1.bias"] = torch.tensor(p["temporal_bn"]["bias"])
+    sd["temporal.1.running_mean"] = torch.tensor(bs["temporal_bn"]["mean"])
+    sd["temporal.1.running_var"] = torch.tensor(bs["temporal_bn"]["var"])
+    sd["spatial.weight"] = conv_w(p["spatial_conv"]["kernel"])
+    sd["aggregation.0.weight"] = torch.tensor(p["spatial_bn"]["scale"])
+    sd["aggregation.0.bias"] = torch.tensor(p["spatial_bn"]["bias"])
+    sd["aggregation.0.running_mean"] = torch.tensor(bs["spatial_bn"]["mean"])
+    sd["aggregation.0.running_var"] = torch.tensor(bs["spatial_bn"]["var"])
+    sd["block_2.0.weight"] = conv_w(p["separable_depthwise"]["kernel"])
+    sd["block_2.1.weight"] = conv_w(p["separable_pointwise"]["kernel"])
+    sd["block_2.2.weight"] = torch.tensor(p["block2_bn"]["scale"])
+    sd["block_2.2.bias"] = torch.tensor(p["block2_bn"]["bias"])
+    sd["block_2.2.running_mean"] = torch.tensor(bs["block2_bn"]["mean"])
+    sd["block_2.2.running_var"] = torch.tensor(bs["block2_bn"]["var"])
+
+    # Flax flattens NHWC (1, T', F2) -> index w*F2 + f; torch flattens NCHW
+    # (F2, 1, T') -> index f*T' + w.  Permute the classifier input features.
+    k = p["classifier"]["kernel"]  # (T'*F2, 4) in flax order
+    k_torch = np.zeros((4, F2 * t_prime), dtype=k.dtype)
+    for f in range(F2):
+        for w in range(t_prime):
+            k_torch[:, f * t_prime + w] = k[w * F2 + f, :]
+    sd["classifier.weight"] = torch.tensor(k_torch)
+    sd["classifier.bias"] = torch.tensor(p["classifier"]["bias"])
+    tmodel.load_state_dict(sd)
+    tmodel.eval()
+
+
+@pytest.mark.parametrize("C,T", [(22, 257), (22, 256)])
+def test_eval_forward_parity(C, T):
+    model = EEGNet(n_channels=C, n_times=T)
+    x = np.random.RandomState(0).randn(6, C, T).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(x), train=False)
+
+    tmodel = build_torch_eegnet(C=C, T=T)
+    transplant_flax_to_torch(variables, tmodel, F2=16, t_prime=T // 32)
+
+    flax_out = np.asarray(model.apply(variables, jnp.asarray(x), train=False))
+    with torch.no_grad():
+        torch_out = tmodel(torch.tensor(x)).numpy()
+
+    np.testing.assert_allclose(flax_out, torch_out, rtol=1e-4, atol=1e-5)
+
+
+def test_parity_with_perturbed_bn_stats():
+    """Parity must hold with non-trivial running stats, not just init."""
+    model = EEGNet()
+    x = np.random.RandomState(1).randn(4, 22, 257).astype(np.float32)
+    variables = model.init(jax.random.PRNGKey(1), jnp.asarray(x), train=False)
+
+    # Run a few train-mode passes so running stats move off (0, 1).
+    vars_mut = variables
+    for seed in range(3):
+        _, updates = model.apply(
+            vars_mut, jnp.asarray(x), train=True,
+            rngs={"dropout": jax.random.PRNGKey(seed)}, mutable=["batch_stats"],
+        )
+        vars_mut = {"params": vars_mut["params"],
+                    "batch_stats": updates["batch_stats"]}
+
+    tmodel = build_torch_eegnet()
+    transplant_flax_to_torch(vars_mut, tmodel, F2=16, t_prime=8)
+
+    flax_out = np.asarray(model.apply(vars_mut, jnp.asarray(x), train=False))
+    with torch.no_grad():
+        torch_out = tmodel(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(flax_out, torch_out, rtol=1e-4, atol=1e-5)

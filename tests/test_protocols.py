@@ -1,0 +1,114 @@
+"""Protocol + report tests on synthetic data.
+
+Validates the full within/cross-subject orchestration (fold construction,
+vmapped training, best-model selection, model saving) and byte-level report
+schema parity with the reference's ``generate_*_report``.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
+from eegnetreplication_tpu.training.protocols import (
+    cross_subject_training,
+    within_subject_training,
+)
+from eegnetreplication_tpu.training.report import (
+    generate_cs_report,
+    generate_ws_report,
+)
+from synthetic import make_loader
+
+CFG = DEFAULT_TRAINING.replace(batch_size=16)
+
+
+@pytest.fixture
+def tmp_paths(tmp_path):
+    return Paths.from_root(tmp_path)
+
+
+class TestWithinSubject:
+    def test_three_subjects_end_to_end(self, tmp_paths):
+        loader = make_loader(n_trials=32, n_channels=6, n_times=64,
+                             class_sep=1.5)
+        result = within_subject_training(
+            epochs=25, config=CFG, loader=loader, subjects=(1, 2, 3),
+            paths=tmp_paths, seed=0)
+        assert len(result.per_subject_test_acc) == 3
+        assert result.fold_test_acc.shape == (12,)
+        assert np.isclose(result.avg_test_acc,
+                          np.mean(result.per_subject_test_acc))
+        # separable synthetic task: better than the 25% chance level
+        assert result.avg_test_acc > 40.0
+        for s in (1, 2, 3):
+            assert (tmp_paths.models / f"subject_{s:02d}_best_model.pth").exists()
+            assert (tmp_paths.models / f"subject_{s:02d}_best_model.npz").exists()
+
+    def test_report_schema_matches_reference(self, tmp_paths):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        result = within_subject_training(
+            epochs=2, config=CFG, loader=loader, subjects=(1, 2),
+            paths=tmp_paths, seed=0)
+        generate_ws_report(result.per_subject_test_acc, result.avg_test_acc,
+                           result.best_states, epochs=2, config=CFG,
+                           paths=tmp_paths)
+        with open(tmp_paths.reports / "latest_within_subject_report.json") as f:
+            report = json.load(f)
+        assert set(report) == {
+            "training_type", "timestamp", "model_parameters",
+            "overall_results", "per_subject_results", "model_info",
+            "summary_statistics"}
+        assert report["training_type"] == "Within-Subject"
+        assert set(report["model_parameters"]) == {
+            "batch_size", "epochs", "learning_rate", "dropout_probability",
+            "cross_validation_folds"}
+        assert set(report["overall_results"]) == {
+            "average_test_accuracy", "number_of_subjects",
+            "best_subject_accuracy", "worst_subject_accuracy", "accuracy_std"}
+        entry = report["per_subject_results"][0]
+        assert set(entry) == {"subject_id", "test_accuracy", "model_saved",
+                              "performance_rank"}
+        assert entry["model_saved"] == "subject_01_best_model.pth"
+        ranks = sorted(e["performance_rank"]
+                       for e in report["per_subject_results"])
+        assert ranks == [1, 2]
+        assert set(report["summary_statistics"]) == {
+            "accuracy_distribution", "accuracy_quartiles"}
+
+
+class TestCrossSubject:
+    def test_four_subjects_end_to_end(self, tmp_paths):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        cfg = CFG.replace(cs_repeats_per_subject=2, cs_train_subjects=2,
+                          cs_val_subjects=1)
+        result = cross_subject_training(
+            epochs=4, config=cfg, loader=loader, subjects=(1, 2, 3, 4),
+            paths=tmp_paths, seed=0)
+        assert len(result.per_subject_test_acc) == 4
+        assert result.fold_test_acc.shape == (8,)  # 4 subjects x 2 repeats
+        assert (tmp_paths.models / "cross_subject_best_model.pth").exists()
+        assert len(result.best_states) == 1
+
+    def test_report_schema_matches_reference(self, tmp_paths):
+        accs = [55.0, 60.0, 65.0]
+        generate_cs_report(None, accs, 60.0, epochs=4, config=CFG,
+                           paths=tmp_paths)
+        with open(tmp_paths.reports / "latest_cross_subject_report.json") as f:
+            report = json.load(f)
+        assert report["training_type"] == "Cross-Subject"
+        assert set(report["model_parameters"]) == {
+            "batch_size", "epochs", "learning_rate", "dropout_probability",
+            "total_folds", "repeats_per_subject", "train_subjects_per_fold",
+            "validation_subjects_per_fold"}
+        assert set(report["overall_results"]) == {
+            "average_test_accuracy", "standard_error",
+            "number_of_test_subjects", "best_subject_accuracy",
+            "worst_subject_accuracy", "accuracy_std"}
+        entry = report["per_subject_results"][0]
+        assert set(entry) == {"test_subject_id", "test_accuracy",
+                              "performance_rank"}
+        assert report["overall_results"]["standard_error"] == round(
+            float(np.std(accs) / np.sqrt(3)), 2)
+        assert report["model_info"]["saved_model"] == "cross_subject_best_model.pth"

@@ -1,0 +1,81 @@
+"""Split logic tests: sklearn parity and reference seeding semantics."""
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.data.splits import (
+    cross_subject_fold_subjects,
+    inner_train_val_split,
+    kfold_indices,
+)
+
+
+class TestKFold:
+    def test_partition_properties(self):
+        splits = kfold_indices(101, 4, seed=42)
+        assert len(splits) == 4
+        all_test = np.concatenate([t for _, t in splits])
+        assert sorted(all_test) == list(range(101))
+        for train, test in splits:
+            assert len(np.intersect1d(train, test)) == 0
+            assert len(train) + len(test) == 101
+
+    def test_matches_sklearn(self):
+        sklearn = pytest.importorskip("sklearn.model_selection")
+        for n, k, seed in [(576, 4, 42), (101, 4, 42), (50, 5, 7)]:
+            ours = kfold_indices(n, k, seed)
+            theirs = list(
+                sklearn.KFold(n_splits=k, shuffle=True,
+                              random_state=seed).split(np.zeros(n)))
+            for (otr, ote), (str_, ste) in zip(ours, theirs):
+                np.testing.assert_array_equal(otr, str_)
+                np.testing.assert_array_equal(ote, ste)
+
+    def test_deterministic(self):
+        a = kfold_indices(100, 4, seed=42)
+        b = kfold_indices(100, 4, seed=42)
+        for (atr, ate), (btr, bte) in zip(a, b):
+            np.testing.assert_array_equal(atr, btr)
+            np.testing.assert_array_equal(ate, bte)
+
+    def test_too_many_splits_raises(self):
+        with pytest.raises(ValueError):
+            kfold_indices(3, 4)
+
+
+class TestInnerSplit:
+    def test_80_20_front_val(self):
+        ids = np.arange(100, 200)
+        train, val = inner_train_val_split(ids)
+        # reference: val = first fifth, train = rest (train.py:77-79)
+        np.testing.assert_array_equal(val, ids[:20])
+        np.testing.assert_array_equal(train, ids[20:])
+
+
+class TestCrossSubjectDraw:
+    def test_excludes_test_subject_and_partitions(self):
+        for subject in range(1, 10):
+            tr, va = cross_subject_fold_subjects(subject, fold_count=1)
+            assert subject not in tr and subject not in va
+            assert len(tr) == 5 and len(va) == 3
+            assert len(set(tr) | set(va)) == 8
+
+    def test_matches_reference_seeding(self):
+        """RandomState(42+fold_count).permutation over the ordered others."""
+        subject, fold_count = 3, 17
+        other = np.array([s for s in range(1, 10) if s != subject])
+        expect = np.random.RandomState(42 + fold_count).permutation(other)
+        tr, va = cross_subject_fold_subjects(subject, fold_count)
+        np.testing.assert_array_equal(tr, expect[:5])
+        np.testing.assert_array_equal(va, expect[5:])
+
+    def test_folds_differ_across_repeats(self):
+        draws = {tuple(cross_subject_fold_subjects(1, fc)[0]) for fc in range(1, 11)}
+        assert len(draws) > 1
+
+    def test_arbitrary_subject_labels(self):
+        tr, va = cross_subject_fold_subjects(6, 1, subjects=(5, 6, 7, 8),
+                                             n_train=2)
+        assert 6 not in tr and 6 not in va
+        assert set(tr) | set(va) == {5, 7, 8}
+        assert len(tr) == 2 and len(va) == 1

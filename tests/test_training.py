@@ -1,0 +1,221 @@
+"""Tests for the training engine: steps, max-norm modes, fused fold loop.
+
+Extends the reference's integration tests (one optimizer step with NaN/Inf
+checks, ``tests/test_model.py:236-280``) with what the reference lacks:
+deterministic-seed regression, learnability on a separable synthetic task,
+masked-padding invariants, and vmap-over-folds equivalence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.models import EEGNet
+from eegnetreplication_tpu.training import (
+    FoldSpec,
+    TrainState,
+    init_fold_states,
+    make_fold_spec,
+    make_fold_trainer,
+    make_optimizer,
+    train_step,
+)
+from eegnetreplication_tpu.training.steps import (
+    clamp_reference_maxnorm,
+    project_paper_maxnorm,
+    weighted_cross_entropy,
+)
+
+C, T = 8, 64
+
+
+def small_model(p=0.5):
+    return EEGNet(n_channels=C, n_times=T, dropout_rate=p)
+
+
+def make_state(model, tx, seed=0):
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, C, T)),
+                           train=False)
+    return TrainState.create(variables, tx)
+
+
+def separable_pool(n_per_class=40, seed=0):
+    """Synthetic 4-class pool where class k has a sinusoid at distinct freq."""
+    rng = np.random.RandomState(seed)
+    xs, ys = [], []
+    t = np.arange(T) / 64.0
+    for k in range(4):
+        freq = 4.0 + 4.0 * k
+        sig = np.sin(2 * np.pi * freq * t)
+        x = rng.randn(n_per_class, C, T) * 0.3 + sig[None, None, :]
+        xs.append(x)
+        ys.append(np.full(n_per_class, k))
+    X = np.concatenate(xs).astype(np.float32)
+    y = np.concatenate(ys).astype(np.int32)
+    perm = rng.permutation(len(y))
+    return jnp.asarray(X[perm]), jnp.asarray(y[perm])
+
+
+class TestSteps:
+    def test_one_step_finite_and_changes_params(self):
+        model, tx = small_model(), make_optimizer()
+        state = make_state(model, tx)
+        x = jax.random.normal(jax.random.PRNGKey(1), (16, C, T))
+        y = jnp.arange(16) % 4
+        w = jnp.ones(16)
+        new_state, loss = train_step(model, tx, state, x, y, w,
+                                     jax.random.PRNGKey(2))
+        assert np.isfinite(float(loss))
+        diffs = jax.tree_util.tree_map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))),
+            new_state.params, state.params)
+        assert max(jax.tree_util.tree_leaves(diffs)) > 0
+
+    def test_empty_batch_is_noop(self):
+        model, tx = small_model(), make_optimizer()
+        state = make_state(model, tx)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, C, T))
+        y = jnp.zeros(8, jnp.int32)
+        w = jnp.zeros(8)
+        new_state, loss = train_step(model, tx, state, x, y, w,
+                                     jax.random.PRNGKey(2))
+        assert float(loss) == 0.0
+        for a, b in zip(jax.tree_util.tree_leaves(new_state.params),
+                        jax.tree_util.tree_leaves(state.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(jax.tree_util.tree_leaves(new_state.opt_state),
+                        jax.tree_util.tree_leaves(state.opt_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_weighted_ce_ignores_padding(self):
+        logits = jnp.asarray(np.random.RandomState(0).randn(6, 4), jnp.float32)
+        y = jnp.asarray([0, 1, 2, 3, 0, 1])
+        full = weighted_cross_entropy(logits[:4], y[:4], jnp.ones(4))
+        padded = weighted_cross_entropy(
+            logits, y, jnp.asarray([1, 1, 1, 1, 0, 0], jnp.float32))
+        np.testing.assert_allclose(float(full), float(padded), rtol=1e-6)
+
+    def test_reference_maxnorm_clamps_only_targets(self):
+        model, tx = small_model(), make_optimizer()
+        state = make_state(model, tx)
+        big = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 5.0),
+                                     state.params)
+        clamped = clamp_reference_maxnorm(big)
+        assert float(jnp.max(clamped["spatial_conv"]["kernel"])) == 1.0
+        assert float(jnp.max(clamped["classifier"]["kernel"])) == 0.25
+        assert float(jnp.max(clamped["classifier"]["bias"])) == 5.0
+        assert float(jnp.max(clamped["temporal_conv"]["kernel"])) == 5.0
+
+    def test_paper_maxnorm_projects_norms(self):
+        model, tx = small_model(), make_optimizer()
+        state = make_state(model, tx)
+        big = jax.tree_util.tree_map(lambda p: jnp.full_like(p, 5.0),
+                                     state.params)
+        proj = project_paper_maxnorm(big)
+        sp = np.asarray(proj["spatial_conv"]["kernel"])
+        norms = np.sqrt((sp ** 2).sum(axis=(0, 1, 2)))
+        assert np.all(norms <= 1.0 + 1e-5)
+        cl = np.asarray(proj["classifier"]["kernel"])
+        assert np.all(np.sqrt((cl ** 2).sum(axis=0)) <= 0.25 + 1e-5)
+        np.testing.assert_allclose(np.asarray(proj["temporal_conv"]["kernel"]),
+                                   5.0)
+
+
+class TestFoldTrainer:
+    def make_setup(self, epochs=5, batch_size=32, maxnorm_mode="reference"):
+        model = small_model()
+        tx = make_optimizer()
+        pool_x, pool_y = separable_pool()
+        n = len(pool_y)  # 160
+        idx = np.arange(n)
+        spec = make_fold_spec(idx[:96], idx[96:128], idx[128:],
+                              train_pad=96, val_pad=32, test_pad=32)
+        trainer = make_fold_trainer(
+            model, tx, batch_size=batch_size, epochs=epochs, train_pad=96,
+            val_pad=32, test_pad=32, maxnorm_mode=maxnorm_mode)
+        state = make_state(model, tx)
+        return trainer, pool_x, pool_y, spec, state
+
+    def test_learns_separable_task(self):
+        trainer, pool_x, pool_y, spec, state = self.make_setup(epochs=30)
+        result = jax.jit(trainer)(pool_x, pool_y, spec, state,
+                                  jax.random.PRNGKey(0))
+        assert result.train_losses.shape == (30,)
+        assert float(result.train_losses[-1]) < float(result.train_losses[0])
+        assert float(result.best_val_acc) > 60.0
+        assert float(result.test_accuracy) > 60.0
+
+    def test_deterministic_given_seed(self):
+        trainer, pool_x, pool_y, spec, state = self.make_setup(epochs=3)
+        r1 = jax.jit(trainer)(pool_x, pool_y, spec, state, jax.random.PRNGKey(7))
+        r2 = jax.jit(trainer)(pool_x, pool_y, spec, state, jax.random.PRNGKey(7))
+        np.testing.assert_array_equal(np.asarray(r1.val_accuracies),
+                                      np.asarray(r2.val_accuracies))
+        np.testing.assert_array_equal(np.asarray(r1.test_accuracy),
+                                      np.asarray(r2.test_accuracy))
+
+    def test_best_tracking_matches_max(self):
+        trainer, pool_x, pool_y, spec, state = self.make_setup(epochs=10)
+        r = jax.jit(trainer)(pool_x, pool_y, spec, state, jax.random.PRNGKey(1))
+        np.testing.assert_allclose(float(r.best_val_acc),
+                                   float(np.max(np.asarray(r.val_accuracies))),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(float(r.min_val_loss),
+                                   float(np.min(np.asarray(r.val_losses))),
+                                   rtol=1e-6)
+
+    def test_padded_fold_equivalent_to_exact_fold(self):
+        """Padding the index arrays must not change the math."""
+        model = small_model(p=0.0)  # no dropout so runs are comparable
+        tx = make_optimizer()
+        pool_x, pool_y = separable_pool()
+        idx = np.arange(160)
+        state = make_state(model, tx)
+        key = jax.random.PRNGKey(3)
+
+        exact_spec = make_fold_spec(idx[:96], idx[96:128], idx[128:160],
+                                    train_pad=96, val_pad=32, test_pad=32)
+        exact = make_fold_trainer(model, tx, batch_size=32, epochs=3,
+                                  train_pad=96, val_pad=32, test_pad=32)
+        r_exact = jax.jit(exact)(pool_x, pool_y, exact_spec, state, key)
+
+        padded_spec = make_fold_spec(idx[:96], idx[96:128], idx[128:160],
+                                     train_pad=128, val_pad=64, test_pad=64)
+        padded = make_fold_trainer(model, tx, batch_size=32, epochs=3,
+                                   train_pad=128, val_pad=64, test_pad=64)
+        r_padded = jax.jit(padded)(pool_x, pool_y, padded_spec, state, key)
+
+        # Val/test metrics must agree exactly in exact arithmetic; allow f32
+        # reduction-order noise.
+        np.testing.assert_allclose(np.asarray(r_exact.val_accuracies),
+                                   np.asarray(r_padded.val_accuracies),
+                                   atol=1e-3)
+        np.testing.assert_allclose(float(r_exact.test_accuracy),
+                                   float(r_padded.test_accuracy), atol=1e-3)
+
+    def test_vmap_over_folds_matches_single(self):
+        model = small_model(p=0.0)
+        tx = make_optimizer()
+        pool_x, pool_y = separable_pool()
+        idx = np.arange(160)
+        trainer = make_fold_trainer(model, tx, batch_size=32, epochs=2,
+                                    train_pad=96, val_pad=32, test_pad=32)
+        spec_a = make_fold_spec(idx[:96], idx[96:128], idx[128:],
+                                train_pad=96, val_pad=32, test_pad=32)
+        spec_b = make_fold_spec(idx[64:160], idx[:32], idx[32:64],
+                                train_pad=96, val_pad=32, test_pad=32)
+        states = init_fold_states(model, tx, 2, (C, T), seed=0)
+        keys = jax.random.split(jax.random.PRNGKey(5), 2)
+
+        specs = jax.tree_util.tree_map(
+            lambda a, b: jnp.stack([a, b]), spec_a, spec_b)
+        vr = jax.jit(jax.vmap(trainer, in_axes=(None, None, 0, 0, 0)))(
+            pool_x, pool_y, specs, states, keys)
+
+        state_a = jax.tree_util.tree_map(lambda x: x[0], states)
+        ra = jax.jit(trainer)(pool_x, pool_y, spec_a, state_a, keys[0])
+        np.testing.assert_allclose(np.asarray(vr.val_accuracies[0]),
+                                   np.asarray(ra.val_accuracies), atol=1e-3)
+        np.testing.assert_allclose(float(vr.test_accuracy[0]),
+                                   float(ra.test_accuracy), atol=1e-3)
