@@ -30,6 +30,11 @@ class ShallowConvNet(nn.Module):
     defaults (25, 75/15) scaled to 128 Hz.
     """
 
+    # No max-norm constraint: the published architecture (and braindecode's
+    # implementation) has none; only EEGNet declares limits.  Plain class
+    # attribute (no annotation) so flax does not treat it as a field.
+    MAXNORM_LIMITS = {}
+
     n_channels: int = 22
     n_times: int = 257
     n_classes: int = 4
@@ -76,6 +81,8 @@ class DeepConvNet(nn.Module):
     Temporal kernels (1,5) and pools (1,2) are the braindecode 250 Hz defaults
     ((1,10)/(1,3)) scaled to 128 Hz so four blocks fit in T=257 samples.
     """
+
+    MAXNORM_LIMITS = {}
 
     n_channels: int = 22
     n_times: int = 257

@@ -56,6 +56,10 @@ class EEGNet(nn.Module):
     (within-subject) or 0.25 (cross-subject).
     """
 
+    # Layers under max-norm treatment (quirk Q1; limits from model.py:43-44,
+    # 83-84).  Plain class attribute, not a dataclass field.
+    MAXNORM_LIMITS = {"spatial_conv": 1.0, "classifier": 0.25}
+
     n_channels: int = 22
     n_times: int = 257
     n_classes: int = 4

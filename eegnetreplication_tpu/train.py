@@ -86,7 +86,8 @@ def main() -> None:
         if args.generateReport:
             generate_ws_report(result.per_subject_test_acc,
                                result.avg_test_acc, result.best_states,
-                               epochs=args.epochs, config=config)
+                               epochs=args.epochs, subjects=result.subjects,
+                               config=config)
     else:
         logger.info("Training Cross-Subject model...")
         result = cross_subject_training(epochs=args.epochs, config=config,
@@ -98,7 +99,7 @@ def main() -> None:
             generate_cs_report(result.best_states[0],
                                result.per_subject_test_acc,
                                result.avg_test_acc, epochs=args.epochs,
-                               config=config)
+                               subjects=result.subjects, config=config)
 
 
 if __name__ == "__main__":

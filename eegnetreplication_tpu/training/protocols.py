@@ -68,6 +68,7 @@ class ProtocolResult:
     fold_test_acc: np.ndarray       # all folds' test accuracies
     wall_seconds: float
     epochs: int
+    subjects: tuple[int, ...] = tuple(range(1, 10))
 
     @property
     def epoch_throughput(self) -> float:
@@ -224,7 +225,7 @@ def within_subject_training(epochs: int | None = None, *,
     avg = float(np.mean(per_subject_test_acc))
     logger.info("Overall Average Test Accuracy across all subjects: %.2f%%", avg)
     return ProtocolResult(per_subject_test_acc, avg, best_states, fold_test,
-                          wall, epochs)
+                          wall, epochs, tuple(subjects))
 
 
 def cross_subject_training(epochs: int | None = None, *,
@@ -295,4 +296,4 @@ def cross_subject_training(epochs: int | None = None, *,
                     paths.models / "cross_subject_best_model.pth")
 
     return ProtocolResult(per_subject_test_acc, avg_all, [best_state],
-                          fold_test, wall, epochs)
+                          fold_test, wall, epochs, tuple(subjects))

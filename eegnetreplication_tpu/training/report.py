@@ -25,17 +25,19 @@ from eegnetreplication_tpu.utils.logging import logger
 
 
 def _ranked_subject_results(accs: list[float], id_key: str,
+                            subjects: tuple[int, ...] | None = None,
                             extra: dict | None = None) -> list[dict]:
     """Per-subject entries with 1-based rank by descending accuracy.
 
     Reproduces the sort-then-backfill at ``train.py:336-354``: ties get
     distinct ranks in sorted-list order (stable sort keeps lower subject id
-    first).
+    first).  ``subjects`` gives the real subject ids when a subset was
+    trained; default is 1..N like the reference's fixed range.
     """
+    subjects = subjects or tuple(range(1, len(accs) + 1))
     results = []
-    for subject_id in range(1, len(accs) + 1):
-        entry = {id_key: subject_id,
-                 "test_accuracy": round(accs[subject_id - 1], 2)}
+    for subject_id, acc in zip(subjects, accs):
+        entry = {id_key: subject_id, "test_accuracy": round(acc, 2)}
         if extra:
             entry.update(extra(subject_id) if callable(extra) else extra)
         entry["performance_rank"] = 0
@@ -75,6 +77,7 @@ def _write_report(report_data: dict, stem: str, paths: Paths) -> Path:
 def generate_ws_report(per_subject_test_acc, avg_test_acc_all_subjects,
                        best_model_states_all_subjects, *,
                        epochs: int | None = None,
+                       subjects: tuple[int, ...] | None = None,
                        config: TrainingConfig = DEFAULT_TRAINING,
                        paths: Paths | None = None) -> Path:
     """Within-subject report (schema: ``train.py:309-368``)."""
@@ -99,7 +102,7 @@ def generate_ws_report(per_subject_test_acc, avg_test_acc_all_subjects,
             "accuracy_std": round(float(np.std(accs)), 2),
         },
         "per_subject_results": _ranked_subject_results(
-            accs, "subject_id",
+            accs, "subject_id", subjects,
             extra=lambda sid: {"model_saved": f"subject_{sid:02d}_best_model.pth"},
         ),
         "model_info": {
@@ -115,6 +118,7 @@ def generate_ws_report(per_subject_test_acc, avg_test_acc_all_subjects,
 
 def generate_cs_report(best_model_state, per_subject_test_acc,
                        avg_test_acc_all, *, epochs: int | None = None,
+                       subjects: tuple[int, ...] | None = None,
                        config: TrainingConfig = DEFAULT_TRAINING,
                        paths: Paths | None = None) -> Path:
     """Cross-subject report (schema: ``train.py:406-468``)."""
@@ -144,7 +148,8 @@ def generate_cs_report(best_model_state, per_subject_test_acc,
             "worst_subject_accuracy": round(min(accs), 2),
             "accuracy_std": round(float(np.std(accs)), 2),
         },
-        "per_subject_results": _ranked_subject_results(accs, "test_subject_id"),
+        "per_subject_results": _ranked_subject_results(accs, "test_subject_id",
+                                                       subjects),
         "model_info": {
             "architecture": "EEGNet",
             "optimizer": "Adam",

@@ -25,8 +25,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
-# Parameter-tree paths subject to max-norm treatment, with their limits
-# (reference: clamp values 1.0 and 0.25 at model.py:43-44,83-84).
+# EEGNet's parameter-tree paths subject to max-norm treatment, with their
+# limits (reference: clamp values 1.0 and 0.25 at model.py:43-44,83-84).
+# This constraint belongs to the EEGNet architecture only; models declare
+# their own limits via a ``MAXNORM_LIMITS`` class attribute (empty for the
+# ShallowConvNet/DeepConvNet baselines, which publish no such constraint).
 MAXNORM_LIMITS = {"spatial_conv": 1.0, "classifier": 0.25}
 
 
@@ -52,16 +55,18 @@ def make_optimizer(learning_rate: float = 1e-3, eps: float = 1e-7) -> optax.Grad
     return optax.adam(learning_rate, b1=0.9, b2=0.999, eps=eps)
 
 
-def clamp_reference_maxnorm(grads: Any) -> Any:
+def clamp_reference_maxnorm(grads: Any, limits: dict | None = None) -> Any:
     """Quirk-Q1 'reference' mode: clamp selected layers' *gradients*.
 
     The reference's ``register_hook`` on the Parameter fires on the gradient,
     so its "max-norm constraint" is an elementwise gradient clamp to +-1.0
     (spatial conv) and +-0.25 (classifier kernel); biases/BN are untouched.
     """
+    limits = MAXNORM_LIMITS if limits is None else limits
+
     def maybe_clamp(path, g):
         top = path[0].key if path else None
-        limit = MAXNORM_LIMITS.get(top)
+        limit = limits.get(top)
         # torch hooks are registered on the weights only (not classifier bias:
         # the hook at model.py:84 targets classifier.weight).
         leaf = path[-1].key if path else None
@@ -72,21 +77,25 @@ def clamp_reference_maxnorm(grads: Any) -> Any:
     return jax.tree_util.tree_map_with_path(maybe_clamp, grads)
 
 
-def project_paper_maxnorm(params: Any) -> Any:
+def project_paper_maxnorm(params: Any, limits: dict | None = None) -> Any:
     """True max-norm weight projection (Lawhern et al. 2018, and the Keras
     reference implementation): renormalize each spatial filter's L2 norm to
     <= 1.0 and each classifier unit's incoming-weight norm to <= 0.25.
     """
+    limits = MAXNORM_LIMITS if limits is None else limits
+
     def maybe_project(path, w):
         top = path[0].key if path else None
         leaf = path[-1].key if path else None
-        limit = MAXNORM_LIMITS.get(top)
+        limit = limits.get(top)
         if limit is None or leaf != "kernel":
             return w
-        if top == "spatial_conv":
-            # (C, 1, in/g, out): norm over the receptive field per out filter.
-            norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=(0, 1, 2), keepdims=True))
-        else:  # classifier (fan_in, n_classes): per output unit.
+        if w.ndim > 2:
+            # Conv kernel (kh, kw, in/g, out): receptive-field norm per filter.
+            norms = jnp.sqrt(jnp.sum(jnp.square(w),
+                                     axis=tuple(range(w.ndim - 1)),
+                                     keepdims=True))
+        else:  # Dense kernel (fan_in, out): per output unit.
             norms = jnp.sqrt(jnp.sum(jnp.square(w), axis=0, keepdims=True))
         scale = jnp.minimum(1.0, limit / jnp.maximum(norms, 1e-12))
         return w * scale
@@ -136,12 +145,15 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
 
     (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
 
+    # Max-norm treatment is per-architecture: models declare their constrained
+    # layers (EEGNet does; the ConvNet baselines declare none).
+    limits = getattr(model, "MAXNORM_LIMITS", {})
     if maxnorm_mode == "reference":
-        grads = clamp_reference_maxnorm(grads)
+        grads = clamp_reference_maxnorm(grads, limits)
     updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
     new_params = optax.apply_updates(state.params, updates)
     if maxnorm_mode == "paper":
-        new_params = project_paper_maxnorm(new_params)
+        new_params = project_paper_maxnorm(new_params, limits)
 
     has_real = jnp.sum(w) > 0
 
