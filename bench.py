@@ -28,10 +28,9 @@ import time
 
 import numpy as np
 
-if os.environ.get("EEGTPU_PLATFORM"):
-    import jax
+from eegnetreplication_tpu.utils.platform import apply_platform_override
 
-    jax.config.update("jax_platforms", os.environ["EEGTPU_PLATFORM"])
+apply_platform_override()
 
 C, T, N_POOL, BATCH = 22, 257, 576, 64
 N_FOLDS = 4

@@ -31,8 +31,16 @@ class Paths:
 
     @staticmethod
     def from_here() -> "Paths":
-        """Anchor paths at the repo root (one level above the package)."""
-        root = Path(__file__).resolve().parents[1]
+        """Anchor paths at the repo root (one level above the package).
+
+        The reference hard-anchors at its install tree (``config.py:20-30``);
+        the ``EEGTPU_DATA_ROOT`` env var additionally allows pointing a CLI
+        run at any data tree without moving the package.
+        """
+        import os
+
+        env_root = os.environ.get("EEGTPU_DATA_ROOT")
+        root = Path(env_root) if env_root else Path(__file__).resolve().parents[1]
         return Paths.from_root(root)
 
     @staticmethod

@@ -1,0 +1,122 @@
+"""ctypes binding for the C++ GDF reader (``native/gdf_reader.cc``).
+
+Loads ``libeegtpu_gdf.so`` from ``native/build/``; ``ensure_built()`` invokes
+``make`` once when a toolchain is present, so the fast path self-provisions.
+The pure-numpy reader in :mod:`eegnetreplication_tpu.data.gdf` remains the
+always-available fallback (and the behavioral spec the native path is tested
+against, ``tests/test_native_gdf.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.utils.logging import logger
+
+_NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libeegtpu_gdf.so"
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def ensure_built(quiet: bool = True) -> bool:
+    """Build the native library if missing; returns availability."""
+    if _LIB_PATH.exists():
+        return True
+    if not (_NATIVE_DIR / "Makefile").exists() or shutil.which("make") is None:
+        return False
+    try:
+        subprocess.run(["make", "-C", str(_NATIVE_DIR)], check=True,
+                       capture_output=quiet)
+    except (subprocess.CalledProcessError, OSError) as e:
+        logger.warning("Native GDF reader build failed: %s", e)
+        return False
+    return _LIB_PATH.exists()
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    if not _LIB_PATH.exists() and not ensure_built():
+        _load_failed = True
+        return None
+    try:
+        lib = ctypes.CDLL(str(_LIB_PATH))
+    except OSError as e:
+        logger.warning("Failed to load native GDF reader: %s", e)
+        _load_failed = True
+        return None
+
+    lib.gdf_open.restype = ctypes.c_void_p
+    lib.gdf_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.gdf_info.argtypes = [ctypes.c_void_p] + [
+        ctypes.POINTER(ctypes.c_int64)] * 2 + [
+        ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_double)]
+    lib.gdf_labels.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                               ctypes.c_int64]
+    lib.gdf_signals.argtypes = [ctypes.c_void_p,
+                                np.ctypeslib.ndpointer(np.float32, flags="C")]
+    lib.gdf_events.argtypes = [ctypes.c_void_p] + [
+        np.ctypeslib.ndpointer(np.int64, flags="C")] * 3
+    lib.gdf_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    """True when the native library is loadable (building it if needed)."""
+    return _load() is not None
+
+
+def read_gdf(path: str | Path):
+    """Read a GDF file through the native parser -> :class:`GDFRecording`."""
+    from eegnetreplication_tpu.data.gdf import GDFRecording
+
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native GDF reader unavailable")
+
+    err = ctypes.create_string_buffer(256)
+    handle = lib.gdf_open(str(path).encode(), err, len(err))
+    if not handle:
+        raise ValueError(f"{path}: {err.value.decode(errors='replace')}")
+    try:
+        n_ch = ctypes.c_int64()
+        n_samp = ctypes.c_int64()
+        sfreq = ctypes.c_double()
+        n_ev = ctypes.c_int64()
+        version = ctypes.c_double()
+        lib.gdf_info(handle, ctypes.byref(n_ch), ctypes.byref(n_samp),
+                     ctypes.byref(sfreq), ctypes.byref(n_ev),
+                     ctypes.byref(version))
+
+        stride = 17
+        label_buf = ctypes.create_string_buffer(stride * n_ch.value)
+        lib.gdf_labels(handle, label_buf, stride)
+        labels = [
+            label_buf.raw[i * stride:(i + 1) * stride].split(b"\x00")[0]
+            .decode(errors="replace")
+            for i in range(n_ch.value)
+        ]
+
+        signals = np.empty((n_ch.value, n_samp.value), dtype=np.float32)
+        lib.gdf_signals(handle, signals)
+
+        pos = np.empty(n_ev.value, dtype=np.int64)
+        typ = np.empty(n_ev.value, dtype=np.int64)
+        dur = np.empty(n_ev.value, dtype=np.int64)
+        if n_ev.value:
+            lib.gdf_events(handle, pos, typ, dur)
+
+        return GDFRecording(signals=signals, sfreq=float(sfreq.value),
+                            labels=labels, event_pos=pos, event_typ=typ,
+                            event_durations=dur, version=float(version.value))
+    finally:
+        lib.gdf_close(handle)

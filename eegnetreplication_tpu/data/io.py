@@ -58,6 +58,15 @@ def load_subject_dataset(subject: int | str = "all", mode: str = "Train",
         logger.info("Loading %d processed trial files from %s", len(files), root)
         return concat_datasets([load_trials(f) for f in files])
 
+    # Native continuous bundles: epoch on the fly.
+    if list(root.glob("*-preprocessed.npz")):
+        from eegnetreplication_tpu.data.epoching import (
+            build_dataset_from_preprocessed,
+        )
+
+        return build_dataset_from_preprocessed(subject=subject, mode=mode,
+                                               paths=paths)
+
     # Reference-layout fallback: epoch .fif files (requires MNE).
     if list(root.glob("*-preprocessed.fif")):
         from eegnetreplication_tpu.data.epoching import build_dataset_from_fif_dir
@@ -68,5 +77,5 @@ def load_subject_dataset(subject: int | str = "all", mode: str = "Train",
     raise FileNotFoundError(
         f"No processed trials found in {root} for subject {subject!r}. "
         f"Run `python -m eegnetreplication_tpu.dataset` first (or place "
-        f"*-trials.npz / *-preprocessed.fif files there)."
+        f"*-trials.npz / *-preprocessed.{{npz,fif}} files there)."
     )

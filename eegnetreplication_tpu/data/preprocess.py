@@ -1,0 +1,130 @@
+"""Raw-recording preprocessing: GDF -> standardized 22-channel 128 Hz arrays.
+
+Functional twin of the reference's ``preprocess_raw_data``
+(``src/eegnet_repl/dataset.py:72-130``), MNE-free and fused on device: the
+reference chains MNE host calls (rename channels -> set types -> montage ->
+drop EOG -> resample 128 Hz -> 4-38 Hz firwin bandpass -> python-loop EMS) and
+saves a ``.fif`` per recording; here channel selection is an array slice
+(channel names are positional metadata, ``dataset.py:89-96``), the DSP chain
+(FFT resample -> zero-phase FIR -> EMS scan) runs as JAX ops in one
+compilation, and the result is saved as a ``-preprocessed.npz`` bundle of
+plain arrays.
+
+The montage step has no array-level effect (it attaches sensor coordinates
+used only by topomap plots; our viz layer carries its own standard-1020
+coordinate table) and therefore has no counterpart here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+
+from eegnetreplication_tpu.config import (
+    BANDPASS_HIGH_HZ,
+    BANDPASS_LOW_HZ,
+    EEG_CHANNEL_NAMES,
+    N_EEG_CHANNELS,
+    TARGET_SFREQ,
+)
+from eegnetreplication_tpu.data.gdf import GDFRecording, read_gdf
+from eegnetreplication_tpu.ops.dsp import fir_bandpass, mne_style_bandpass_design, resample_fft
+from eegnetreplication_tpu.ops.ems import exponential_moving_standardize
+from eegnetreplication_tpu.utils.logging import logger
+
+
+@dataclass
+class ProcessedRecording:
+    """A preprocessed continuous recording plus its (resampled) events."""
+
+    data: np.ndarray        # (22, T') float32, standardized, 128 Hz
+    sfreq: float
+    labels: list[str]
+    event_pos: np.ndarray   # (n_events,) int64, samples at the NEW rate
+    event_typ: np.ndarray   # (n_events,) int64 GDF event codes
+
+    def save(self, path: str | Path) -> Path:
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        np.savez_compressed(path, data=self.data.astype(np.float32),
+                            sfreq=np.float64(self.sfreq),
+                            labels=np.array(self.labels),
+                            event_pos=self.event_pos.astype(np.int64),
+                            event_typ=self.event_typ.astype(np.int64))
+        return path
+
+    @staticmethod
+    def load(path: str | Path) -> "ProcessedRecording":
+        with np.load(Path(path)) as z:
+            return ProcessedRecording(
+                data=z["data"], sfreq=float(z["sfreq"]),
+                labels=[str(s) for s in z["labels"]],
+                event_pos=z["event_pos"], event_typ=z["event_typ"],
+            )
+
+
+def preprocess_recording(rec: GDFRecording,
+                         target_sfreq: float = TARGET_SFREQ,
+                         l_freq: float = BANDPASS_LOW_HZ,
+                         h_freq: float = BANDPASS_HIGH_HZ,
+                         ems_factor_new: float = 1e-3,
+                         ems_init_block_size: int = 1000) -> ProcessedRecording:
+    """Run the full preprocessing chain on one recording.
+
+    Stages (matching ``dataset.py:85-124`` semantically):
+    1. keep the first 22 channels — the EEG block of the BCI-IV-2a layout;
+       the trailing 3 are EOG (``dataset.py:89-111``);
+    2. zero out non-finite samples (the competition GDFs mark artifact spans
+       with NaN; the reference inherits MNE's passthrough, which would smear
+       NaN through FFT stages — we make the policy explicit);
+    3. FFT resample to 128 Hz (``dataset.py:114``);
+    4. zero-phase 4-38 Hz FIR bandpass, MNE-style design (``dataset.py:117``);
+    5. exponential moving standardization (``dataset.py:121-124``).
+
+    Event positions are rescaled to the new rate like MNE does on resample.
+    """
+    x = rec.signals[:N_EEG_CHANNELS]
+    n_bad = int(np.sum(~np.isfinite(x)))
+    if n_bad:
+        logger.info("Zeroing %d non-finite samples (%.3f%%)", n_bad,
+                    100.0 * n_bad / x.size)
+        x = np.where(np.isfinite(x), x, 0.0).astype(np.float32)
+
+    num = int(round(x.shape[1] * target_sfreq / rec.sfreq))
+    kernel = mne_style_bandpass_design(target_sfreq, l_freq, h_freq)
+
+    xj = resample_fft(jnp.asarray(x, jnp.float32), num)
+    xj = fir_bandpass(xj, target_sfreq, l_freq, h_freq, kernel=kernel)
+    xj = exponential_moving_standardize(
+        xj, factor_new=ems_factor_new, init_block_size=ems_init_block_size)
+    out = np.asarray(xj, dtype=np.float32)
+
+    scale = target_sfreq / rec.sfreq
+    new_pos = np.round(rec.event_pos * scale).astype(np.int64)
+    return ProcessedRecording(
+        data=out, sfreq=float(target_sfreq),
+        labels=list(EEG_CHANNEL_NAMES)[:N_EEG_CHANNELS],
+        event_pos=new_pos, event_typ=rec.event_typ.astype(np.int64),
+    )
+
+
+def preprocess_raw_data(src_path: str | Path, dest_path: str | Path) -> list[Path]:
+    """Preprocess every ``.gdf`` under ``src_path`` into ``dest_path``.
+
+    Directory-level twin of ``preprocess_raw_data`` (``dataset.py:72-130``);
+    writes ``<stem>-preprocessed.npz`` per recording and returns the paths.
+    """
+    src_path, dest_path = Path(src_path), Path(dest_path)
+    logger.info("Preprocessing raw data from %s to %s", src_path, dest_path)
+    written = []
+    for file in sorted(src_path.glob("*.gdf")):
+        rec = read_gdf(file)
+        processed = preprocess_recording(rec)
+        out_file = dest_path / (file.stem + "-preprocessed.npz")
+        processed.save(out_file)
+        logger.info("Saved preprocessed file to %s", out_file)
+        written.append(out_file)
+    return written

@@ -1,0 +1,75 @@
+"""Dataset CLI: ``python -m eegnetreplication_tpu.dataset``.
+
+Flag-compatible with the reference CLI (``src/eegnet_repl/dataset.py:334-363``):
+``--src kaggle|moabb`` selects the raw source; the kaggle path preprocesses
+``data/raw/{Train,Eval}/*.gdf`` into ``data/processed/{Train,Eval}``.
+
+Two artifacts per recording, both plain ``.npz``:
+- ``A01T-preprocessed.npz`` — the continuous standardized 22ch/128 Hz signal
+  plus events (the reference's ``.fif`` boundary, component 9);
+- ``A01T-trials.npz`` — the epoched ``(n, 22, 257)`` trials + labels, written
+  eagerly so training never re-epochs (the reference re-epochs on every run,
+  ``dataset.py:239-281``).
+
+The moabb path is stubbed: it is broken in the reference too (quirk Q3 —
+``Paths.data_moabb_processed`` missing, README calls it "Non-functional").
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from eegnetreplication_tpu.config import Paths
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def build_processed_tree(paths: Paths | None = None) -> None:
+    """Preprocess + epoch both splits of the kaggle GDF layout."""
+    from eegnetreplication_tpu.data.epoching import break_recording_into_epochs
+    from eegnetreplication_tpu.data.io import save_trials, trials_filename
+    from eegnetreplication_tpu.data.preprocess import preprocess_raw_data
+    from eegnetreplication_tpu.data.containers import BCICI2ADataset
+
+    paths = paths or Paths.from_here()
+    for mode in ("Train", "Eval"):
+        out_dir = paths.data_processed / mode
+        out_dir.mkdir(parents=True, exist_ok=True)
+        written = preprocess_raw_data(paths.data_raw / mode, out_dir)
+        for npz in written:
+            X, y = break_recording_into_epochs(npz, mode=mode, paths=paths)
+            stem = npz.name[:4]  # A01T
+            subject = int(stem[1:3])
+            save_trials(BCICI2ADataset(X=X, y=y),
+                        out_dir / trials_filename(subject, mode))
+            logger.info("Epoched %s: %d trials", stem, len(y))
+
+
+def main() -> None:
+    """CLI entrypoint (flags as in ``dataset.py:334-338``)."""
+    from eegnetreplication_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
+    parser = argparse.ArgumentParser(
+        description="Preprocess BCI Competition IV Dataset 2a from source.")
+    parser.add_argument("--src", default="kaggle",
+                        help="Specify source (options: kaggle, moabb).")
+    args = parser.parse_args()
+
+    if args.src not in ("kaggle", "moabb"):
+        logger.error("Unknown source specified: %s", args.src)
+        raise ValueError(f"Unknown source: {args.src}")
+
+    logger.info("Preprocessing data from source: %s", args.src)
+    if args.src == "kaggle":
+        build_processed_tree()
+    else:
+        # Quirk Q3: the reference's moabb path references a Paths attribute
+        # that doesn't exist and its README flags moabb "Non-functional".
+        raise NotImplementedError(
+            "The moabb preprocessing path is non-functional in the reference "
+            "(README.md:29) and is not implemented here; use --src kaggle."
+        )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,104 @@
+"""Signal-processing ops for the preprocessing front-end, as JAX kernels.
+
+The reference delegates its DSP to MNE on the host: FFT resampling 250->128 Hz
+(``src/eegnet_repl/dataset.py:114``) and a 4-38 Hz zero-phase firwin bandpass
+(``dataset.py:117``).  Here the same two stages are accelerator-friendly JAX
+ops — FFT resampling via spectrum truncation and FIR filtering via
+frequency-domain convolution — so the whole preprocessing chain
+(resample -> bandpass -> EMS) runs fused on device.
+
+Filter design follows MNE's defaults so outputs are comparable (not
+bit-identical — MNE pads/windows slightly differently):
+
+- transition bandwidths: ``l_trans = min(max(0.25*l, 2), l)``,
+  ``h_trans = min(max(0.25*h, 2), nyq - h)``;
+- hamming-window design, length ``ceil(3.3 * sfreq / min(l_trans, h_trans))``
+  rounded up to odd (zero-phase type-I FIR);
+- amplitude spec 0 below ``l - l_trans``, 1 in ``[l, h]``, 0 above
+  ``h + h_trans`` (linear ramps between), like MNE's ``construct_fir_filter``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mne_style_bandpass_design(sfreq: float, l_freq: float, h_freq: float) -> np.ndarray:
+    """Design the bandpass FIR kernel (host-side, numpy; returns (n_taps,)).
+
+    Mirrors MNE's "auto" firwin design used by ``raw.filter(4., 38.,
+    fir_design='firwin')`` (``dataset.py:117``).
+    """
+    from scipy.signal import firwin2
+
+    nyq = sfreq / 2.0
+    l_trans = min(max(0.25 * l_freq, 2.0), l_freq)
+    h_trans = min(max(0.25 * h_freq, 2.0), nyq - h_freq)
+    n_taps = int(math.ceil(3.3 * sfreq / min(l_trans, h_trans)))
+    n_taps += 1 - n_taps % 2  # odd length -> symmetric, zero-phase capable
+
+    freq = [0.0, l_freq - l_trans, l_freq, h_freq, h_freq + h_trans, nyq]
+    gain = [0.0, 0.0, 1.0, 1.0, 0.0, 0.0]
+    return firwin2(n_taps, freq, gain, fs=sfreq, window="hamming").astype(
+        np.float32
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num",))
+def resample_fft(x: jnp.ndarray, num: int) -> jnp.ndarray:
+    """FFT-domain resampling of ``x (..., T)`` to ``num`` samples.
+
+    Spectrum truncation/zero-padding (the method behind MNE's
+    ``raw.resample``): keep the lowest ``num`` frequency bins and scale by
+    ``num/T``.  Exact for band-limited signals; downsampling implicitly
+    low-passes at the new Nyquist.
+    """
+    t = x.shape[-1]
+    spectrum = jnp.fft.rfft(x, axis=-1)
+    n_keep = num // 2 + 1
+    if n_keep <= spectrum.shape[-1]:
+        spectrum = spectrum[..., :n_keep]
+        # A real even-length target has an unpaired Nyquist bin; fold the
+        # discarded conjugate half's energy (2x the real part) like
+        # scipy.signal.resample.
+        if num % 2 == 0 and num < t:
+            spectrum = spectrum.at[..., -1].set(2.0 * spectrum[..., -1].real)
+    else:
+        # Upsampling: a real even-length *source* has an unpaired Nyquist bin
+        # whose energy must be split before zero-padding (scipy semantics).
+        if t % 2 == 0:
+            spectrum = spectrum.at[..., -1].set(0.5 * spectrum[..., -1])
+        pad = [(0, 0)] * (spectrum.ndim - 1) + [(0, n_keep - spectrum.shape[-1])]
+        spectrum = jnp.pad(spectrum, pad)
+    return jnp.fft.irfft(spectrum, n=num, axis=-1) * (num / t)
+
+
+@functools.partial(jax.jit, static_argnames=("n_taps",))
+def _fir_zero_phase(x: jnp.ndarray, kernel: jnp.ndarray, n_taps: int) -> jnp.ndarray:
+    """Zero-phase FIR via frequency-domain convolution with edge reflection.
+
+    ``kernel`` is odd-length symmetric; reflect-pad by half the kernel on both
+    sides (MNE's default edge handling), convolve via FFT, take the valid
+    center so the linear-phase delay cancels.
+    """
+    half = n_taps // 2
+    pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+    xp = jnp.pad(x, pad, mode="reflect")
+    n = xp.shape[-1] + n_taps - 1
+    nfft = 1 << max(1, (n - 1)).bit_length()  # next power of two
+    spec = jnp.fft.rfft(xp, n=nfft, axis=-1) * jnp.fft.rfft(kernel, n=nfft)
+    full = jnp.fft.irfft(spec, n=nfft, axis=-1)[..., :n]
+    return full[..., n_taps - 1: n_taps - 1 + x.shape[-1]]
+
+
+def fir_bandpass(x: jnp.ndarray, sfreq: float, l_freq: float = 4.0,
+                 h_freq: float = 38.0, kernel: np.ndarray | None = None) -> jnp.ndarray:
+    """Zero-phase bandpass of ``x (..., T)`` with the MNE-style design."""
+    if kernel is None:
+        kernel = mne_style_bandpass_design(sfreq, l_freq, h_freq)
+    return _fir_zero_phase(x, jnp.asarray(kernel, x.dtype), len(kernel))

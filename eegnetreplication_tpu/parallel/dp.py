@@ -67,12 +67,15 @@ def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
         grads = jax.lax.psum(grads, axis_name=data_axis)
         loss = jax.lax.psum(loss, axis_name=data_axis)
 
+        # Per-architecture max-norm limits, same rule as the single-device
+        # step (steps.py): only models that declare limits get them.
+        limits = getattr(model, "MAXNORM_LIMITS", {})
         if maxnorm_mode == "reference":
-            grads = clamp_reference_maxnorm(grads)
+            grads = clamp_reference_maxnorm(grads, limits)
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         if maxnorm_mode == "paper":
-            new_params = project_paper_maxnorm(new_params)
+            new_params = project_paper_maxnorm(new_params, limits)
 
         return TrainState(params=new_params, batch_stats=new_bs,
                           opt_state=new_opt_state), loss

@@ -51,11 +51,16 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["reference", "paper"],
                         help="Max-norm behaviour: reference grad-clamp (Q1) "
                              "or true paper weight projection.")
+    parser.add_argument("--subjects", type=str, default=None,
+                        help="Comma-separated subject ids (default: 1-9).")
     return parser
 
 
 def main() -> None:
     """CLI entrypoint."""
+    from eegnetreplication_tpu.utils.platform import apply_platform_override
+
+    apply_platform_override()
     args = build_parser().parse_args()
 
     from eegnetreplication_tpu.parallel import make_mesh
@@ -69,6 +74,18 @@ def main() -> None:
     )
 
     config = DEFAULT_TRAINING.replace(maxnorm_mode=args.maxnormMode)
+    subjects = (tuple(int(s) for s in args.subjects.split(","))
+                if args.subjects else tuple(range(1, 10)))
+    if args.trainingType != "Within-Subject":
+        # Each cross-subject fold needs cs_train_subjects train + >=1 val
+        # + 1 held-out test subject (train.py:199-202).
+        min_needed = config.cs_train_subjects + 2
+        if len(subjects) < min_needed:
+            raise SystemExit(
+                f"Cross-Subject training needs at least {min_needed} "
+                f"subjects ({config.cs_train_subjects} train + 1 val + 1 "
+                f"test); got {len(subjects)}."
+            )
     mesh = None
     import jax
 
@@ -80,7 +97,8 @@ def main() -> None:
         logger.info("Training Within-Subject models for all subjects...")
         result = within_subject_training(epochs=args.epochs, config=config,
                                          seed=args.seed, mesh=mesh,
-                                         model_name=args.model)
+                                         model_name=args.model,
+                                         subjects=subjects)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         if args.generateReport:
@@ -92,7 +110,8 @@ def main() -> None:
         logger.info("Training Cross-Subject model...")
         result = cross_subject_training(epochs=args.epochs, config=config,
                                         seed=args.seed, mesh=mesh,
-                                        model_name=args.model)
+                                        model_name=args.model,
+                                        subjects=subjects)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         if args.generateReport:

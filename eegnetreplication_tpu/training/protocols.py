@@ -240,6 +240,13 @@ def cross_subject_training(epochs: int | None = None, *,
     epochs = epochs if epochs is not None else config.epochs
     paths = paths or Paths.from_here()
     n_subjects = len(subjects)
+    if n_subjects < config.cs_train_subjects + 2:
+        raise ValueError(
+            f"Cross-subject training needs at least "
+            f"{config.cs_train_subjects + 2} subjects "
+            f"({config.cs_train_subjects} train + 1 val + 1 test); "
+            f"got {n_subjects}."
+        )
 
     logger.info("Loading data for all subjects...")
     train_sets = [loader(s, "Train") for s in subjects]

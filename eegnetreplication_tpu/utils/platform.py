@@ -1,0 +1,22 @@
+"""Runtime platform selection.
+
+This environment's site startup pins ``jax_platforms`` (e.g. to a tunneled
+TPU backend), which both overrides the standard ``JAX_PLATFORMS`` env var and
+can fail to initialize outside the install tree.  ``apply_platform_override``
+lets ``EEGTPU_PLATFORM`` (e.g. ``cpu``, ``tpu``) win, provided it runs before
+the first JAX backend initialization — CLI entry points call it first thing.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def apply_platform_override() -> str | None:
+    """Honor ``EEGTPU_PLATFORM`` if set; returns the applied platform."""
+    platform = os.environ.get("EEGTPU_PLATFORM")
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    return platform or None

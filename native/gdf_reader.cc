@@ -1,0 +1,308 @@
+// Native GDF reader: the C++ fast path behind eegnetreplication_tpu.data.gdf.
+//
+// The reference's ingest is MNE's Python GDF parser (it reads each BCI-IV-2a
+// recording through mne.io.read_raw_gdf, src/eegnet_repl/dataset.py:86);
+// this library parses the same format (GDF v1.x / v2.x, per the GDF spec and
+// the BioSig reference implementation) with a single pass over a memory
+// buffer, exposed through a C ABI consumed via ctypes
+// (eegnetreplication_tpu/data/gdf_native.py).  The Python implementation in
+// data/gdf.py documents the layout; the two are cross-checked in
+// tests/test_native_gdf.py.
+//
+// Build: make -C native   (produces build/libeegtpu_gdf.so)
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <cstdio>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct GdfFile {
+  int64_t n_channels = 0;
+  int64_t n_samples = 0;
+  double sfreq = 0.0;
+  double version = 0.0;
+  std::vector<std::string> labels;
+  std::vector<float> signals;      // (n_channels * n_samples) row-major
+  std::vector<int64_t> event_pos;  // 0-based samples
+  std::vector<int64_t> event_typ;
+  std::vector<int64_t> event_dur;
+};
+
+template <typename T>
+T read_le(const uint8_t* p) {
+  T v;
+  std::memcpy(&v, p, sizeof(T));
+  return v;  // target platforms are little-endian (x86_64 / TPU hosts)
+}
+
+// Per-channel sample decoder: GDFTYP -> double.
+double decode_sample(const uint8_t* p, uint32_t gdftyp) {
+  switch (gdftyp) {
+    case 1: return static_cast<double>(read_le<int8_t>(p));
+    case 2: return static_cast<double>(read_le<uint8_t>(p));
+    case 3: return static_cast<double>(read_le<int16_t>(p));
+    case 4: return static_cast<double>(read_le<uint16_t>(p));
+    case 5: return static_cast<double>(read_le<int32_t>(p));
+    case 6: return static_cast<double>(read_le<uint32_t>(p));
+    case 7: return static_cast<double>(read_le<int64_t>(p));
+    case 8: return static_cast<double>(read_le<uint64_t>(p));
+    case 16: return static_cast<double>(read_le<float>(p));
+    case 17: return read_le<double>(p);
+    default: return std::nan("");
+  }
+}
+
+size_t gdftyp_size(uint32_t t) {
+  switch (t) {
+    case 1: case 2: return 1;
+    case 3: case 4: return 2;
+    case 5: case 6: case 16: return 4;
+    case 7: case 8: case 17: return 8;
+    default: return 0;
+  }
+}
+
+bool fail(char* err, int errlen, const std::string& msg) {
+  if (err && errlen > 0) {
+    std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+  return false;
+}
+
+bool parse(const uint8_t* data, size_t size, GdfFile* out, char* err,
+           int errlen) {
+  if (size < 256) return fail(err, errlen, "truncated GDF file");
+  if (std::memcmp(data, "GDF", 3) != 0) return fail(err, errlen, "not a GDF file");
+
+  char ver_buf[6] = {0};
+  std::memcpy(ver_buf, data + 4, 4);
+  double version = std::atof(ver_buf);
+  if (version <= 0.0) return fail(err, errlen, "unparsable GDF version");
+
+  int64_t header_len;
+  if (version >= 1.9) {
+    header_len = static_cast<int64_t>(read_le<uint16_t>(data + 184)) * 256;
+  } else {
+    header_len = read_le<int64_t>(data + 184);
+  }
+  const int64_t n_records = read_le<int64_t>(data + 236);
+  const uint32_t dur_num = read_le<uint32_t>(data + 244);
+  const uint32_t dur_den = read_le<uint32_t>(data + 248);
+  const uint16_t n_channels = read_le<uint16_t>(data + 252);
+  if (n_records < 0) return fail(err, errlen, "unknown record count");
+  if (header_len < 256 + 256 * static_cast<int64_t>(n_channels) ||
+      static_cast<size_t>(header_len) > size) {
+    return fail(err, errlen, "bad header length");
+  }
+  const double record_dur = dur_den ? static_cast<double>(dur_num) / dur_den : 1.0;
+
+  // Channel headers are field-major: all labels, then all transducers, ...
+  const uint8_t* ch = data + 256;
+  size_t off = 0;
+  auto block = [&](size_t per_ch) {
+    const uint8_t* p = ch + off;
+    off += per_ch * n_channels;
+    return p;
+  };
+
+  const uint8_t* labels_p = block(16);
+  block(80);  // transducer
+  const uint8_t *physmin_p, *physmax_p, *digmin_p, *digmax_p;
+  bool dig_is_int = false;
+  if (version >= 1.9) {
+    block(6);   // physical dimension (obsolete)
+    block(2);   // physical dimension code
+    physmin_p = block(8);
+    physmax_p = block(8);
+    digmin_p = block(8);
+    digmax_p = block(8);
+    block(68);  // prefilter text
+    block(4); block(4); block(4);  // lowpass / highpass / notch
+  } else {
+    block(8);   // physical dimension text
+    physmin_p = block(8);
+    physmax_p = block(8);
+    digmin_p = block(8);   // int64 in v1
+    digmax_p = block(8);
+    dig_is_int = true;
+    block(80);  // prefilter text
+  }
+  const uint8_t* spr_p = block(4);
+  const uint8_t* typ_p = block(4);
+
+  std::vector<uint32_t> spr(n_channels), gdftyp(n_channels);
+  std::vector<double> gain(n_channels), offset(n_channels);
+  std::vector<size_t> samp_size(n_channels);
+  for (int c = 0; c < n_channels; ++c) {
+    spr[c] = read_le<uint32_t>(spr_p + 4 * c);
+    gdftyp[c] = read_le<uint32_t>(typ_p + 4 * c);
+    samp_size[c] = gdftyp_size(gdftyp[c]);
+    if (samp_size[c] == 0) {
+      return fail(err, errlen, "unsupported GDFTYP " + std::to_string(gdftyp[c]));
+    }
+    const double pmin = read_le<double>(physmin_p + 8 * c);
+    const double pmax = read_le<double>(physmax_p + 8 * c);
+    const double dmin = dig_is_int
+        ? static_cast<double>(read_le<int64_t>(digmin_p + 8 * c))
+        : read_le<double>(digmin_p + 8 * c);
+    const double dmax = dig_is_int
+        ? static_cast<double>(read_le<int64_t>(digmax_p + 8 * c))
+        : read_le<double>(digmax_p + 8 * c);
+    const double denom = dmax - dmin;
+    gain[c] = denom != 0.0 ? (pmax - pmin) / denom : 1.0;
+    offset[c] = pmin - gain[c] * dmin;
+    if (spr[c] != spr[0]) {
+      return fail(err, errlen, "mixed samples-per-record not supported");
+    }
+  }
+  const uint32_t spr0 = n_channels ? spr[0] : 0;
+
+  size_t record_bytes = 0;
+  std::vector<size_t> ch_offset(n_channels);
+  for (int c = 0; c < n_channels; ++c) {
+    ch_offset[c] = record_bytes;
+    record_bytes += samp_size[c] * spr0;
+  }
+  const size_t data_bytes = record_bytes * static_cast<size_t>(n_records);
+  if (static_cast<size_t>(header_len) + data_bytes > size) {
+    return fail(err, errlen, "truncated data section");
+  }
+
+  out->n_channels = n_channels;
+  out->n_samples = static_cast<int64_t>(n_records) * spr0;
+  out->sfreq = record_dur > 0 ? spr0 / record_dur : spr0;
+  out->version = version;
+  out->labels.resize(n_channels);
+  for (int c = 0; c < n_channels; ++c) {
+    const char* l = reinterpret_cast<const char*>(labels_p + 16 * c);
+    size_t n = strnlen(l, 16);
+    while (n > 0 && (l[n - 1] == ' ')) --n;
+    out->labels[c].assign(l, n);
+  }
+
+  out->signals.resize(static_cast<size_t>(n_channels) * out->n_samples);
+  const uint8_t* body = data + header_len;
+  for (int64_t r = 0; r < n_records; ++r) {
+    const uint8_t* rec = body + r * record_bytes;
+    for (int c = 0; c < n_channels; ++c) {
+      const uint8_t* src = rec + ch_offset[c];
+      float* dst = out->signals.data() +
+                   static_cast<size_t>(c) * out->n_samples + r * spr0;
+      const double g = gain[c], o = offset[c];
+      if (gdftyp[c] == 16 && g == 1.0 && o == 0.0) {
+        std::memcpy(dst, src, sizeof(float) * spr0);  // common fast path
+      } else {
+        const size_t ss = samp_size[c];
+        for (uint32_t s = 0; s < spr0; ++s) {
+          dst[s] = static_cast<float>(g * decode_sample(src + s * ss, gdftyp[c]) + o);
+        }
+      }
+    }
+  }
+
+  // Event table (optional), after the data records.
+  const size_t ev_start = header_len + data_bytes;
+  if (ev_start + 8 <= size) {
+    const uint8_t* ev = data + ev_start;
+    const uint8_t mode = ev[0];
+    size_t n_events;
+    if (version >= 1.9) {
+      n_events = ev[1] | (ev[2] << 8) | (static_cast<size_t>(ev[3]) << 16);
+    } else {
+      n_events = read_le<uint32_t>(ev + 4);
+    }
+    size_t cursor = 8;
+    if (ev_start + cursor + 6 * n_events <= size) {
+      out->event_pos.resize(n_events);
+      out->event_typ.resize(n_events);
+      out->event_dur.assign(n_events, 0);
+      for (size_t i = 0; i < n_events; ++i) {
+        // GDF positions are 1-based sample indices.
+        out->event_pos[i] =
+            static_cast<int64_t>(read_le<uint32_t>(ev + cursor + 4 * i)) - 1;
+      }
+      cursor += 4 * n_events;
+      for (size_t i = 0; i < n_events; ++i) {
+        out->event_typ[i] = read_le<uint16_t>(ev + cursor + 2 * i);
+      }
+      cursor += 2 * n_events;
+      if (mode == 3 && ev_start + cursor + 6 * n_events <= size) {
+        cursor += 2 * n_events;  // per-event channel numbers
+        for (size_t i = 0; i < n_events; ++i) {
+          out->event_dur[i] = read_le<uint32_t>(ev + cursor + 4 * i);
+        }
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse `path`; returns an opaque handle or nullptr (error text in `err`).
+void* gdf_open(const char* path, char* err, int errlen) {
+  std::FILE* f = std::fopen(path, "rb");
+  if (!f) {
+    fail(err, errlen, std::string("cannot open ") + path);
+    return nullptr;
+  }
+  std::fseek(f, 0, SEEK_END);
+  const long fsize = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  std::vector<uint8_t> buf(static_cast<size_t>(fsize));
+  const size_t got = std::fread(buf.data(), 1, buf.size(), f);
+  std::fclose(f);
+  if (got != buf.size()) {
+    fail(err, errlen, "short read");
+    return nullptr;
+  }
+  auto* g = new GdfFile();
+  if (!parse(buf.data(), buf.size(), g, err, errlen)) {
+    delete g;
+    return nullptr;
+  }
+  return g;
+}
+
+void gdf_info(void* h, int64_t* n_channels, int64_t* n_samples, double* sfreq,
+              int64_t* n_events, double* version) {
+  auto* g = static_cast<GdfFile*>(h);
+  *n_channels = g->n_channels;
+  *n_samples = g->n_samples;
+  *sfreq = g->sfreq;
+  *n_events = static_cast<int64_t>(g->event_pos.size());
+  *version = g->version;
+}
+
+// Copy labels into `out`, one `stride`-byte NUL-terminated slot per channel.
+void gdf_labels(void* h, char* out, int64_t stride) {
+  auto* g = static_cast<GdfFile*>(h);
+  for (int64_t c = 0; c < g->n_channels; ++c) {
+    std::snprintf(out + c * stride, static_cast<size_t>(stride), "%s",
+                  g->labels[static_cast<size_t>(c)].c_str());
+  }
+}
+
+// Copy the calibrated (n_channels, n_samples) float32 signal block.
+void gdf_signals(void* h, float* out) {
+  auto* g = static_cast<GdfFile*>(h);
+  std::memcpy(out, g->signals.data(), g->signals.size() * sizeof(float));
+}
+
+void gdf_events(void* h, int64_t* pos, int64_t* typ, int64_t* dur) {
+  auto* g = static_cast<GdfFile*>(h);
+  const size_t n = g->event_pos.size();
+  std::memcpy(pos, g->event_pos.data(), n * sizeof(int64_t));
+  std::memcpy(typ, g->event_typ.data(), n * sizeof(int64_t));
+  std::memcpy(dur, g->event_dur.data(), n * sizeof(int64_t));
+}
+
+void gdf_close(void* h) { delete static_cast<GdfFile*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,248 @@
+"""Tests for the native data pipeline: GDF reader, DSP ops, epoching, CLI.
+
+The reference has no tests for its data-acquisition path at all (SURVEY.md
+§4); these cover the framework's native replacements end to end on synthetic
+GDF files (no real data, no network).
+"""
+
+import shutil
+import tempfile
+import unittest
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.config import Paths
+from eegnetreplication_tpu.data.epoching import (
+    CUE_UNKNOWN,
+    TRAIN_CUE_TO_CLASS,
+    extract_epochs,
+    load_true_labels,
+    map_labels,
+)
+from eegnetreplication_tpu.data.gdf import GDFRecording, read_gdf_python, write_gdf
+from eegnetreplication_tpu.data.preprocess import (
+    ProcessedRecording,
+    preprocess_recording,
+)
+from eegnetreplication_tpu.ops.dsp import (
+    fir_bandpass,
+    mne_style_bandpass_design,
+    resample_fft,
+)
+
+
+class TestGDFReader(unittest.TestCase):
+    def _roundtrip(self, version):
+        rng = np.random.RandomState(7)
+        sig = rng.uniform(-0.9, 0.9, (25, 250 * 6)).astype(np.float32)
+        pos = np.array([100, 500, 900, 1300])
+        typ = np.array([768, 769, 772, 1023])
+        with tempfile.TemporaryDirectory() as d:
+            p = write_gdf(Path(d) / "A01T.gdf", sig, 250.0, event_pos=pos,
+                          event_typ=typ, version=version)
+            rec = read_gdf_python(p)
+        self.assertEqual(rec.signals.shape, (25, 1500))
+        np.testing.assert_allclose(rec.signals, sig, atol=1e-6)
+        np.testing.assert_array_equal(rec.event_pos, pos)
+        np.testing.assert_array_equal(rec.event_typ, typ)
+        self.assertEqual(rec.sfreq, 250.0)
+
+    def test_roundtrip_v2(self):
+        self._roundtrip("2.20")
+
+    def test_roundtrip_v1(self):
+        self._roundtrip("1.25")
+
+    def test_rejects_non_gdf(self):
+        with tempfile.TemporaryDirectory() as d:
+            p = Path(d) / "junk.gdf"
+            p.write_bytes(b"\x00" * 512)
+            with self.assertRaises(ValueError):
+                read_gdf_python(p)
+
+
+class TestDSP(unittest.TestCase):
+    def test_resample_preserves_tone(self):
+        t = np.arange(0, 8, 1 / 250.0)
+        sig = np.sin(2 * np.pi * 10 * t).astype(np.float32)
+        num = int(round(len(sig) * 128 / 250))
+        out = np.asarray(resample_fft(sig, num))
+        t2 = np.arange(num) / 128.0
+        ref = np.sin(2 * np.pi * 10 * t2)
+        self.assertLess(np.abs(out[64:-64] - ref[64:-64]).max(), 1e-3)
+
+    def test_resample_matches_scipy(self):
+        from scipy.signal import resample as scipy_resample
+
+        rng = np.random.RandomState(5)
+        x = rng.randn(3, 1000).astype(np.float32)
+        for num in (512, 513, 2000, 2001):  # down/up, even/odd targets
+            ours = np.asarray(resample_fft(x, num))
+            ref = scipy_resample(x.astype(np.float64), num, axis=-1)
+            np.testing.assert_allclose(ours, ref, atol=1e-4)
+
+    def test_bandpass_design_matches_mne_length(self):
+        # MNE's auto design at 128 Hz / 4-38 Hz: min trans bw 2 Hz ->
+        # ceil(3.3 * 128 / 2) = 212 -> odd 213 taps.
+        k = mne_style_bandpass_design(128.0, 4.0, 38.0)
+        self.assertEqual(len(k), 213)
+        self.assertAlmostEqual(float(np.sum(np.abs(k - k[::-1]))), 0.0,
+                               places=6)  # symmetric -> linear phase
+
+    def test_bandpass_frequency_response(self):
+        t = np.arange(0, 8, 1 / 128.0)
+        x = np.stack([np.sin(2 * np.pi * f * t) for f in (1.0, 20.0, 55.0)])
+        y = np.asarray(fir_bandpass(x.astype(np.float32), 128.0))
+        rms = np.sqrt((y[:, 150:-150] ** 2).mean(axis=1))
+        self.assertLess(rms[0], 0.02)           # 1 Hz: stopband
+        self.assertAlmostEqual(rms[1], 2 ** -0.5, delta=0.02)  # 20 Hz: pass
+        self.assertLess(rms[2], 0.02)           # 55 Hz: stopband
+
+    def test_bandpass_zero_phase(self):
+        # A passband tone must come out with (close to) zero delay.
+        t = np.arange(0, 8, 1 / 128.0)
+        x = np.sin(2 * np.pi * 15 * t).astype(np.float32)
+        y = np.asarray(fir_bandpass(x, 128.0))
+        xc = np.correlate(y[200:-200], x[200:-200], "full")
+        lag = int(np.argmax(xc)) - (len(x[200:-200]) - 1)
+        self.assertEqual(lag, 0)
+
+
+class TestPreprocessRecording(unittest.TestCase):
+    def test_shapes_events_and_standardization(self):
+        rng = np.random.RandomState(3)
+        sfreq, secs = 250.0, 20
+        n = int(sfreq * secs)
+        sig = rng.randn(25, n).astype(np.float32)
+        sig[22:] += 50.0  # EOG channels: junk that must be dropped
+        sig[0, 1000:1010] = np.nan  # artifact span
+        rec = GDFRecording(signals=sig, sfreq=sfreq,
+                           labels=[f"c{i}" for i in range(25)],
+                           event_pos=np.array([500, 2500]),
+                           event_typ=np.array([769, 770]))
+        out = preprocess_recording(rec)
+        self.assertEqual(out.data.shape[0], 22)
+        self.assertEqual(out.data.shape[1], int(round(n * 128 / 250)))
+        self.assertTrue(np.all(np.isfinite(out.data)))
+        np.testing.assert_array_equal(
+            out.event_pos, np.round(rec.event_pos * 128 / 250).astype(int))
+        # EMS output is approximately standardized in the tail.
+        tail = out.data[:, -500:]
+        self.assertLess(np.abs(tail.mean()), 0.5)
+
+    def test_save_load_roundtrip(self):
+        pr = ProcessedRecording(
+            data=np.ones((22, 100), np.float32), sfreq=128.0,
+            labels=["a"] * 22, event_pos=np.array([5]),
+            event_typ=np.array([769]))
+        with tempfile.TemporaryDirectory() as d:
+            p = pr.save(Path(d) / "x-preprocessed.npz")
+            back = ProcessedRecording.load(p)
+        np.testing.assert_array_equal(back.data, pr.data)
+        self.assertEqual(back.sfreq, 128.0)
+        np.testing.assert_array_equal(back.event_typ, pr.event_typ)
+
+
+class TestEpoching(unittest.TestCase):
+    def test_map_labels_parity(self):
+        y = np.array([7, 8, 9, 10, 7])
+        out = map_labels(y, {7: 0, 8: 1, 9: 2, 10: 3})
+        np.testing.assert_array_equal(out, [0, 1, 2, 3, 0])
+        with self.assertRaises(RuntimeError):
+            map_labels(np.array([7, 99]), {7: 0})
+
+    def test_extract_epochs_train(self):
+        sfreq = 128.0
+        data = np.arange(22 * 2000, dtype=np.float32).reshape(22, 2000)
+        pos = np.array([100, 600, 1100, 1900])  # last one runs off the end
+        typ = np.array([769, 771, 772, 770])
+        X, y, kept = extract_epochs(data, sfreq, pos, typ, mode="Train")
+        self.assertEqual(X.shape, (3, 22, 257))
+        np.testing.assert_array_equal(y, [0, 2, 3])
+        np.testing.assert_array_equal(kept, [0, 1, 2])
+        # Window starts 64 samples (0.5 s) after the cue.
+        np.testing.assert_array_equal(X[0, 0], data[0, 164:164 + 257])
+
+    def test_extract_epochs_eval_selects_unknown_cues(self):
+        data = np.zeros((22, 3000), np.float32)
+        pos = np.array([100, 600, 1100])
+        typ = np.array([769, CUE_UNKNOWN, CUE_UNKNOWN])
+        X, y, kept = extract_epochs(data, 128.0, pos, typ, mode="Eval")
+        self.assertEqual(X.shape[0], 2)
+        np.testing.assert_array_equal(y, [0, 0])
+
+    def test_unknown_mode_raises(self):
+        with self.assertRaises(ValueError):
+            extract_epochs(np.zeros((1, 10), np.float32), 128.0,
+                           np.zeros(0, int), np.zeros(0, int), mode="Test")
+
+
+class TestEndToEndDatasetCLI(unittest.TestCase):
+    """Synthetic GDF tree -> CLI preprocessing -> loadable trials."""
+
+    def _make_raw_tree(self, root: Path, subjects=(1, 4)):
+        from scipy.io import savemat
+
+        rng = np.random.RandomState(0)
+        sfreq, secs = 250.0, 40
+        n = int(sfreq * secs)
+        n_trials = 8
+        for s in subjects:
+            for mode, code in (("Train", None), ("Eval", CUE_UNKNOWN)):
+                sig = rng.uniform(-0.5, 0.5, (25, n)).astype(np.float32)
+                pos = (np.arange(n_trials) * 1100 + 300).astype(np.int64)
+                if mode == "Train":
+                    typ = np.array([769, 770, 771, 772] * 2)
+                else:
+                    typ = np.full(n_trials, code)
+                sess = "T" if mode == "Train" else "E"
+                write_gdf(root / mode / f"A{s:02d}{sess}.gdf", sig, sfreq,
+                          event_pos=pos, event_typ=typ)
+                if mode == "Eval":
+                    labels = rng.randint(1, 5, n_trials)
+                    tl = root / "TrueLabels"
+                    tl.mkdir(parents=True, exist_ok=True)
+                    savemat(tl / f"A{s:02d}E.mat", {"classlabel": labels})
+
+    def test_build_processed_tree_and_load(self):
+        from eegnetreplication_tpu.data.io import load_subject_dataset
+        from eegnetreplication_tpu.dataset import build_processed_tree
+
+        tmp = Path(tempfile.mkdtemp())
+        try:
+            paths = Paths.from_root(tmp)
+            self._make_raw_tree(paths.data_raw)
+            build_processed_tree(paths)
+
+            for mode in ("Train", "Eval"):
+                d = load_subject_dataset(subject=1, mode=mode, paths=paths)
+                self.assertEqual(d.X.shape[1:], (22, 257))
+                self.assertEqual(len(d), 8)
+                self.assertTrue(set(np.unique(d.y)) <= {0, 1, 2, 3})
+            # Subject filter vs all.
+            all_train = load_subject_dataset(subject="all", mode="Train",
+                                             paths=paths)
+            self.assertEqual(len(all_train), 16)
+
+            # Eval labels come from the TrueLabels .mat files.
+            true = load_true_labels("A01E", paths)
+            d = load_subject_dataset(subject=1, mode="Eval", paths=paths)
+            np.testing.assert_array_equal(d.y, true)
+        finally:
+            shutil.rmtree(tmp)
+
+    def test_loader_errors_without_data(self):
+        from eegnetreplication_tpu.data.io import load_subject_dataset
+
+        tmp = Path(tempfile.mkdtemp())
+        try:
+            with self.assertRaises(FileNotFoundError):
+                load_subject_dataset(subject=1, mode="Train",
+                                     paths=Paths.from_root(tmp))
+        finally:
+            shutil.rmtree(tmp)
+
+
+if __name__ == "__main__":
+    unittest.main()

@@ -1,0 +1,65 @@
+"""Cross-check the C++ GDF reader against the pure-numpy implementation.
+
+The numpy reader (``data/gdf.py``) is the behavioral spec; the native library
+(``native/gdf_reader.cc``) must produce identical arrays for the same bytes.
+Skipped when no C++ toolchain is available to build the library.
+"""
+
+import tempfile
+import unittest
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.data import gdf_native
+from eegnetreplication_tpu.data.gdf import read_gdf, read_gdf_python, write_gdf
+
+HAVE_NATIVE = gdf_native.ensure_built()
+
+
+@unittest.skipUnless(HAVE_NATIVE, "native GDF library not buildable here")
+class TestNativeGDFParity(unittest.TestCase):
+    def _make(self, d, version, with_events=True):
+        rng = np.random.RandomState(11)
+        sig = rng.uniform(-0.99, 0.99, (25, 250 * 5)).astype(np.float32)
+        pos = np.array([10, 400, 900]) if with_events else None
+        typ = np.array([768, 769, 783]) if with_events else None
+        return write_gdf(Path(d) / f"x{version[0]}.gdf", sig, 250.0,
+                         labels=[f"EEG-{i}" for i in range(25)],
+                         event_pos=pos, event_typ=typ, version=version)
+
+    def test_parity_both_versions(self):
+        for version in ("2.20", "1.25"):
+            with tempfile.TemporaryDirectory() as d:
+                p = self._make(d, version)
+                py = read_gdf_python(p)
+                nat = gdf_native.read_gdf(p)
+            np.testing.assert_array_equal(nat.signals, py.signals)
+            np.testing.assert_array_equal(nat.event_pos, py.event_pos)
+            np.testing.assert_array_equal(nat.event_typ, py.event_typ)
+            self.assertEqual(nat.labels, py.labels)
+            self.assertEqual(nat.sfreq, py.sfreq)
+            self.assertEqual(nat.n_channels, py.n_channels)
+
+    def test_no_events(self):
+        with tempfile.TemporaryDirectory() as d:
+            p = self._make(d, "2.20", with_events=False)
+            nat = gdf_native.read_gdf(p)
+        self.assertEqual(len(nat.event_pos), 0)
+
+    def test_read_gdf_dispatches_to_native(self):
+        with tempfile.TemporaryDirectory() as d:
+            p = self._make(d, "2.20")
+            rec = read_gdf(p, prefer_native=True)
+        self.assertEqual(rec.signals.shape, (25, 1250))
+
+    def test_native_error_reporting(self):
+        with tempfile.TemporaryDirectory() as d:
+            bad = Path(d) / "bad.gdf"
+            bad.write_bytes(b"\x00" * 512)
+            with self.assertRaises(ValueError):
+                gdf_native.read_gdf(bad)
+
+
+if __name__ == "__main__":
+    unittest.main()
