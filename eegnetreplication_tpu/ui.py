@@ -1,0 +1,390 @@
+"""Tkinter GUI: training pipeline runner, log viewer, reports, model explorer.
+
+Shell twin of the reference's ``App`` (``src/eegnet_repl/ui.py:53-512``),
+preserving its key architectural property: the GUI never imports training
+code — every action launches the corresponding CLI module
+(``python -m eegnetreplication_tpu.{fetch,dataset,train}``) as a subprocess
+and streams its merged stdout/stderr into the Logs tab
+(``ui.py:213,229,256-259,271-293``).  The stages communicate only through
+files on disk, so the GUI works unchanged over any backend the CLIs use.
+
+Differences by design:
+- subprocess output lines are marshalled to the Tk main thread via
+  ``after()`` instead of mutating Tk widgets from worker threads (the
+  reference's ``ui.py:278-281`` is thread-unsafe under Tk);
+- the model explorer loads either checkpoint format (native ``.npz``
+  preferred, reference ``.pth`` fallback) through
+  :func:`eegnetreplication_tpu.viz.load_model_filters`.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import threading
+import tkinter as tk
+from pathlib import Path
+from tkinter import messagebox, scrolledtext, ttk
+from tkinter.ttk import Progressbar
+
+from eegnetreplication_tpu.config import Paths
+from eegnetreplication_tpu.utils.logging import logger
+from eegnetreplication_tpu.viz import (
+    load_model_filters,
+    plot_power_spectra_of_temporal_filters,
+    plot_spatial_filters,
+    plot_temporal_filters,
+)
+
+PKG = "eegnetreplication_tpu"
+
+
+def get_report(paths: Paths | None = None) -> dict:
+    """Load the most recent training reports (``ui.py:597-620``)."""
+    paths = paths or Paths.from_here()
+    reports = {}
+    for key in ("within_subject", "cross_subject"):
+        report_path = paths.reports / f"latest_{key}_report.json"
+        if report_path.exists():
+            try:
+                with open(report_path, "r", encoding="utf-8") as f:
+                    reports[key] = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                logger.error("Error loading %s report: %s", key, e)
+    return reports
+
+
+def get_model_path(model_type: str, subject: str,
+                   paths: Paths | None = None) -> Path:
+    """Resolve the checkpoint for a GUI selection; ``.npz`` wins over ``.pth``.
+
+    Filename convention matches the reference (``ui.py:503-512``).
+    """
+    paths = paths or Paths.from_here()
+    if model_type == "Within-Subject":
+        stem = f"subject_{subject}_best_model"
+    else:
+        stem = "cross_subject_best_model"
+    npz = paths.models / f"{stem}.npz"
+    return npz if npz.exists() else paths.models / f"{stem}.pth"
+
+
+class App(tk.Tk):
+    """Model trainer and explorer app UI (``ui.py:53-73``)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.title("EEGNet Model Trainer and Explorer (TPU)")
+        self.geometry("1200x800")
+
+        self.notebook = ttk.Notebook(self)
+        self.notebook.pack(fill=tk.BOTH, expand=True, padx=10, pady=10)
+
+        self.create_training_tab()
+        self.create_logs_tab()
+        self.create_reports_tab()
+        self.create_exploration_tab()
+
+        self.current_process = None
+        self.reports_data = {}
+
+    # ------------------------------------------------------------- tabs
+    def create_training_tab(self):
+        frame = ttk.Frame(self.notebook)
+        self.notebook.add(frame, text="Training Pipeline")
+        ttk.Label(frame, text="EEGNet Training Pipeline",
+                  font=("Arial", 16, "bold")).pack(pady=10)
+
+        step1 = ttk.LabelFrame(frame, text="Step 1: Fetch Data", padding=10)
+        step1.pack(fill=tk.X, padx=10, pady=5)
+        ttk.Label(step1, text="Data Source:").grid(row=0, column=0,
+                                                   sticky=tk.W, padx=5)
+        self.source_var = tk.StringVar(value="kaggle")
+        ttk.Combobox(step1, textvariable=self.source_var,
+                     values=["kaggle", "moabb"]).grid(row=0, column=1, padx=5)
+        ttk.Button(step1, text="Fetch Data",
+                   command=self.fetch_data).grid(row=0, column=2, padx=10)
+
+        step2 = ttk.LabelFrame(frame, text="Step 2: Preprocess Data",
+                               padding=10)
+        step2.pack(fill=tk.X, padx=10, pady=5)
+        ttk.Button(step2, text="Preprocess Data",
+                   command=self.preprocess_data).pack(side=tk.LEFT, padx=5)
+
+        step3 = ttk.LabelFrame(frame, text="Step 3: Train Model", padding=10)
+        step3.pack(fill=tk.X, padx=10, pady=5)
+        ttk.Label(step3, text="Training Type:").grid(row=0, column=0,
+                                                     sticky=tk.W, padx=5)
+        self.training_type_var = tk.StringVar(value="Within-Subject")
+        ttk.Combobox(step3, textvariable=self.training_type_var,
+                     values=["Within-Subject", "Cross-Subject"]).grid(
+            row=0, column=1, padx=5)
+        ttk.Label(step3, text="Epochs:").grid(row=0, column=2, sticky=tk.W,
+                                              padx=5)
+        self.epochs_var = tk.StringVar(value="100")
+        ttk.Entry(step3, textvariable=self.epochs_var, width=10).grid(
+            row=0, column=3, padx=5)
+        self.generate_report_var = tk.BooleanVar(value=True)
+        ttk.Checkbutton(step3, text="Generate Report",
+                        variable=self.generate_report_var).grid(
+            row=0, column=4, padx=10)
+        ttk.Button(step3, text="Train Model",
+                   command=self.train_model).grid(row=0, column=5, padx=10)
+
+        self.progress = Progressbar(frame, mode="indeterminate")
+        self.progress.pack(fill=tk.X, padx=10, pady=10)
+        self.status_var = tk.StringVar(value="Ready")
+        ttk.Label(frame, textvariable=self.status_var).pack(pady=5)
+
+    def create_logs_tab(self):
+        frame = ttk.Frame(self.notebook)
+        self.notebook.add(frame, text="Logs")
+        ttk.Label(frame, text="Real-time Logs",
+                  font=("Arial", 16, "bold")).pack(pady=10)
+        self.log_text = scrolledtext.ScrolledText(frame, height=25, width=120)
+        self.log_text.pack(fill=tk.BOTH, expand=True, padx=10, pady=10)
+        ttk.Button(frame, text="Clear Logs",
+                   command=self.clear_logs).pack(pady=5)
+
+    def create_reports_tab(self):
+        frame = ttk.Frame(self.notebook)
+        self.notebook.add(frame, text="Training Reports")
+        ttk.Label(frame, text="Training Results",
+                  font=("Arial", 16, "bold")).pack(pady=10)
+        ttk.Button(frame, text="Refresh Reports",
+                   command=self.load_reports).pack(pady=5)
+        self.reports_notebook = ttk.Notebook(frame)
+        self.reports_notebook.pack(fill=tk.BOTH, expand=True, padx=10, pady=10)
+        self.load_reports()
+
+    def create_exploration_tab(self):
+        frame = ttk.Frame(self.notebook)
+        self.notebook.add(frame, text="Model Exploration")
+        ttk.Label(frame, text="Model Filter Visualization",
+                  font=("Arial", 16, "bold")).pack(pady=10)
+
+        model_frame = ttk.LabelFrame(frame, text="Select Model", padding=10)
+        model_frame.pack(fill=tk.X, padx=10, pady=5)
+        ttk.Label(model_frame, text="Subject (for Within-Subject):").grid(
+            row=0, column=0, sticky=tk.W, padx=5)
+        self.subject_var = tk.StringVar(value="01")
+        ttk.Combobox(model_frame, textvariable=self.subject_var,
+                     values=[f"{i:02d}" for i in range(1, 10)]).grid(
+            row=0, column=1, padx=5)
+        ttk.Label(model_frame, text="Model Type:").grid(row=0, column=2,
+                                                        sticky=tk.W, padx=5)
+        self.model_type_var = tk.StringVar(value="Within-Subject")
+        ttk.Combobox(model_frame, textvariable=self.model_type_var,
+                     values=["Within-Subject", "Cross-Subject"]).grid(
+            row=0, column=3, padx=5)
+
+        viz_frame = ttk.LabelFrame(frame, text="Visualizations", padding=10)
+        viz_frame.pack(fill=tk.X, padx=10, pady=5)
+        for col, (label, fn) in enumerate([
+            ("Plot Temporal Filters", plot_temporal_filters),
+            ("Plot Spatial Filters", plot_spatial_filters),
+            ("Plot Power Spectra", plot_power_spectra_of_temporal_filters),
+        ]):
+            ttk.Button(viz_frame, text=label,
+                       command=lambda f=fn: self._plot_with_selection(f)).grid(
+                row=0, column=col, padx=5, pady=5)
+
+    # ---------------------------------------------------- subprocess jobs
+    def _launch(self, cmd: list[str], busy_message: str, success_message: str):
+        """Run a CLI module in a daemon thread, streaming output to Logs."""
+        def run():
+            self.status_var.set(busy_message)
+            self.progress.start()
+            try:
+                self.run_subprocess(cmd, success_message)
+            except Exception as e:  # surface everything; GUI must not die
+                self._ui(lambda: messagebox.showerror(
+                    "Error", f"{busy_message} failed: {e}"))
+                self._ui(lambda: self.status_var.set(f"Error: {busy_message}"))
+            finally:
+                self._ui(self.progress.stop)
+
+        threading.Thread(target=run, daemon=True).start()
+
+    def fetch_data(self):
+        self._launch([sys.executable, "-m", f"{PKG}.fetch",
+                      "--src", self.source_var.get()],
+                     "Fetching data...", "Data fetching completed")
+
+    def preprocess_data(self):
+        self._launch([sys.executable, "-m", f"{PKG}.dataset",
+                      "--src", self.source_var.get()],
+                     "Preprocessing data...", "Data preprocessing completed")
+
+    def train_model(self):
+        try:
+            epochs = int(self.epochs_var.get())
+            if epochs < 1 or epochs > 1000:
+                raise ValueError("Epochs must be between 1 and 1000")
+        except ValueError as e:
+            messagebox.showerror("Invalid Input", f"Invalid epochs value: {e}")
+            self.status_var.set("Invalid epochs input")
+            return
+        self._launch(
+            [sys.executable, "-m", f"{PKG}.train",
+             "--trainingType", self.training_type_var.get(),
+             "--epochs", str(epochs),
+             "--generateReport", str(self.generate_report_var.get())],
+            "Training model...", "Model training completed")
+        self.after(1000, self.load_reports)
+
+    def _ui(self, fn):
+        """Schedule ``fn`` on the Tk main thread."""
+        self.after(0, fn)
+
+    def _append_log(self, line: str):
+        self.log_text.insert(tk.END, line)
+        self.log_text.see(tk.END)
+
+    def run_subprocess(self, cmd, success_message):
+        """Stream a child CLI's output into the Logs tab (``ui.py:271-293``)."""
+        process = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                   stderr=subprocess.STDOUT, text=True,
+                                   bufsize=1, universal_newlines=True)
+        self.current_process = process
+        for line in process.stdout:
+            self._ui(lambda text=line: self._append_log(text))
+        process.wait()
+        if process.returncode == 0:
+            self._ui(lambda: self.status_var.set(success_message))
+            self._ui(lambda: self._append_log(f"\n=== {success_message} ===\n"))
+        else:
+            self._ui(lambda: self.status_var.set("Process failed"))
+            self._ui(lambda: self._append_log(
+                f"\n=== Process failed with return code "
+                f"{process.returncode} ===\n"))
+
+    def clear_logs(self):
+        self.log_text.delete(1.0, tk.END)
+
+    # ------------------------------------------------------------ reports
+    def load_reports(self):
+        self.reports_data = get_report()
+        for tab in self.reports_notebook.tabs():
+            self.reports_notebook.forget(tab)
+        if "within_subject" in self.reports_data:
+            self._report_tab("within_subject", "Within-Subject", "subject_id")
+        if "cross_subject" in self.reports_data:
+            self._report_tab("cross_subject", "Cross-Subject",
+                             "test_subject_id")
+        if not self.reports_data:
+            frame = ttk.Frame(self.reports_notebook)
+            self.reports_notebook.add(frame, text="No Reports")
+            ttk.Label(frame, text="No training reports found.\n"
+                                  "Please run training first.",
+                      font=("Arial", 12)).pack(expand=True)
+
+    def _report_tab(self, key: str, title: str, id_key: str):
+        """One scrollable report tab: overall stats, table, bar chart."""
+        outer = ttk.Frame(self.reports_notebook)
+        self.reports_notebook.add(outer, text=title)
+        report = self.reports_data[key]
+
+        canvas = tk.Canvas(outer)
+        scrollbar = ttk.Scrollbar(outer, orient="vertical",
+                                  command=canvas.yview)
+        inner = ttk.Frame(canvas)
+        canvas.configure(yscrollcommand=scrollbar.set)
+        canvas.bind("<Configure>", lambda e: canvas.configure(
+            scrollregion=canvas.bbox("all")))
+        canvas.create_window((0, 0), window=inner, anchor="nw")
+
+        overall = report["overall_results"]
+        stats = ttk.LabelFrame(inner, text="Overall Results", padding=10)
+        stats.pack(fill=tk.X, padx=10, pady=5)
+        ttk.Label(stats, text=f"Average Test Accuracy: "
+                              f"{overall['average_test_accuracy']}%",
+                  font=("Arial", 12, "bold")).pack(anchor=tk.W)
+        if "standard_error" in overall:
+            ttk.Label(stats, text=f"Standard Error: "
+                                  f"±{overall['standard_error']}%").pack(
+                anchor=tk.W)
+        ttk.Label(stats, text=f"Best Subject: "
+                              f"{overall['best_subject_accuracy']}%").pack(
+            anchor=tk.W)
+        ttk.Label(stats, text=f"Worst Subject: "
+                              f"{overall['worst_subject_accuracy']}%").pack(
+            anchor=tk.W)
+        ttk.Label(stats, text=f"Standard Deviation: "
+                              f"{overall['accuracy_std']}%").pack(anchor=tk.W)
+
+        table = ttk.LabelFrame(inner, text="Per-Subject Results", padding=10)
+        table.pack(fill=tk.BOTH, expand=True, padx=10, pady=5)
+        columns = ("Subject", "Accuracy", "Rank")
+        tree = ttk.Treeview(table, columns=columns, show="headings",
+                            height=10)
+        for col in columns:
+            tree.heading(col, text=col)
+            tree.column(col, width=110)
+        for result in report["per_subject_results"]:
+            tree.insert("", tk.END, values=(
+                f"Subject {result[id_key]}",
+                f"{result['test_accuracy']}%",
+                result["performance_rank"]))
+        tree.pack(fill=tk.BOTH, expand=True)
+
+        self._accuracy_chart(inner, report["per_subject_results"], title,
+                             id_key)
+        canvas.pack(side="left", fill="both", expand=True)
+        scrollbar.pack(side="right", fill="y")
+
+    def _accuracy_chart(self, parent, results, title_prefix, id_key):
+        """Embedded bar chart with an average line (``ui.py:427-465``)."""
+        import numpy as np
+        from matplotlib.backends.backend_tkagg import FigureCanvasTkAgg
+        from matplotlib.figure import Figure
+
+        chart = ttk.LabelFrame(parent, text="Accuracy Comparison", padding=10)
+        chart.pack(fill=tk.BOTH, expand=True, padx=10, pady=5)
+
+        fig = Figure(figsize=(10, 6), dpi=100)
+        ax = fig.add_subplot(111)
+        subjects = [f"S{r[id_key]}" for r in results]
+        accuracies = [r["test_accuracy"] for r in results]
+        bars = ax.bar(subjects, accuracies, color="steelblue", alpha=0.7)
+        ax.set_xlabel("Subject")
+        ax.set_ylabel("Test Accuracy (%)")
+        ax.set_title(f"{title_prefix} - Test Accuracy by Subject")
+        ax.grid(axis="y", alpha=0.3)
+        for bar, acc in zip(bars, accuracies):
+            ax.text(bar.get_x() + bar.get_width() / 2, bar.get_height() + 0.5,
+                    f"{acc}%", ha="center", va="bottom")
+        avg = float(np.mean(accuracies))
+        ax.axhline(y=avg, color="red", linestyle="--", alpha=0.7,
+                   label=f"Average: {avg:.2f}%")
+        ax.legend()
+        for lbl in ax.get_xticklabels():
+            lbl.set_rotation(45)
+        fig.tight_layout()
+
+        widget = FigureCanvasTkAgg(fig, chart)
+        widget.draw()
+        widget.get_tk_widget().pack(fill=tk.BOTH, expand=True)
+
+    # --------------------------------------------------------- exploration
+    def _plot_with_selection(self, plot_fn):
+        try:
+            model_path = get_model_path(self.model_type_var.get(),
+                                        self.subject_var.get())
+            if model_path.exists():
+                plot_fn(load_model_filters(model_path))
+            else:
+                messagebox.showerror("Error", "Selected model file not found.")
+        except Exception as e:
+            messagebox.showerror("Error", f"Failed to plot: {e}")
+
+
+def main() -> None:
+    """Run the UI."""
+    app = App()
+    app.mainloop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Tests for the visualization layer and the GUI's non-widget helpers."""
+
+import json
+import os
+import tempfile
+import unittest
+from pathlib import Path
+
+import matplotlib
+
+matplotlib.use("Agg")  # headless
+
+import numpy as np
+
+from eegnetreplication_tpu.config import EEG_CHANNEL_NAMES, Paths
+from eegnetreplication_tpu.viz import (
+    ELECTRODE_XY,
+    PS,
+    FilterSet,
+    load_model_filters,
+    plot_power_spectra_of_temporal_filters,
+    plot_spatial_filters,
+    plot_temporal_filters,
+    plot_topomap,
+)
+
+
+def _demo_checkpoint_files(tmp: Path):
+    """Write one native .npz and one reference .pth checkpoint of an EEGNet."""
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.training.checkpoint import (
+        save_checkpoint,
+        save_pth,
+    )
+
+    model = EEGNet(n_channels=22, n_times=257)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 22, 257)),
+                           train=False)
+    npz = tmp / "m.npz"
+    pth = tmp / "m.pth"
+    save_checkpoint(npz, variables["params"], variables["batch_stats"],
+                    metadata={"model": "eegnet"})
+    save_pth(pth, variables["params"], variables["batch_stats"],
+             f2=model.F2, t_prime=model.n_times // 32)
+    return npz, pth
+
+
+class TestFilterLoading(unittest.TestCase):
+    def test_load_both_formats_agree(self):
+        with tempfile.TemporaryDirectory() as d:
+            npz, pth = _demo_checkpoint_files(Path(d))
+            f_npz = load_model_filters(npz)
+            f_pth = load_model_filters(pth)
+        self.assertEqual(f_npz.temporal.shape, (8, 32))
+        self.assertEqual(f_npz.spatial.shape, (16, 22))
+        np.testing.assert_allclose(f_npz.temporal, f_pth.temporal, atol=1e-6)
+        np.testing.assert_allclose(f_npz.spatial, f_pth.spatial, atol=1e-6)
+
+    def test_unknown_format_raises(self):
+        with self.assertRaises(ValueError):
+            load_model_filters("model.txt")
+
+
+class TestPlots(unittest.TestCase):
+    def setUp(self):
+        rng = np.random.RandomState(0)
+        self.filters = FilterSet(
+            temporal=rng.randn(8, 32).astype(np.float32),
+            spatial=rng.randn(16, 22).astype(np.float32))
+
+    def test_temporal_grid(self):
+        fig = plot_temporal_filters(self.filters, show=False)
+        self.assertEqual(len(fig.axes), 8)
+
+    def test_spatial_topomaps(self):
+        fig = plot_spatial_filters(self.filters, show=False)
+        self.assertEqual(len(fig.axes), 16)
+
+    def test_power_spectra(self):
+        fig = plot_power_spectra_of_temporal_filters(self.filters, show=False)
+        self.assertEqual(len(fig.axes), 8)
+
+    def test_save_path(self):
+        with tempfile.TemporaryDirectory() as d:
+            out = Path(d) / "fig.png"
+            plot_temporal_filters(self.filters, show=False, save_path=out)
+            self.assertTrue(out.exists())
+
+    def test_topomap_single_axis(self):
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots()
+        plot_topomap(np.arange(22, dtype=float), ax)
+        self.assertFalse(ax.axison)
+        plt.close(fig)
+
+    def test_electrode_table_covers_all_channels(self):
+        self.assertEqual(set(ELECTRODE_XY), set(EEG_CHANNEL_NAMES))
+
+
+class TestPS(unittest.TestCase):
+    def test_parseval_like_scaling(self):
+        # A pure tone of amplitude A has single-sided power A^2/2 split into
+        # one bin under the 'ps' scaling (2/N^2 * |X|^2 with |X| = A*N/2).
+        n, fs = 128, 128.0
+        t = np.arange(n) / fs
+        x = 3.0 * np.sin(2 * np.pi * 16 * t)
+        f, ps = PS(x, fs, method="ps")
+        peak = ps[np.argmin(np.abs(f - 16))]
+        self.assertAlmostEqual(peak, 9.0 / 2, delta=0.01)
+
+    def test_psd_scaling_differs(self):
+        x = np.sin(np.arange(64))
+        _, ps = PS(x, 128.0, method="ps")
+        _, psd = PS(x, 128.0, method="psd")
+        self.assertFalse(np.allclose(ps, psd))
+
+
+class TestUIHelpers(unittest.TestCase):
+    def test_get_report_reads_latest(self):
+        from eegnetreplication_tpu.ui import get_report
+
+        with tempfile.TemporaryDirectory() as d:
+            paths = Paths.from_root(Path(d))
+            paths.reports.mkdir(parents=True)
+            payload = {"overall_results": {"average_test_accuracy": 70.0}}
+            (paths.reports / "latest_within_subject_report.json").write_text(
+                json.dumps(payload))
+            reports = get_report(paths)
+        self.assertIn("within_subject", reports)
+        self.assertNotIn("cross_subject", reports)
+        self.assertEqual(
+            reports["within_subject"]["overall_results"]
+            ["average_test_accuracy"], 70.0)
+
+    def test_get_model_path_prefers_native(self):
+        from eegnetreplication_tpu.ui import get_model_path
+
+        with tempfile.TemporaryDirectory() as d:
+            paths = Paths.from_root(Path(d))
+            paths.models.mkdir(parents=True)
+            pth = paths.models / "subject_01_best_model.pth"
+            npz = paths.models / "subject_01_best_model.npz"
+            pth.touch()
+            self.assertEqual(get_model_path("Within-Subject", "01", paths), pth)
+            npz.touch()
+            self.assertEqual(get_model_path("Within-Subject", "01", paths), npz)
+            self.assertEqual(
+                get_model_path("Cross-Subject", "01", paths).name,
+                "cross_subject_best_model.pth")
+
+
+@unittest.skipUnless(os.environ.get("DISPLAY"), "no X display")
+class TestAppConstruction(unittest.TestCase):
+    def test_app_builds_four_tabs(self):
+        from eegnetreplication_tpu.ui import App
+
+        app = App()
+        try:
+            tabs = [app.notebook.tab(t, "text") for t in app.notebook.tabs()]
+            self.assertEqual(tabs, ["Training Pipeline", "Logs",
+                                    "Training Reports", "Model Exploration"])
+        finally:
+            app.destroy()
+
+
+if __name__ == "__main__":
+    unittest.main()
