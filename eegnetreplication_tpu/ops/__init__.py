@@ -1,6 +1,17 @@
 """TPU compute ops: standardization, filtering, resampling, Pallas kernels."""
 
+from eegnetreplication_tpu.ops.dsp import (  # noqa: F401
+    fir_bandpass,
+    mne_style_bandpass_design,
+    resample_fft,
+)
 from eegnetreplication_tpu.ops.ems import (  # noqa: F401
     exponential_moving_standardize,
     raw_exponential_moving_standardize,
+)
+from eegnetreplication_tpu.ops.fused_eegnet import (  # noqa: F401
+    block1_pallas,
+    block1_reference,
+    fold_block1_params,
+    fused_eval_forward,
 )

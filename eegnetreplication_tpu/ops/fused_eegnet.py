@@ -1,0 +1,194 @@
+"""Fused EEGNet block-1 inference kernel (Pallas TPU).
+
+The hot op of the flagship model's forward pass is block 1
+(reference ``src/eegnet_repl/model.py:22-51``): temporal conv ``(1,32)`` ->
+BatchNorm -> depthwise spatial conv ``(C,1)`` -> BatchNorm -> ELU ->
+AvgPool(1,4).  In eval mode every stage before the ELU is *linear* (BN is a
+per-channel affine), which admits an algebraic reordering XLA cannot discover
+on its own because convolution layers are opaque primitives to it:
+
+    temporal(x) then spatial-mix  ==  spatial-mix(x) then temporal
+
+i.e. with ``h[f1,c,t] = sum_k w[f1,k] x[c,t+k-15]`` and the depthwise spatial
+filters ``s[f2,c]`` (group ``g = f2 // D``),
+
+    y[f2,t] = A[f2] * sum_k w[g,k] * (sum_c s[f2,c] x[c,t+k-15]) + B[f2]
+
+where ``A``/``B`` fold both BatchNorms.  The channel reduction becomes ONE
+``(F2,C) @ (C,T)`` matmul on the MXU, and the temporal filter runs on 16
+mixed channels instead of ``C*F1 = 176`` channel-filter pairs — ~11x less
+conv work plus one small matmul.  The Pallas kernel keeps the whole block in
+VMEM per batch element: matmul -> 32 statically-unrolled shifted FMAs ->
+affine -> ELU -> AvgPool(4), one HBM round trip for the entire block.
+
+``fold_block1_params`` derives ``(S, W, A, B)`` from flax variables;
+``block1_reference`` is the jnp twin used for testing and as the non-TPU
+fallback; ``fused_eval_forward`` runs the full network (fused block 1 +
+block 2/classifier via the regular flax modules) and matches
+``model.apply(..., train=False)`` numerically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TEMPORAL_K = 32
+PAD_LEFT = 15   # torch/XLA SAME padding for an even kernel: (15, 16)
+PAD_RIGHT = 16
+
+
+def fold_block1_params(params, batch_stats, eps: float = 1e-5):
+    """Fold block-1 weights + both BatchNorms into (S, W, A, B).
+
+    Returns:
+        S: ``(F2, C)`` spatial mixing matrix.
+        W: ``(F2, K)`` per-output temporal taps (group kernel replicated).
+        A, B: ``(F2,)`` affine folding temporal_bn and spatial_bn.
+    """
+    w_t = params["temporal_conv"]["kernel"]      # (1, K, 1, F1)
+    w_s = params["spatial_conv"]["kernel"]       # (C, 1, 1, F2)
+    f1 = w_t.shape[-1]
+    f2 = w_s.shape[-1]
+    d = f2 // f1
+
+    t_bn = params["temporal_bn"], batch_stats["temporal_bn"]
+    s_bn = params["spatial_bn"], batch_stats["spatial_bn"]
+
+    def bn_affine(bn, n):
+        (p, stats) = bn
+        inv = 1.0 / jnp.sqrt(stats["var"] + eps)
+        scale = p["scale"] * inv
+        shift = p["bias"] - stats["mean"] * scale
+        return scale.reshape(n), shift.reshape(n)
+
+    a1, b1 = bn_affine(t_bn, f1)   # per F1, applied between the convs
+    a2, b2 = bn_affine(s_bn, f2)   # per F2, applied after the spatial conv
+
+    S = jnp.transpose(w_s[:, 0, 0, :])                     # (F2, C)
+    w = jnp.transpose(w_t[0, :, 0, :])                     # (F1, K)
+    group = jnp.arange(f2) // d                            # f2 -> f1
+    W = w[group]                                           # (F2, K)
+
+    col_sum = jnp.sum(S, axis=1)                           # sum_c s[f2,c]
+    A = a2 * a1[group]
+    B = a2 * (b1[group] * col_sum) + b2
+    return S, W, A, B
+
+
+def _elu(x):
+    return jnp.where(x > 0, x, jnp.expm1(x))
+
+
+def block1_reference(x, S, W, A, B):
+    """jnp twin of the fused kernel: ``(B, C, T) -> (B, F2, T_pool)``."""
+    mixed = jnp.einsum("fc,bct->bft", S, x)
+    padded = jnp.pad(mixed, ((0, 0), (0, 0), (PAD_LEFT, PAD_RIGHT)))
+    t = x.shape[-1]
+    acc = jnp.zeros_like(mixed)
+    for k in range(TEMPORAL_K):
+        acc = acc + W[None, :, k:k + 1] * padded[..., k:k + t]
+    act = _elu(A[None, :, None] * acc + B[None, :, None])
+    t_pool = t // 4
+    pooled = act[..., : t_pool * 4].reshape(*act.shape[:-1], t_pool, 4)
+    return jnp.mean(pooled, axis=-1)
+
+
+def _block1_kernel(x_ref, s_ref, w_ref, a_ref, b_ref, out_ref):
+    """One batch element, fully VMEM-resident.
+
+    x_ref: (1, C, T_padded) with PAD_LEFT/PAD_RIGHT zeros already in place.
+    out_ref: (1, F2, T_pool).
+    """
+    t = out_ref.shape[-1] * 4
+    mixed = jnp.dot(s_ref[:], x_ref[0],
+                    preferred_element_type=jnp.float32)    # (F2, T+31) on MXU
+    acc = jnp.zeros((s_ref.shape[0], t), jnp.float32)
+    for k in range(TEMPORAL_K):                            # static unroll, VPU
+        acc = acc + w_ref[:, k:k + 1] * mixed[:, k:k + t]
+    act = _elu(a_ref[:] * acc + b_ref[:])                  # (F2,1) broadcasts
+    pooled = act.reshape(act.shape[0], -1, 4)
+    out_ref[0] = jnp.mean(pooled, axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def block1_pallas(x, S, W, A, B, interpret: bool = False):
+    """Pallas-fused block 1: ``(B, C, T) -> (B, F2, T//4)``.
+
+    Grid over the batch; per step the (C, T) slice, the (F2, C) mixing
+    matmul, the unrolled 32-tap conv, affine+ELU and the pool all stay in
+    VMEM (one HBM read of x, one HBM write of the pooled output).
+    """
+    from jax.experimental import pallas as pl
+
+    n_b, _, t = x.shape
+    f2 = S.shape[0]
+    t_pool = t // 4
+    # Pre-pad time on the host side of the kernel so in-kernel slices are
+    # static; zero-padding keeps SAME-conv semantics.
+    xp = jnp.pad(x, ((0, 0), (0, 0), (PAD_LEFT, PAD_RIGHT)))
+
+    out = pl.pallas_call(
+        _block1_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_b, f2, t_pool), jnp.float32),
+        grid=(n_b,),
+        in_specs=[
+            pl.BlockSpec((1, x.shape[1], xp.shape[2]),
+                         lambda b: (b, 0, 0)),
+            pl.BlockSpec((f2, S.shape[1]), lambda b: (0, 0)),
+            pl.BlockSpec((f2, TEMPORAL_K), lambda b: (0, 0)),
+            pl.BlockSpec((f2, 1), lambda b: (0, 0)),
+            pl.BlockSpec((f2, 1), lambda b: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, f2, t_pool), lambda b: (b, 0, 0)),
+        interpret=interpret,
+    )(xp, S, W, A.reshape(f2, 1), B.reshape(f2, 1))
+    return out
+
+
+def fused_eval_forward(model, params, batch_stats, x, *,
+                       use_pallas: bool | None = None):
+    """Full eval-mode forward with the fused block 1.
+
+    Numerically equivalent to ``model.apply({...}, x, train=False)``; block 2
+    and the classifier reuse the regular flax submodule parameters via a
+    functional re-implementation (they are a small fraction of the FLOPs).
+
+    ``use_pallas=None`` auto-selects: the Pallas path on TPU backends, the
+    jnp reference elsewhere.  The whole function (BN folding included) is
+    jitted, so repeated calls compile once.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    return _fused_eval_forward_jit(model, params, batch_stats, x, use_pallas)
+
+
+@functools.partial(jax.jit, static_argnames=("model", "use_pallas"))
+def _fused_eval_forward_jit(model, params, batch_stats, x, use_pallas):
+    S, W, A, B = fold_block1_params(params, batch_stats,
+                                    eps=model.bn_epsilon)
+    h = (block1_pallas(x, S, W, A, B) if use_pallas
+         else block1_reference(x, S, W, A, B))       # (B, F2, T//4)
+
+    # --- Block 2 (separable conv) + classifier, functional on the params ---
+    h = jnp.transpose(h, (0, 2, 1))[:, None, :, :]   # NHWC (B, 1, T', F2)
+    w_dw = params["separable_depthwise"]["kernel"]   # (1, 16, 1, F2)
+    h = jax.lax.conv_general_dilated(
+        h, w_dw, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=h.shape[-1])
+    w_pw = params["separable_pointwise"]["kernel"]   # (1, 1, F2, F2)
+    h = jax.lax.conv_general_dilated(
+        h, w_pw, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    bn_p, bn_s = params["block2_bn"], batch_stats["block2_bn"]
+    inv = 1.0 / jnp.sqrt(bn_s["var"] + model.bn_epsilon)
+    h = (h - bn_s["mean"]) * inv * bn_p["scale"] + bn_p["bias"]
+    h = _elu(h)
+    b_, _, t_, f_ = h.shape
+    h = h[:, :, : (t_ // 8) * 8, :].reshape(b_, 1, t_ // 8, 8, f_).mean(axis=3)
+    h = h.reshape(b_, -1)
+    return h @ params["classifier"]["kernel"] + params["classifier"]["bias"]

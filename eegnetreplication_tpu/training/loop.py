@@ -240,6 +240,23 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
     return fold_trainer
 
 
+def shard_over_fold_axis(fn, mesh, fold_axis: str, mapped: tuple[bool, ...]):
+    """Wrap a vmapped runner in ``shard_map`` over the mesh's fold axis.
+
+    ``mapped`` marks, per positional argument, whether it carries the leading
+    fold/run dimension (sharded) or is replicated.  Single home for the
+    fold-axis sharding contract (used by the protocol trainer and the
+    permutation test); callers pad the mapped axis to a multiple of
+    ``mesh.shape[fold_axis]``.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    in_specs = tuple(P(fold_axis) if m else P() for m in mapped)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=P(fold_axis), check_rep=False)
+
+
 def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
                             train_pad: int, val_pad: int, test_pad: int,
                             maxnorm_mode: str = "reference",
@@ -262,17 +279,8 @@ def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
 
     if mesh is None:
         return jax.jit(vmapped)
-
-    from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
-
-    mapped = shard_map(
-        vmapped, mesh=mesh,
-        in_specs=(P(), P(), P(fold_axis), P(fold_axis), P(fold_axis)),
-        out_specs=P(fold_axis),
-        check_rep=False,
-    )
-    return jax.jit(mapped)
+    return jax.jit(shard_over_fold_axis(
+        vmapped, mesh, fold_axis, mapped=(False, False, True, True, True)))
 
 
 def init_fold_states(model, tx, n_folds: int, sample_shape, seed: int = 0):

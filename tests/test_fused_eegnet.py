@@ -1,0 +1,91 @@
+"""Parity tests for the fused block-1 Pallas kernel (interpret mode on CPU).
+
+The jnp reference path must match the flax model bit-for-bit-ish (same op
+order), and the Pallas kernel must match the reference; together they pin the
+algebraic refactoring (spatial-mix-first + folded BatchNorms) to the model's
+eval-mode semantics.
+"""
+
+import unittest
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from eegnetreplication_tpu.models import EEGNet
+from eegnetreplication_tpu.ops.fused_eegnet import (
+    block1_pallas,
+    block1_reference,
+    fold_block1_params,
+    fused_eval_forward,
+)
+
+
+def _setup(C=22, T=257, F1=8, D=2, seed=0, batch=8, perturb_bn=False):
+    model = EEGNet(n_channels=C, n_times=T, F1=F1, D=D)
+    v = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, C, T)),
+                   train=False)
+    if perturb_bn:
+        # Non-trivial running stats: the folding must honour them.
+        rng = np.random.RandomState(3)
+        bs = jax.tree_util.tree_map(
+            lambda a: jnp.asarray(np.abs(rng.randn(*a.shape)) + 0.5),
+            v["batch_stats"])
+        v = {"params": v["params"], "batch_stats": bs}
+    x = jnp.asarray(np.random.RandomState(seed + 1).randn(batch, C, T),
+                    jnp.float32)
+    return model, v, x
+
+
+class TestFusedForward(unittest.TestCase):
+    def test_fused_matches_flax_eval(self):
+        model, v, x = _setup()
+        want = model.apply(v, x, train=False)
+        got = fused_eval_forward(model, v["params"], v["batch_stats"], x,
+                                 use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-5)
+
+    def test_fused_matches_flax_with_perturbed_bn(self):
+        model, v, x = _setup(perturb_bn=True)
+        want = model.apply(v, x, train=False)
+        got = fused_eval_forward(model, v["params"], v["batch_stats"], x,
+                                 use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+
+    def test_wide_config(self):
+        model, v, x = _setup(F1=16, D=4, batch=4)
+        want = model.apply(v, x, train=False)
+        got = fused_eval_forward(model, v["params"], v["batch_stats"], x,
+                                 use_pallas=False)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+
+
+class TestPallasKernel(unittest.TestCase):
+    def _parity(self, **kw):
+        model, v, x = _setup(**kw)
+        S, W, A, B = fold_block1_params(v["params"], v["batch_stats"],
+                                        eps=model.bn_epsilon)
+        ref = block1_reference(x, S, W, A, B)
+        out = block1_pallas(x, S, W, A, B, interpret=True)
+        self.assertEqual(out.shape, ref.shape)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_parity_default(self):
+        self._parity()
+
+    def test_parity_t256(self):
+        self._parity(T=256, batch=4)
+
+    def test_parity_wide(self):
+        self._parity(F1=16, D=4, batch=2)
+
+    def test_parity_perturbed_bn(self):
+        self._parity(perturb_bn=True, batch=4)
+
+
+if __name__ == "__main__":
+    unittest.main()
