@@ -53,6 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
                              "or true paper weight projection.")
     parser.add_argument("--subjects", type=str, default=None,
                         help="Comma-separated subject ids (default: 1-9).")
+    parser.add_argument("--profileDir", type=str, default=None,
+                        help="Write a jax.profiler trace (TensorBoard) here.")
     return parser
 
 
@@ -89,16 +91,19 @@ def main() -> None:
     mesh = None
     import jax
 
+    from eegnetreplication_tpu.utils.profiling import trace
+
     if len(jax.devices()) > 1 or args.meshFold is not None:
         mesh = make_mesh(n_fold=args.meshFold, n_data=args.meshData)
         logger.info("Using device mesh %s", dict(mesh.shape))
 
     if args.trainingType == "Within-Subject":
         logger.info("Training Within-Subject models for all subjects...")
-        result = within_subject_training(epochs=args.epochs, config=config,
-                                         seed=args.seed, mesh=mesh,
-                                         model_name=args.model,
-                                         subjects=subjects)
+        with trace(args.profileDir):
+            result = within_subject_training(epochs=args.epochs, config=config,
+                                             seed=args.seed, mesh=mesh,
+                                             model_name=args.model,
+                                             subjects=subjects)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         if args.generateReport:
@@ -108,10 +113,11 @@ def main() -> None:
                                config=config)
     else:
         logger.info("Training Cross-Subject model...")
-        result = cross_subject_training(epochs=args.epochs, config=config,
-                                        seed=args.seed, mesh=mesh,
-                                        model_name=args.model,
-                                        subjects=subjects)
+        with trace(args.profileDir):
+            result = cross_subject_training(epochs=args.epochs, config=config,
+                                            seed=args.seed, mesh=mesh,
+                                            model_name=args.model,
+                                            subjects=subjects)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         if args.generateReport:

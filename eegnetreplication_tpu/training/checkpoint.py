@@ -50,12 +50,25 @@ def _unflatten(flat: dict[str, np.ndarray], prefix: str) -> dict:
 
 
 def save_checkpoint(path: str | Path, params: Any, batch_stats: Any,
-                    metadata: dict | None = None) -> Path:
-    """Save params + batch stats + JSON metadata into one ``.npz``."""
+                    metadata: dict | None = None, *,
+                    opt_state: Any = None, step: int | None = None) -> Path:
+    """Save params + batch stats (+ optimizer state + step) into one ``.npz``.
+
+    The reference persists bare weights only, so training cannot resume
+    (SURVEY.md §5 "save-only").  Passing ``opt_state``/``step`` makes the
+    checkpoint resumable: optimizer leaves are stored positionally (their
+    tree structure is rebuilt from ``tx.init(params)`` at load time, see
+    :func:`load_train_state`).
+    """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     flat = _flatten(params, "params" + SEP)
     flat.update(_flatten(batch_stats, "batch_stats" + SEP))
+    if opt_state is not None:
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(opt_state)):
+            flat[f"opt{SEP}{i}"] = np.asarray(leaf)
+    if step is not None:
+        flat["__step__"] = np.asarray(step, np.int64)
     flat["__metadata__"] = np.frombuffer(
         json.dumps(metadata or {}).encode(), dtype=np.uint8
     )
@@ -70,6 +83,36 @@ def load_checkpoint(path: str | Path) -> tuple[dict, dict, dict]:
     metadata = json.loads(bytes(flat.pop("__metadata__")).decode())
     return (_unflatten(flat, "params" + SEP),
             _unflatten(flat, "batch_stats" + SEP), metadata)
+
+
+def load_train_state(path: str | Path, tx) -> tuple[Any, int, dict]:
+    """Load a resumable checkpoint into ``(TrainState, step, metadata)``.
+
+    ``tx`` must be the same optimizer the state was saved with: its
+    ``tx.init(params)`` supplies the tree structure the positionally-stored
+    optimizer leaves are poured back into.
+    """
+    from eegnetreplication_tpu.training.steps import TrainState
+
+    with np.load(Path(path), allow_pickle=False) as data:
+        flat = {k: data[k] for k in data.files}
+    metadata = json.loads(bytes(flat.pop("__metadata__")).decode())
+    step = int(flat.pop("__step__", 0))
+    params = _unflatten(flat, "params" + SEP)
+    batch_stats = _unflatten(flat, "batch_stats" + SEP)
+
+    opt_keys = sorted((k for k in flat if k.startswith("opt" + SEP)),
+                      key=lambda k: int(k.split(SEP)[1]))
+    template = tx.init(params)
+    if opt_keys:
+        treedef = jax.tree_util.tree_structure(template)
+        opt_state = jax.tree_util.tree_unflatten(
+            treedef, [flat[k] for k in opt_keys])
+    else:
+        opt_state = template  # weights-only checkpoint: fresh optimizer
+    state = TrainState(params=params, batch_stats=batch_stats,
+                       opt_state=opt_state)
+    return state, step, metadata
 
 
 def _classifier_nhwc_to_nchw(kernel: np.ndarray, f2: int, t_prime: int) -> np.ndarray:

@@ -1,0 +1,67 @@
+"""Tracing and step-time measurement.
+
+The reference has no profiling of any kind (SURVEY.md §5: no timers, no
+throughput numbers anywhere).  This module supplies the TPU equivalents:
+
+- :func:`trace` — a context manager around ``jax.profiler`` emitting a
+  TensorBoard-loadable trace of everything run inside it;
+- :class:`StepTimer` — wall-clock step/rate accounting used by the protocols
+  and the benchmark (fold-epochs/s is the BASELINE.json metric the reference
+  never measured).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+from eegnetreplication_tpu.utils.logging import logger
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | None):
+    """Profile the enclosed block with ``jax.profiler`` (no-op if dir is None).
+
+    View with TensorBoard: ``tensorboard --logdir <log_dir>``.
+    """
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    logger.info("JAX profiler trace -> %s", log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        logger.info("JAX profiler trace written to %s", log_dir)
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock accumulator for repeated steps."""
+
+    times: list = field(default_factory=list)
+    _t0: float | None = None
+
+    def __enter__(self) -> "StepTimer":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.times.append(time.perf_counter() - self._t0)
+        self._t0 = None
+
+    @property
+    def total(self) -> float:
+        return sum(self.times)
+
+    @property
+    def mean(self) -> float:
+        return self.total / len(self.times) if self.times else 0.0
+
+    def rate(self, units_per_step: float = 1.0) -> float:
+        """Units per second across all recorded steps."""
+        return len(self.times) * units_per_step / self.total if self.times else 0.0
