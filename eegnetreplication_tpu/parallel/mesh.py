@@ -68,5 +68,20 @@ def make_hybrid_mesh(n_data_per_host: int = 1) -> Mesh:
     return Mesh(arr, (FOLD_AXIS, DATA_AXIS))
 
 
+def initialize_distributed(coordinator_address: str | None = None,
+                           num_processes: int | None = None,
+                           process_id: int | None = None) -> None:
+    """Multi-host bring-up: the framework's replacement for NCCL/MPI init.
+
+    On TPU pods with standard environments the arguments auto-detect; pass
+    them explicitly elsewhere.  Call once per process before ``jax.devices()``
+    so every host sees the global device set, then build a mesh with
+    :func:`make_hybrid_mesh`.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
 def mesh_size(mesh: Mesh) -> int:
     return math.prod(mesh.shape.values())

@@ -1,0 +1,56 @@
+"""Explore a processed subject: shapes, class balance, a trial plot.
+
+Script equivalent of the reference's exploration notebook
+(``notebooks/01_explore_data.ipynb``).  Needs preprocessed data
+(``python -m eegnetreplication_tpu.dataset --src kaggle``); pass a subject id
+or rely on the default (1).
+
+Usage: python examples/01_explore_data.py [subject] [out.png]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt
+import numpy as np
+
+from eegnetreplication_tpu.config import EEG_CHANNEL_NAMES
+from eegnetreplication_tpu.data.io import load_subject_dataset
+
+
+def main() -> None:
+    subject = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    out = sys.argv[2] if len(sys.argv) > 2 else "explore.png"
+
+    train = load_subject_dataset(subject=subject, mode="Train")
+    evald = load_subject_dataset(subject=subject, mode="Eval")
+    print(f"Subject {subject}: Train {train.X.shape}, Eval {evald.X.shape}")
+    for name, d in (("Train", train), ("Eval", evald)):
+        counts = np.bincount(d.y, minlength=4)
+        print(f"  {name} class counts (L/R/Foot/Tongue): {counts.tolist()}")
+        print(f"  {name} value range: [{d.X.min():.2f}, {d.X.max():.2f}], "
+              f"mean {d.X.mean():.3f}, std {d.X.std():.3f}")
+
+    fig, axes = plt.subplots(2, 1, figsize=(12, 7))
+    axes[0].bar(["left", "right", "foot", "tongue"],
+                np.bincount(train.y, minlength=4), color="steelblue")
+    axes[0].set_title(f"Subject {subject} Train class balance")
+    t = np.arange(train.X.shape[2]) / 128.0 + 0.5
+    for c in range(0, train.n_channels, 4):
+        axes[1].plot(t, train.X[0, c] + 4.0 * (c // 4),
+                     label=EEG_CHANNEL_NAMES[c], lw=0.8)
+    axes[1].set_title("Trial 0, every 4th channel (offset for display)")
+    axes[1].set_xlabel("Time since cue (s)")
+    axes[1].legend(loc="upper right", fontsize=7)
+    fig.tight_layout()
+    fig.savefig(out, dpi=120)
+    print(f"Wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""Label-permutation significance test for a trained subject.
+
+Script equivalent of the reference's permutation analysis
+(``notebooks/04_model_inter_subject.ipynb`` cells 44-48, which reports real
+85.71% vs mean permuted 24.21%, p < 0.001 on subject 3).  All permuted runs
+train simultaneously in one compiled program.
+
+Usage: python examples/03_permutation_test.py [subject] [n_permutations] [epochs]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+from eegnetreplication_tpu.data.io import load_subject_dataset
+from eegnetreplication_tpu.training.permutation import permutation_test
+
+
+def main() -> None:
+    subject = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    n_perm = int(sys.argv[2]) if len(sys.argv) > 2 else 50
+    epochs = int(sys.argv[3]) if len(sys.argv) > 3 else 100
+
+    train = load_subject_dataset(subject=subject, mode="Train")
+    evald = load_subject_dataset(subject=subject, mode="Eval")
+    X = np.concatenate([train.X, evald.X])
+    y = np.concatenate([train.y, evald.y])
+
+    result = permutation_test(X, y, n_permutations=n_perm, epochs=epochs)
+    print(f"Subject {subject}: real {result.real_accuracy:.2f}% vs "
+          f"mean permuted {result.mean_permuted:.2f}% "
+          f"(chance 25%), p = {result.p_value:.4f}")
+
+
+if __name__ == "__main__":
+    main()

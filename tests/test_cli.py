@@ -1,0 +1,118 @@
+"""Subprocess tests of the CLI plugin boundary.
+
+The GUI drives the framework exclusively through
+``python -m eegnetreplication_tpu.{fetch,dataset,train}`` subprocesses (the
+reference's architectural keystone, ``ui.py:213,229,256-259``); these tests
+exercise that exact boundary end-to-end on a synthetic data tree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import unittest
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _run(args, data_root, timeout=420):
+    env = dict(os.environ,
+               EEGTPU_DATA_ROOT=str(data_root),
+               EEGTPU_PLATFORM="cpu",
+               EEGTPU_NO_LOG_FILE="1",
+               PYTHONPATH=str(REPO))
+    return subprocess.run([sys.executable, "-m"] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+class TestCLIBoundary(unittest.TestCase):
+    @classmethod
+    def setUpClass(cls):
+        from scipy.io import savemat
+
+        from eegnetreplication_tpu.config import Paths
+        from eegnetreplication_tpu.data.gdf import write_gdf
+
+        cls.tmp = Path(tempfile.mkdtemp(prefix="eegtpu_cli_"))
+        paths = Paths.from_root(cls.tmp)
+        rng = np.random.RandomState(0)
+        n = 250 * 40
+        for s in (1, 2):
+            for mode, sess in (("Train", "T"), ("Eval", "E")):
+                sig = rng.uniform(-0.5, 0.5, (25, n)).astype(np.float32)
+                pos = np.arange(8) * 1100 + 300
+                typ = (np.array([769, 770, 771, 772] * 2) if mode == "Train"
+                       else np.full(8, 783))
+                write_gdf(paths.data_raw / mode / f"A{s:02d}{sess}.gdf", sig,
+                          250.0, event_pos=pos, event_typ=typ)
+                if mode == "Eval":
+                    (paths.data_raw / "TrueLabels").mkdir(exist_ok=True)
+                    savemat(paths.data_raw / "TrueLabels" / f"A{s:02d}E.mat",
+                            {"classlabel": rng.randint(1, 5, 8)})
+
+    @classmethod
+    def tearDownClass(cls):
+        import shutil
+
+        shutil.rmtree(cls.tmp, ignore_errors=True)
+
+    def test_1_dataset_cli(self):
+        proc = _run(["eegnetreplication_tpu.dataset", "--src", "kaggle"],
+                    self.tmp)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        processed = self.tmp / "data" / "processed"
+        for s in (1, 2):
+            self.assertTrue(
+                (processed / "Train" / f"A{s:02d}T-trials.npz").exists())
+            self.assertTrue(
+                (processed / "Eval" / f"A{s:02d}E-trials.npz").exists())
+
+    def test_2_train_cli_writes_report_and_models(self):
+        proc = _run(["eegnetreplication_tpu.train",
+                     "--trainingType", "Within-Subject", "--epochs", "1",
+                     "--subjects", "1,2", "--generateReport", "True"],
+                    self.tmp)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        report_path = (self.tmp / "reports"
+                       / "latest_within_subject_report.json")
+        self.assertTrue(report_path.exists())
+        report = json.loads(report_path.read_text())
+        self.assertEqual(report["training_type"], "Within-Subject")
+        self.assertEqual(
+            [r["subject_id"] for r in report["per_subject_results"]], [1, 2])
+        self.assertTrue(
+            (self.tmp / "models" / "subject_01_best_model.npz").exists())
+
+    def test_3_generate_report_false_writes_nothing(self):
+        # Quirk Q5: the reference's `--generateReport False` still wrote a
+        # report; ours must not.
+        before = set((self.tmp / "reports").glob("*")) \
+            if (self.tmp / "reports").exists() else set()
+        proc = _run(["eegnetreplication_tpu.train",
+                     "--trainingType", "Within-Subject", "--epochs", "1",
+                     "--subjects", "1", "--generateReport", "False"],
+                    self.tmp)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        after = set((self.tmp / "reports").glob("*")) \
+            if (self.tmp / "reports").exists() else set()
+        self.assertEqual(before, after)
+
+    def test_fetch_cli_errors_cleanly_without_backend(self):
+        proc = _run(["eegnetreplication_tpu.fetch", "--src", "kaggle"],
+                    self.tmp, timeout=120)
+        if proc.returncode != 0:  # kagglehub absent in this environment
+            self.assertIn("kagglehub", proc.stderr)
+
+    def test_dataset_cli_rejects_unknown_src(self):
+        proc = _run(["eegnetreplication_tpu.dataset", "--src", "nope"],
+                    self.tmp, timeout=120)
+        self.assertNotEqual(proc.returncode, 0)
+        self.assertIn("Unknown source", proc.stderr)
+
+
+if __name__ == "__main__":
+    unittest.main()
