@@ -1,7 +1,9 @@
 """Benchmark: fused TPU fold-training throughput vs the reference's loop style.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
+     "platform": ..., "baseline": N, "compile_s": N}
+(plus an "error" field when a stage failed — the line is always printed).
 
 The measured quantity is within-subject training throughput in
 **fold-epochs/second** — how many (fold x epoch) units of the reference's
@@ -16,8 +18,20 @@ Workload shape matches the real protocol: a 576-trial subject pool
 ``vmap`` in one compiled program, batch size 64.
 
 Env knobs: BENCH_SMOKE=1 shrinks epochs for a quick correctness pass;
-EEGTPU_PLATFORM=cpu forces the backend (the site startup pins
-``jax_platforms=axon,cpu``, so a plain JAX_PLATFORMS env var is ignored).
+EEGTPU_PLATFORM=cpu|tpu forces the backend and skips the probe (the site
+startup pins ``jax_platforms`` to a tunneled TPU backend, so a plain
+JAX_PLATFORMS env var is ignored); BENCH_TPU_PROBE_S overrides the probe
+timeout (default 90 s).
+
+Robustness contract (round-1 postmortem): the pinned TPU backend can fail
+*or hang* at init, which previously killed the run before any JSON was
+printed.  We therefore probe the accelerator in a **subprocess** with a
+timeout before this process touches JAX, fall back to CPU when the probe
+fails, and wrap everything so one JSON line is printed on any Python-level
+failure; a watchdog timer (BENCH_DEADLINE_S, default 1500 s) additionally
+covers the probe-to-init race where the backend passes the probe but hangs
+during this process's own init (best-effort — a hang that never releases
+the GIL can still defeat it).
 """
 
 from __future__ import annotations
@@ -28,9 +42,9 @@ import time
 
 import numpy as np
 
-from eegnetreplication_tpu.utils.platform import apply_platform_override
+from eegnetreplication_tpu.utils.platform import select_platform
 
-apply_platform_override()
+PLATFORM = select_platform()  # never raises; falls back to CPU
 
 C, T, N_POOL, BATCH = 22, 257, 576, 64
 N_FOLDS = 4
@@ -59,8 +73,8 @@ def _fold_indices():
     return folds
 
 
-def bench_tpu(x, y, folds) -> float:
-    """Fold-epochs/sec of the fused vmapped trainer (all 4 folds at once)."""
+def bench_tpu(x, y, folds) -> tuple[float, float]:
+    """(fold-epochs/sec, compile seconds) of the fused vmapped trainer."""
     import jax
     import jax.numpy as jnp
 
@@ -93,12 +107,15 @@ def bench_tpu(x, y, folds) -> float:
     pool_x, pool_y = jnp.asarray(x), jnp.asarray(y)
 
     # Warmup: compile (first TPU compile is the slow part; it is amortized
-    # over the 36-fold x 500-epoch real protocol, so excluded from the rate).
+    # over the 36-fold x 500-epoch real protocol, so excluded from the rate
+    # but reported separately as compile_s).
+    t0 = time.perf_counter()
     jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
+    compile_s = time.perf_counter() - t0
     t0 = time.perf_counter()
     jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
     dt = time.perf_counter() - t0
-    return N_FOLDS * EPOCHS / dt
+    return N_FOLDS * EPOCHS / dt, compile_s
 
 
 def bench_torch_reference_style(x, y, folds) -> float:
@@ -169,17 +186,57 @@ def bench_torch_reference_style(x, y, folds) -> float:
     return TORCH_EPOCHS / dt
 
 
+def _arm_watchdog(record: dict, deadline_s: float) -> "threading.Timer":
+    """Best-effort guard for hangs the probe can't prevent.
+
+    The subprocess probe validates backend init, but a flaky tunneled
+    backend can still hang during THIS process's init (probe-to-init
+    race).  If the deadline passes, print the JSON line with an error
+    field and hard-exit — rc 0 with the contract honored beats the
+    driver's rc-124 timeout with no output.  Best-effort: a hang that
+    never releases the GIL can still defeat it.
+    """
+    import threading
+
+    def fire():
+        record["error"] = f"watchdog: bench exceeded {deadline_s:.0f}s"
+        print(json.dumps(record), flush=True)
+        os._exit(0)
+
+    timer = threading.Timer(deadline_s, fire)
+    timer.daemon = True
+    timer.start()
+    return timer
+
+
 def main() -> None:
-    x, y = _synthetic_pool()
-    folds = _fold_indices()
-    ours = bench_tpu(x, y, folds)
-    baseline = bench_torch_reference_style(x, y, folds)
-    print(json.dumps({
+    """Run the bench; ALWAYS print exactly one JSON line on stdout."""
+    record = {
         "metric": "within_subject_training_throughput",
-        "value": round(ours, 2),
+        "value": 0.0,
         "unit": "fold-epochs/s",
-        "vs_baseline": round(ours / baseline, 2),
-    }))
+        "vs_baseline": 0.0,
+        "platform": PLATFORM,
+    }
+    try:
+        deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
+    except ValueError:
+        deadline_s = 1500.0
+    watchdog = _arm_watchdog(record, deadline_s)
+    try:
+        x, y = _synthetic_pool()
+        folds = _fold_indices()
+        ours, compile_s = bench_tpu(x, y, folds)
+        record.update(value=round(ours, 2), compile_s=round(compile_s, 2))
+        baseline = bench_torch_reference_style(x, y, folds)
+        record.update(
+            vs_baseline=round(ours / baseline, 2),
+            baseline=round(baseline, 2),
+        )
+    except Exception as exc:  # noqa: BLE001 — contract: always emit the line
+        record["error"] = f"{type(exc).__name__}: {exc}"[:300]
+    watchdog.cancel()
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

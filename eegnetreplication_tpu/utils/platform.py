@@ -1,15 +1,27 @@
-"""Runtime platform selection.
+"""Runtime platform selection and hardening.
 
 This environment's site startup pins ``jax_platforms`` (e.g. to a tunneled
-TPU backend), which both overrides the standard ``JAX_PLATFORMS`` env var and
-can fail to initialize outside the install tree.  ``apply_platform_override``
-lets ``EEGTPU_PLATFORM`` (e.g. ``cpu``, ``tpu``) win, provided it runs before
-the first JAX backend initialization — CLI entry points call it first thing.
+TPU backend) which overrides the standard ``JAX_PLATFORMS`` env var and can
+fail — or HANG — at first backend init.  Everything here must run before the
+first JAX backend initialization to have any effect; CLI entry points call
+these first thing.  This module is the single home for that logic: the
+benchmark, the driver dry-run entry point, and the CLIs all share it.
 """
 
 from __future__ import annotations
 
 import os
+import re
+import subprocess
+import sys
+
+_DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
+
+_PROBE_SRC = (
+    "import jax; ds = jax.devices(); "
+    "assert any(d.platform != 'cpu' for d in ds), 'cpu only'; "
+    "print(jax.default_backend())"
+)
 
 
 def apply_platform_override() -> str | None:
@@ -20,3 +32,88 @@ def apply_platform_override() -> str | None:
 
         jax.config.update("jax_platforms", platform)
     return platform or None
+
+
+def probe_accelerator(timeout_s: float = 90.0) -> str | None:
+    """Try accelerator backend init in a subprocess; backend name or None.
+
+    Runs out-of-process because a broken tunneled backend can hang inside
+    its C++ init where no in-process timeout can reach it.
+    """
+    env = dict(os.environ)
+    env.pop("EEGTPU_PLATFORM", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC], capture_output=True,
+            text=True, timeout=timeout_s, env=env,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode != 0:
+        return None
+    name = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    return name or None
+
+
+def force_cpu(n_devices: int | None = None) -> bool:
+    """Pin JAX to the CPU platform, with ``n_devices`` virtual devices.
+
+    ``n_devices=None`` leaves any ambient virtual-device-count flag alone
+    and only forces the platform.  Sets both the env vars and the
+    in-process config so the forcing wins whether or not JAX has been
+    imported yet.  Returns True if no backend was initialized yet (the
+    forcing will take); False means a backend already exists — the config
+    update is silently ignored by JAX in that case, so the caller should
+    verify ``jax.devices()`` afterwards.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        flags = re.sub(rf"{_DEVCOUNT_FLAG}=\S+", "", flags)
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} {_DEVCOUNT_FLAG}={n_devices}".strip()
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    # jax.config.update after backend init does NOT raise — it is silently
+    # ineffective.  Detect the initialized-backend case explicitly so the
+    # return value is honest.
+    initialized = False
+    try:
+        from jax._src import xla_bridge
+
+        initialized = bool(getattr(xla_bridge, "_backends", None))
+    except Exception:
+        pass
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        return False
+    return not initialized
+
+
+def select_platform(probe_timeout_s: float | None = None) -> str:
+    """Pick the JAX platform before any in-process backend init.
+
+    ``EEGTPU_PLATFORM`` wins when set; otherwise probe the accelerator in a
+    subprocess and fall back to CPU when the probe fails or hangs.  Never
+    raises — on any unexpected error the CPU fallback is applied.
+    """
+    try:
+        forced = apply_platform_override()
+        if forced:
+            return forced
+        if probe_timeout_s is None:
+            try:
+                probe_timeout_s = float(
+                    os.environ.get("BENCH_TPU_PROBE_S", "90"))
+            except ValueError:
+                probe_timeout_s = 90.0
+        accel = probe_accelerator(probe_timeout_s)
+        if accel is not None:
+            return accel  # ambient pin works; leave it in charge
+    except Exception:
+        pass
+    force_cpu()
+    return "cpu"
