@@ -46,6 +46,12 @@ from eegnetreplication_tpu.utils.platform import select_platform
 
 PLATFORM = select_platform()  # never raises; falls back to CPU
 
+# Exactly-one-JSON-line guard: whichever of main() / the watchdog acquires
+# this first is the sole printer.
+import threading  # noqa: E402
+
+_EMIT_ONCE = threading.Lock()
+
 C, T, N_POOL, BATCH = 22, 257, 576, 64
 N_FOLDS = 4
 EPOCHS = 2 if os.environ.get("BENCH_SMOKE") else 100
@@ -199,6 +205,8 @@ def _arm_watchdog(record: dict, deadline_s: float) -> "threading.Timer":
     import threading
 
     def fire():
+        if not _EMIT_ONCE.acquire(blocking=False):
+            return  # main() is already printing the line
         record["error"] = f"watchdog: bench exceeded {deadline_s:.0f}s"
         print(json.dumps(record), flush=True)
         os._exit(0)
@@ -235,8 +243,9 @@ def main() -> None:
         )
     except Exception as exc:  # noqa: BLE001 — contract: always emit the line
         record["error"] = f"{type(exc).__name__}: {exc}"[:300]
-    watchdog.cancel()
-    print(json.dumps(record))
+    if _EMIT_ONCE.acquire(blocking=False):
+        watchdog.cancel()
+        print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -19,8 +19,8 @@ BioSig reference implementation):
   followed by per-channel lowpass/highpass/notch floats;
 - sample records interleaved channel-blocked per record, with per-channel
   sample type (GDFTYP) and samples-per-record;
-- an event table after the data: mode byte, then (v >= 1.9) a 24-bit event
-  count and float32 event sample rate, or (v < 1.9) a 24-bit sample rate and
+- an event table after the data: mode byte, then (v >= 1.94) a 24-bit event
+  count and float32 event sample rate, or (v < 1.94) a 24-bit sample rate and
   uint32 count; positions are uint32 **1-based** sample indices, types uint16;
   mode 3 adds per-event channel and duration arrays.
 
@@ -122,6 +122,11 @@ def read_gdf_python(path: str | Path) -> GDFRecording:
     n_channels = struct.unpack_from("<H", data, 252)[0]
     if n_records < 0:
         raise ValueError(f"{path}: unknown record count (streaming file)")
+    min_header = 256 * (1 + n_channels)
+    if not min_header <= header_len <= len(data):
+        raise ValueError(
+            f"{path}: bad GDF header length {header_len} "
+            f"(need {min_header}..{len(data)} for {n_channels} channels)")
     record_dur = dur_num / dur_den if dur_den else 1.0
 
     # --- channel headers: field-major arrays of per-channel metadata ---
@@ -212,7 +217,10 @@ def read_gdf_python(path: str | Path) -> GDFRecording:
         ev = memoryview(data)[ev_start:]
         mode = ev[0]
         b1, b2, b3 = ev[1], ev[2], ev[3]
-        if version >= 1.9:
+        # The 24-bit-count + float32-rate layout only applies from v1.94
+        # (per the GDF spec and BioSig); GDF 1.90-1.93 still use the v1
+        # layout (3-byte rate + uint32 count).
+        if version >= 1.94:
             n_events = b1 + (b2 << 8) + (b3 << 16)
             cursor = 8  # bytes 4:8 are the float32 event sample rate
         else:
@@ -259,7 +267,9 @@ def write_gdf(path: str | Path, signals: np.ndarray, sfreq: float,
         raise ValueError("n_samples must be a whole number of 1 s records")
     n_records = n_samples // spr
     labels = labels or [f"ch{i}" for i in range(n_channels)]
-    is_v2 = float(version.split(" ")[-1] if " " in version else version) >= 1.9
+    vnum = float(version.split(" ")[-1] if " " in version else version)
+    is_v2 = vnum >= 1.9          # fixed/channel header layout switches at 1.90
+    ev_v2 = vnum >= 1.94         # event-table layout only switches at 1.94
 
     header = bytearray(256)
     header[0:8] = f"GDF {version}".encode("ascii")[:8].ljust(8)
@@ -308,7 +318,7 @@ def write_gdf(path: str | Path, signals: np.ndarray, sfreq: float,
         n_ev = len(event_pos)
         ev = bytearray(8)
         ev[0] = 1  # mode
-        if is_v2:
+        if ev_v2:
             ev[1:4] = struct.pack("<I", n_ev)[:3]
             ev[4:8] = struct.pack("<f", sfreq)
         else:

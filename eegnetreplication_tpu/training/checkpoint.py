@@ -219,5 +219,7 @@ def load_pth(path: str | Path, f2: int, t_prime: int) -> tuple[dict, dict]:
     """Load a reference ``.pth`` into (params, batch_stats) (requires torch)."""
     import torch
 
-    sd = torch.load(Path(path), map_location="cpu")
+    # weights_only=True (torch >= 1.13): state_dicts are plain tensors and
+    # untrusted .pth pickles must not execute code.
+    sd = torch.load(Path(path), map_location="cpu", weights_only=True)
     return from_torch_state_dict(sd, f2, t_prime)

@@ -194,8 +194,8 @@ class App(tk.Tk):
     def _launch(self, cmd: list[str], busy_message: str, success_message: str):
         """Run a CLI module in a daemon thread, streaming output to Logs."""
         def run():
-            self.status_var.set(busy_message)
-            self.progress.start()
+            self._ui(lambda: self.status_var.set(busy_message))
+            self._ui(self.progress.start)
             try:
                 self.run_subprocess(cmd, success_message)
             except Exception as e:  # surface everything; GUI must not die

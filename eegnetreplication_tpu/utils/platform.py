@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import re
+import signal
 import subprocess
 import sys
 
@@ -42,16 +43,33 @@ def probe_accelerator(timeout_s: float = 90.0) -> str | None:
     """
     env = dict(os.environ)
     env.pop("EEGTPU_PLATFORM", None)
+    # Own session + process-group kill: a tunneled backend can spawn helper
+    # processes that inherit the pipes; killing only the direct child would
+    # leave subprocess draining stdout forever (the very hang we guard
+    # against).
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", _PROBE_SRC], capture_output=True,
-            text=True, timeout=timeout_s, env=env,
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _PROBE_SRC], stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL, text=True, env=env,
+            start_new_session=True,
         )
-    except (subprocess.TimeoutExpired, OSError):
+    except OSError:
         return None
-    if out.returncode != 0:
+    try:
+        stdout, _ = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (OSError, AttributeError):
+            proc.kill()
+        try:
+            proc.communicate(timeout=5)
+        except Exception:
+            pass
         return None
-    name = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    if proc.returncode != 0:
+        return None
+    name = stdout.strip().splitlines()[-1] if stdout.strip() else ""
     return name or None
 
 

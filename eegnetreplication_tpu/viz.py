@@ -77,7 +77,9 @@ def load_model_filters(path: str | Path) -> FilterSet:
     elif path.suffix == ".pth":
         import torch
 
-        sd = torch.load(path, map_location="cpu")
+        # weights_only=True (torch >= 1.13): the state_dicts are plain
+        # tensors and untrusted .pth pickles must not execute code.
+        sd = torch.load(path, map_location="cpu", weights_only=True)
         temporal = sd["temporal.0.weight"][:, 0, 0, :].numpy()
         spatial = sd["spatial.weight"][:, 0, :, 0].numpy()
     else:

@@ -210,7 +210,9 @@ bool parse(const uint8_t* data, size_t size, GdfFile* out, char* err,
     const uint8_t* ev = data + ev_start;
     const uint8_t mode = ev[0];
     size_t n_events;
-    if (version >= 1.9) {
+    // 24-bit count + float32 rate only from v1.94 (GDF spec / BioSig);
+    // GDF 1.90-1.93 keep the v1 layout (3-byte rate + uint32 count).
+    if (version >= 1.94) {
       n_events = ev[1] | (ev[2] << 8) | (static_cast<size_t>(ev[3]) << 16);
     } else {
       n_events = read_le<uint32_t>(ev + 4);

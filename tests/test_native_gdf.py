@@ -29,7 +29,9 @@ class TestNativeGDFParity(unittest.TestCase):
                          event_pos=pos, event_typ=typ, version=version)
 
     def test_parity_both_versions(self):
-        for version in ("2.20", "1.25"):
+        # 1.92 exercises the GDF 1.90-1.93 corner: v2-style fixed/channel
+        # headers but the v1 event-table layout (the switch is at 1.94).
+        for version in ("2.20", "1.92", "1.25"):
             with tempfile.TemporaryDirectory() as d:
                 p = self._make(d, version)
                 py = read_gdf_python(p)
