@@ -202,8 +202,6 @@ def _arm_watchdog(record: dict, deadline_s: float) -> "threading.Timer":
     driver's rc-124 timeout with no output.  Best-effort: a hang that
     never releases the GIL can still defeat it.
     """
-    import threading
-
     def fire():
         if not _EMIT_ONCE.acquire(blocking=False):
             return  # main() is already printing the line

@@ -46,9 +46,9 @@ def build_processed_tree(paths: Paths | None = None) -> None:
 
 def main() -> None:
     """CLI entrypoint (flags as in ``dataset.py:334-338``)."""
-    from eegnetreplication_tpu.utils.platform import apply_platform_override
+    from eegnetreplication_tpu.utils.platform import select_platform
 
-    apply_platform_override()
+    select_platform()  # honor EEGTPU_PLATFORM; probe accel; else CPU fallback
     parser = argparse.ArgumentParser(
         description="Preprocess BCI Competition IV Dataset 2a from source.")
     parser.add_argument("--src", default="kaggle",

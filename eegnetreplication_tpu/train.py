@@ -60,9 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main() -> None:
     """CLI entrypoint."""
-    from eegnetreplication_tpu.utils.platform import apply_platform_override
+    from eegnetreplication_tpu.utils.platform import select_platform
 
-    apply_platform_override()
+    select_platform()  # honor EEGTPU_PLATFORM; probe accel; else CPU fallback
     args = build_parser().parse_args()
 
     from eegnetreplication_tpu.parallel import make_mesh

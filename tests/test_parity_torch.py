@@ -111,6 +111,122 @@ def test_eval_forward_parity(C, T):
     np.testing.assert_allclose(flax_out, torch_out, rtol=1e-4, atol=1e-5)
 
 
+def _torch_train_steps(tmodel, x, y, batches, mode, limits):
+    """Reference-semantics torch loop: Adam(1e-3, eps=1e-7) + CE, with the
+    reference's gradient clamp (``model.py:43-44,83-84``) or the paper's true
+    max-norm projection applied per step.  Returns per-step losses."""
+    opt = torch.optim.Adam(tmodel.parameters(), lr=1e-3, eps=1e-7)
+    loss_fn = tnn.CrossEntropyLoss()
+    xt, yt = torch.tensor(x), torch.tensor(y.astype(np.int64))
+    tmodel.train()
+    losses = []
+    for idx in batches:
+        opt.zero_grad()
+        loss = loss_fn(tmodel(xt[idx]), yt[idx])
+        loss.backward()
+        if mode == "reference":
+            for w, lim in limits:
+                w.grad.clamp_(-lim, lim)
+        opt.step()
+        if mode == "paper":
+            with torch.no_grad():
+                for w, lim in limits:
+                    dims = tuple(range(1, w.ndim))  # per-output-filter norm
+                    norms = w.pow(2).sum(dim=dims, keepdim=True).sqrt()
+                    w.mul_(torch.clamp(lim / norms.clamp_min(1e-12), max=1.0))
+        losses.append(float(loss))
+    return losses
+
+
+@pytest.mark.parametrize("mode", ["reference", "paper"])
+def test_training_trajectory_parity(mode):
+    """N jitted train_steps track an independent torch Adam+BN loop.
+
+    Same pool, same transplanted init, same batch order, dropout off
+    (p=0 keeps train-mode BN active while removing the only stochastic
+    element) — the cheapest faithful proxy for full-protocol accuracy
+    parity vs the reference's loop (``model.py:130-148``) in a
+    network-blocked environment.  Covers both max-norm treatments
+    (quirk Q1): the reference's gradient clamp and the paper's weight
+    projection.
+    """
+    from eegnetreplication_tpu.training.checkpoint import from_torch_state_dict
+    from eegnetreplication_tpu.training.steps import (
+        TrainState,
+        make_optimizer,
+        train_step,
+    )
+
+    C, T, B, n_steps = 22, 257, 32, 60
+    rng = np.random.RandomState(3)
+    pool_x = rng.randn(160, C, T).astype(np.float32)
+    pool_y = rng.randint(0, 4, 160).astype(np.int32)
+    batches = []
+    while len(batches) < n_steps:
+        order = rng.permutation(len(pool_x))
+        batches += [order[s:s + B] for s in range(0, len(order), B)]
+    batches = batches[:n_steps]
+
+    model = EEGNet(n_channels=C, n_times=T, dropout_rate=0.0)
+    variables = model.init(jax.random.PRNGKey(7),
+                           jnp.zeros((1, C, T), jnp.float32), train=False)
+    tmodel = build_torch_eegnet(C=C, T=T, p=0.0)
+    transplant_flax_to_torch(variables, tmodel, F2=16, t_prime=T // 32)
+
+    torch_losses = _torch_train_steps(
+        tmodel, pool_x, pool_y, batches, mode,
+        limits=[(tmodel.spatial.weight, 1.0),
+                (tmodel.classifier.weight, 0.25)])
+
+    tx = make_optimizer()
+    state = TrainState.create(variables, tx)
+    step = jax.jit(lambda s, bx, by, key: train_step(
+        model, tx, s, bx, by, jnp.ones(bx.shape[0]), key,
+        maxnorm_mode=mode))
+    jax_losses = []
+    w_ones = jax.random.PRNGKey(0)  # dropout rng unused at p=0
+    for idx in batches:
+        state, loss = step(state, jnp.asarray(pool_x[idx]),
+                           jnp.asarray(pool_y[idx]), w_ones)
+        jax_losses.append(float(loss))
+
+    # Per-step losses must track within float32 drift over 60 steps.
+    np.testing.assert_allclose(jax_losses, torch_losses, rtol=2e-3, atol=2e-3)
+
+    # Final parameters must agree once mapped into the flax layout.
+    # Exception: temporal_bn's affine params have mathematically ZERO
+    # gradient (any per-channel affine shift after temporal_bn is exactly
+    # cancelled by spatial_bn's normalization), so their "gradients" are
+    # float32 noise ~1e-7 that Adam amplifies to O(lr) random walks which
+    # differ between frameworks; bound those by the walk, not by parity.
+    t_params, t_bs = from_torch_state_dict(tmodel.state_dict(), f2=16,
+                                           t_prime=T // 32)
+    j_params = jax.tree_util.tree_map(np.asarray, state.params)
+    noise_walk_bound = 1e-3 * n_steps  # lr * n_steps
+    for layer, leaves in t_params.items():
+        for leaf, tv in leaves.items():
+            jv = j_params[layer][leaf]
+            if layer == "temporal_bn":
+                assert np.max(np.abs(jv - tv)) < noise_walk_bound, (
+                    f"{layer}.{leaf} exceeded the Adam noise-walk bound")
+                continue
+            np.testing.assert_allclose(
+                jv, tv, rtol=5e-3, atol=5e-4,
+                err_msg=f"{layer}.{leaf} diverged after {n_steps} steps "
+                        f"(mode={mode})")
+    # BN running stats: torch uses the unbiased batch var for the running
+    # update, flax the biased one — allow that n/(n-1) factor.  The atol
+    # additionally absorbs the temporal_bn noise walk leaking into the
+    # downstream layers' running means (a ~lr-scale shift of the conv
+    # outputs each step).
+    j_bs = jax.tree_util.tree_map(np.asarray, state.batch_stats)
+    for layer, leaves in t_bs.items():
+        for leaf, tv in leaves.items():
+            np.testing.assert_allclose(
+                j_bs[layer][leaf], tv, rtol=5e-3, atol=2e-2,
+                err_msg=f"batch_stats {layer}.{leaf} diverged (mode={mode})")
+
+
 def test_parity_with_perturbed_bn_stats():
     """Parity must hold with non-trivial running stats, not just init."""
     model = EEGNet()
