@@ -55,6 +55,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Comma-separated subject ids (default: 1-9).")
     parser.add_argument("--profileDir", type=str, default=None,
                         help="Write a jax.profiler trace (TensorBoard) here.")
+    parser.add_argument("--checkpointEvery", type=int, default=0,
+                        help="Snapshot the run every N epochs (0 = off); a "
+                             "crashed run restarts from the last snapshot "
+                             "with --resume instead of epoch 0.")
+    parser.add_argument("--resume", action="store_true",
+                        help="Resume from the run snapshot if one exists "
+                             "(requires --checkpointEvery).")
     return parser
 
 
@@ -63,7 +70,13 @@ def main() -> None:
     from eegnetreplication_tpu.utils.platform import select_platform
 
     select_platform()  # honor EEGTPU_PLATFORM; probe accel; else CPU fallback
-    args = build_parser().parse_args()
+    parser = build_parser()
+    args = parser.parse_args()
+    if args.checkpointEvery < 0:
+        parser.error("--checkpointEvery must be >= 0")
+    if args.resume and not args.checkpointEvery:
+        parser.error("--resume requires --checkpointEvery (the snapshot "
+                     "cadence must match a resumable run)")
 
     from eegnetreplication_tpu.parallel import make_mesh
     from eegnetreplication_tpu.training.protocols import (
@@ -103,7 +116,9 @@ def main() -> None:
             result = within_subject_training(epochs=args.epochs, config=config,
                                              seed=args.seed, mesh=mesh,
                                              model_name=args.model,
-                                             subjects=subjects)
+                                             subjects=subjects,
+                                             checkpoint_every=args.checkpointEvery,
+                                             resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         if args.generateReport:
@@ -117,7 +132,9 @@ def main() -> None:
             result = cross_subject_training(epochs=args.epochs, config=config,
                                             seed=args.seed, mesh=mesh,
                                             model_name=args.model,
-                                            subjects=subjects)
+                                            subjects=subjects,
+                                            checkpoint_every=args.checkpointEvery,
+                                            resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         if args.generateReport:

@@ -85,6 +85,64 @@ def load_checkpoint(path: str | Path) -> tuple[dict, dict, dict]:
             _unflatten(flat, "batch_stats" + SEP), metadata)
 
 
+def save_run_snapshot(path: str | Path, carry: Any,
+                      metrics: dict[str, np.ndarray], epochs_done: int,
+                      signature: dict) -> Path:
+    """Persist a mid-protocol training snapshot (all folds' carry + metrics).
+
+    ``carry`` is the stacked epoch-scan carry from
+    :func:`~eegnetreplication_tpu.training.loop.make_multi_fold_segment`;
+    its leaves are stored positionally and poured back into a
+    freshly-constructed template on load (same trick as the optimizer state
+    in :func:`save_checkpoint`).  ``signature`` identifies the run (protocol,
+    epochs, seed, ...) so a stale snapshot is never resumed into a different
+    run.  Written atomically (tmp file + rename) so a crash mid-save leaves
+    the previous snapshot intact.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    flat = {
+        f"carry{SEP}{i}": np.asarray(leaf)
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(carry))
+    }
+    for name, arr in metrics.items():
+        flat[f"metric{SEP}{name}"] = np.asarray(arr)
+    flat["__epochs_done__"] = np.asarray(epochs_done, np.int64)
+    flat["__signature__"] = np.frombuffer(
+        json.dumps(signature, sort_keys=True).encode(), dtype=np.uint8)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **flat)
+    tmp.replace(path)
+    return path
+
+
+def load_run_snapshot(path: str | Path, carry_template: Any,
+                      signature: dict) -> tuple[Any, dict, int]:
+    """Restore a run snapshot; returns ``(carry, metrics, epochs_done)``.
+
+    Raises ``ValueError`` if the stored signature does not match — resuming
+    into a different protocol/epoch-count/seed would silently corrupt the
+    science.
+    """
+    with np.load(Path(path), allow_pickle=False) as data:
+        flat = {k: data[k] for k in data.files}
+    stored = json.loads(bytes(flat.pop("__signature__")).decode())
+    if stored != signature:
+        raise ValueError(
+            f"Snapshot {path} belongs to a different run: {stored} != "
+            f"{signature}. Delete it or rerun without --resume.")
+    epochs_done = int(flat.pop("__epochs_done__"))
+    carry_keys = sorted((k for k in flat if k.startswith("carry" + SEP)),
+                        key=lambda k: int(k.split(SEP)[1]))
+    treedef = jax.tree_util.tree_structure(carry_template)
+    carry = jax.tree_util.tree_unflatten(treedef,
+                                         [flat[k] for k in carry_keys])
+    metrics = {k[len("metric" + SEP):]: v for k, v in flat.items()
+               if k.startswith("metric" + SEP)}
+    return carry, metrics, epochs_done
+
+
 def load_train_state(path: str | Path, tx) -> tuple[Any, int, dict]:
     """Load a resumable checkpoint into ``(TrainState, step, metadata)``.
 

@@ -135,19 +135,21 @@ def evaluate_pool(model, state: TrainState, pool_x, pool_y, idx, n,
     return 100.0 * total_correct / jnp.maximum(n, 1)
 
 
-def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
-                      train_pad: int, val_pad: int, test_pad: int,
-                      maxnorm_mode: str = "reference"):
-    """Build ``fold_trainer(pool_x, pool_y, spec, init_state, key) -> FoldResult``.
+def make_epoch_scanner(model, tx, *, batch_size: int,
+                       maxnorm_mode: str = "reference"):
+    """Build ``segment(pool_x, pool_y, spec, carry, epoch_keys)``.
 
-    All sizes are static so one compilation serves every fold of a protocol;
-    ``vmap`` the returned function over (spec, init_state, key) to train many
-    folds in one XLA program.
+    The segment scans ``epoch_keys.shape[0]`` epochs from an explicit carry
+    ``(state, best_state, best_acc, min_val_loss)`` and returns the new carry
+    plus per-epoch ``(train_loss, val_loss, val_acc)`` arrays.  Running a
+    fold as a sequence of segments with the SAME key schedule is bit-identical
+    to one full-length scan — this is what makes mid-run checkpoint/resume
+    possible without giving up epoch fusion.  Index-pad sizes are read from
+    the spec's static shapes at trace time.
     """
-    train_steps = math.ceil(train_pad / batch_size)
-    val_steps = max(1, math.ceil(val_pad / batch_size))
-
     def run_epoch(pool_x, pool_y, spec: FoldSpec, state: TrainState, key):
+        train_steps = math.ceil(spec.train_idx.shape[0] / batch_size)
+        val_steps = max(1, math.ceil(spec.val_idx.shape[0] / batch_size))
         shuffle_key, dropout_key = jax.random.split(key)
         gather_idx, weights = _shuffled_slots(
             shuffle_key, spec.train_idx, spec.train_n, train_steps * batch_size
@@ -200,8 +202,7 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
         val_acc = 100.0 * correct / jnp.maximum(spec.val_n, 1)
         return state, train_loss, val_loss, val_acc
 
-    def fold_trainer(pool_x, pool_y, spec: FoldSpec, init_state: TrainState,
-                     key) -> FoldResult:
+    def segment(pool_x, pool_y, spec: FoldSpec, carry, epoch_keys):
         def epoch_body(carry, epoch_key):
             state, best_state, best_acc, min_loss = carry
             state, train_loss, val_loss, val_acc = run_epoch(
@@ -216,11 +217,35 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
             return ((state, best_state, best_acc, min_loss),
                     (train_loss, val_loss, val_acc))
 
+        return jax.lax.scan(epoch_body, carry, epoch_keys)
+
+    return segment
+
+
+def init_fold_carry(init_state: TrainState):
+    """The epoch-scan carry at epoch 0: ``(state, best, best_acc, min_loss)``."""
+    return (init_state, init_state, jnp.float32(0.0), jnp.float32(jnp.inf))
+
+
+def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
+                      train_pad: int, val_pad: int, test_pad: int,
+                      maxnorm_mode: str = "reference"):
+    """Build ``fold_trainer(pool_x, pool_y, spec, init_state, key) -> FoldResult``.
+
+    All sizes are static so one compilation serves every fold of a protocol;
+    ``vmap`` the returned function over (spec, init_state, key) to train many
+    folds in one XLA program.  (``train_pad``/``val_pad``/``test_pad`` are
+    documentation of the spec shapes; the scanner reads them from the spec.)
+    """
+    del train_pad, val_pad, test_pad  # encoded in the spec's static shapes
+    segment = make_epoch_scanner(model, tx, batch_size=batch_size,
+                                 maxnorm_mode=maxnorm_mode)
+
+    def fold_trainer(pool_x, pool_y, spec: FoldSpec, init_state: TrainState,
+                     key) -> FoldResult:
         epoch_keys = jax.random.split(key, epochs)
-        init_carry = (init_state, init_state, jnp.float32(0.0),
-                      jnp.float32(jnp.inf))
-        (state, best_state, best_acc, min_loss), per_epoch = jax.lax.scan(
-            epoch_body, init_carry, epoch_keys
+        (state, best_state, best_acc, min_loss), per_epoch = segment(
+            pool_x, pool_y, spec, init_fold_carry(init_state), epoch_keys
         )
         train_losses, val_losses, val_accs = per_epoch
         test_acc = evaluate_pool(
@@ -281,6 +306,37 @@ def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
         return jax.jit(vmapped)
     return jax.jit(shard_over_fold_axis(
         vmapped, mesh, fold_axis, mapped=(False, False, True, True, True)))
+
+
+def make_multi_fold_segment(model, tx, *, batch_size: int,
+                            maxnorm_mode: str = "reference",
+                            mesh=None, fold_axis: str = "fold"):
+    """Vmapped, jitted epoch-segment runner for chunked (resumable) training.
+
+    ``segment(pool_x, pool_y, specs, carry, epoch_keys)``: all of ``specs``,
+    the carry leaves and ``epoch_keys`` carry a leading fold dimension;
+    ``epoch_keys`` is ``(n_folds, n_epochs_in_chunk, 2)``.  Chaining segments
+    over consecutive key slices is bit-identical to one full scan, which is
+    what lets protocols checkpoint between chunks (SURVEY §5: the reference
+    cannot resume mid-run at all).
+    """
+    segment = make_epoch_scanner(model, tx, batch_size=batch_size,
+                                 maxnorm_mode=maxnorm_mode)
+    vmapped = jax.vmap(segment, in_axes=(None, None, 0, 0, 0))
+    if mesh is None:
+        return jax.jit(vmapped)
+    return jax.jit(shard_over_fold_axis(
+        vmapped, mesh, fold_axis, mapped=(False, False, True, True, True)))
+
+
+def make_multi_fold_evaluator(model, *, batch_size: int):
+    """Vmapped, jitted test evaluation: ``(pool_x, pool_y, specs, states)`` ->
+    per-fold test accuracy (percentage)."""
+    def eval_one(pool_x, pool_y, spec: FoldSpec, state: TrainState):
+        return evaluate_pool(model, state, pool_x, pool_y, spec.test_idx,
+                             spec.test_n, batch_size)
+
+    return jax.jit(jax.vmap(eval_one, in_axes=(None, None, 0, 0)))
 
 
 def init_fold_states(model, tx, n_folds: int, sample_shape, seed: int = 0):

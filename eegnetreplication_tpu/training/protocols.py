@@ -27,6 +27,7 @@ from __future__ import annotations
 import math
 import time
 from dataclasses import dataclass
+from pathlib import Path
 from typing import Any, Callable
 
 import jax
@@ -43,9 +44,13 @@ from eegnetreplication_tpu.data.splits import (
 from eegnetreplication_tpu.models import EEGNet, get_model
 from eegnetreplication_tpu.training import checkpoint as ckpt_lib
 from eegnetreplication_tpu.training.loop import (
+    FoldResult,
     FoldSpec,
+    init_fold_carry,
     init_fold_states,
     make_fold_spec,
+    make_multi_fold_evaluator,
+    make_multi_fold_segment,
     make_multi_fold_trainer,
 )
 from eegnetreplication_tpu.training.steps import make_optimizer
@@ -96,8 +101,20 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
-               config: TrainingConfig, epochs: int, seed: int, mesh=None):
-    """Train all folds in one compiled program; returns stacked FoldResult."""
+               config: TrainingConfig, epochs: int, seed: int, mesh=None,
+               checkpoint_every: int | None = None,
+               checkpoint_path=None, resume: bool = False,
+               signature: dict | None = None,
+               _crash_after_chunk: int | None = None):
+    """Train all folds fused; returns stacked FoldResult.
+
+    Without ``checkpoint_every`` the whole run is ONE compiled program (the
+    round-1 design).  With it, the epoch scan runs in chunks of that many
+    epochs with a run snapshot persisted between chunks — same key schedule,
+    bit-identical results — so a crash at epoch 490/500 resumes from the last
+    chunk boundary instead of epoch 0 (the reference cannot resume at all,
+    SURVEY §5).  ``_crash_after_chunk`` is a test-only fault-injection hook.
+    """
     tx = make_optimizer(config.learning_rate, config.adam_eps)
     n_folds = len(specs)
     train_pad = specs[0].train_idx.shape[0]
@@ -109,12 +126,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                               (pool_x.shape[1], pool_x.shape[2]), seed=seed)
     keys = jax.random.split(jax.random.PRNGKey(seed + 1), n_folds)
 
-    trainer = make_multi_fold_trainer(
-        model, tx, batch_size=config.batch_size, epochs=epochs,
-        train_pad=train_pad, val_pad=val_pad, test_pad=test_pad,
-        maxnorm_mode=config.maxnorm_mode, mesh=mesh,
-    )
-
+    padded = n_folds
     if mesh is not None:
         # Pad the fold axis to a multiple of the mesh's fold-axis size so the
         # shard is even; surplus folds repeat fold 0 and are dropped after.
@@ -131,13 +143,101 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             states = jax.tree_util.tree_map(pad_leaf, states)
             keys = pad_leaf(keys)
 
+    pool_x, pool_y = jnp.asarray(pool_x), jnp.asarray(pool_y)
+
+    if checkpoint_every is not None and checkpoint_every < 0:
+        raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+    if not checkpoint_every:
+        trainer = make_multi_fold_trainer(
+            model, tx, batch_size=config.batch_size, epochs=epochs,
+            train_pad=train_pad, val_pad=val_pad, test_pad=test_pad,
+            maxnorm_mode=config.maxnorm_mode, mesh=mesh,
+        )
+        t0 = time.perf_counter()
+        results = trainer(pool_x, pool_y, stacked, states, keys)
+        results = jax.block_until_ready(results)
+        wall = time.perf_counter() - t0
+        if padded != n_folds:
+            results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds],
+                                             results)
+        return results, wall
+
+    # --- chunked, resumable path ---
+    # padded_folds in the signature: a snapshot from a different device
+    # topology (different fold padding) must not pour into this template.
+    signature = dict(signature or {}, epochs=epochs, n_folds=n_folds,
+                     padded_folds=padded, seed=seed)
+    if epochs % checkpoint_every:
+        logger.warning(
+            "epochs (%d) is not a multiple of checkpoint_every (%d): the "
+            "final %d-epoch chunk compiles a second XLA program",
+            epochs, checkpoint_every, epochs % checkpoint_every)
+    segment = make_multi_fold_segment(
+        model, tx, batch_size=config.batch_size,
+        maxnorm_mode=config.maxnorm_mode, mesh=mesh)
+    # Same key schedule as the fused path: split(key, epochs) per fold.
+    epoch_keys = jax.vmap(lambda k: jax.random.split(k, epochs))(keys)
+    carry = jax.vmap(init_fold_carry)(states)
+    metrics = {"train_losses": [], "val_losses": [], "val_accuracies": []}
+    start_epoch = 0
+
+    if resume and checkpoint_path is not None:
+        if Path(checkpoint_path).exists():
+            carry, stored, start_epoch = ckpt_lib.load_run_snapshot(
+                checkpoint_path, carry, signature)
+            for name in metrics:
+                metrics[name] = [stored[name]]
+            logger.info("Resuming from %s at epoch %d", checkpoint_path,
+                        start_epoch)
+        else:
+            logger.warning(
+                "--resume requested but no snapshot at %s; training from "
+                "scratch (check the model/protocol names match the crashed "
+                "run)", checkpoint_path)
+
     t0 = time.perf_counter()
-    results = trainer(jnp.asarray(pool_x), jnp.asarray(pool_y), stacked,
-                      states, keys)
-    results = jax.block_until_ready(results)
+    chunk_no = 0
+    for lo in range(start_epoch, epochs, checkpoint_every):
+        hi = min(lo + checkpoint_every, epochs)
+        carry, per_epoch = segment(pool_x, pool_y, stacked, carry,
+                                   epoch_keys[:, lo:hi])
+        carry = jax.block_until_ready(carry)
+        for name, arr in zip(
+                ("train_losses", "val_losses", "val_accuracies"), per_epoch):
+            metrics[name].append(np.asarray(arr))
+        if checkpoint_path is not None:
+            ckpt_lib.save_run_snapshot(
+                checkpoint_path, carry,
+                {k: np.concatenate(v, axis=1) for k, v in metrics.items()},
+                epochs_done=hi, signature=signature)
+            logger.info("Checkpointed %d/%d epochs to %s", hi, epochs,
+                        checkpoint_path)
+        chunk_no += 1
+        if _crash_after_chunk is not None and chunk_no >= _crash_after_chunk:
+            raise RuntimeError(f"injected crash after chunk {chunk_no}")
+
+    _, best_state, best_acc, min_loss = carry
+    evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size)
+    test_acc = jax.block_until_ready(
+        evaluator(pool_x, pool_y, stacked, best_state))
     wall = time.perf_counter() - t0
-    if mesh is not None and padded != n_folds:
+
+    results = FoldResult(
+        best_state=best_state,
+        best_val_acc=best_acc,
+        min_val_loss=min_loss,
+        train_losses=jnp.concatenate(
+            [jnp.asarray(a) for a in metrics["train_losses"]], axis=1),
+        val_losses=jnp.concatenate(
+            [jnp.asarray(a) for a in metrics["val_losses"]], axis=1),
+        val_accuracies=jnp.concatenate(
+            [jnp.asarray(a) for a in metrics["val_accuracies"]], axis=1),
+        test_accuracy=test_acc,
+    )
+    if padded != n_folds:
         results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds], results)
+    if checkpoint_path is not None and Path(checkpoint_path).exists():
+        Path(checkpoint_path).unlink()  # complete: snapshot no longer needed
     return results, wall
 
 
@@ -170,7 +270,10 @@ def within_subject_training(epochs: int | None = None, *,
                             seed: int = 0, mesh=None,
                             paths: Paths | None = None,
                             model_name: str = "eegnet",
-                            save_models: bool = True) -> ProtocolResult:
+                            save_models: bool = True,
+                            checkpoint_every: int | None = None,
+                            resume: bool = False,
+                            _crash_after_chunk: int | None = None) -> ProtocolResult:
     """Within-subject protocol: per subject, 4-fold CV over both sessions."""
     epochs = epochs if epochs is not None else config.epochs
     paths = paths or Paths.from_here()
@@ -203,8 +306,14 @@ def within_subject_training(epochs: int | None = None, *,
     logger.info("Training %d folds (%d subjects x %d) for %d epochs, "
                 "fused+vmapped", len(specs), len(subjects),
                 config.kfold_splits, epochs)
-    results, wall = _run_folds(model, specs, pool_x, pool_y, config=config,
-                               epochs=epochs, seed=seed, mesh=mesh)
+    results, wall = _run_folds(
+        model, specs, pool_x, pool_y, config=config, epochs=epochs,
+        seed=seed, mesh=mesh, checkpoint_every=checkpoint_every,
+        checkpoint_path=paths.models / f"within_subject_{model_name}.run.npz",
+        resume=resume,
+        signature={"protocol": "within_subject", "model": model_name,
+                   "subjects": list(subjects)},
+        _crash_after_chunk=_crash_after_chunk)
 
     fold_test = np.asarray(results.test_accuracy)  # (n_subjects*4,)
     fold_best_val = np.asarray(results.best_val_acc)
@@ -235,7 +344,10 @@ def cross_subject_training(epochs: int | None = None, *,
                            seed: int = 0, mesh=None,
                            paths: Paths | None = None,
                            model_name: str = "eegnet",
-                           save_models: bool = True) -> ProtocolResult:
+                           save_models: bool = True,
+                           checkpoint_every: int | None = None,
+                           resume: bool = False,
+                           _crash_after_chunk: int | None = None) -> ProtocolResult:
     """Cross-subject protocol: 5-train/3-val/1-test subjects, 10 repeats."""
     epochs = epochs if epochs is not None else config.epochs
     paths = paths or Paths.from_here()
@@ -278,8 +390,14 @@ def cross_subject_training(epochs: int | None = None, *,
 
     logger.info("Training %d cross-subject folds for %d epochs, fused+vmapped",
                 len(specs), epochs)
-    results, wall = _run_folds(model, specs, pool_x, pool_y, config=config,
-                               epochs=epochs, seed=seed, mesh=mesh)
+    results, wall = _run_folds(
+        model, specs, pool_x, pool_y, config=config, epochs=epochs,
+        seed=seed, mesh=mesh, checkpoint_every=checkpoint_every,
+        checkpoint_path=paths.models / f"cross_subject_{model_name}.run.npz",
+        resume=resume,
+        signature={"protocol": "cross_subject", "model": model_name,
+                   "subjects": list(subjects)},
+        _crash_after_chunk=_crash_after_chunk)
 
     fold_test = np.asarray(results.test_accuracy)
     min_val_loss = np.asarray(results.min_val_loss)

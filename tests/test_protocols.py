@@ -112,3 +112,49 @@ class TestCrossSubject:
         assert report["overall_results"]["standard_error"] == round(
             float(np.std(accs) / np.sqrt(3)), 2)
         assert report["model_info"]["saved_model"] == "cross_subject_best_model.pth"
+
+
+class TestChunkedResume:
+    """Mid-run checkpointing: chunked scans + crash/resume (SURVEY §5)."""
+
+    def _run(self, tmp_paths, **kw):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        return within_subject_training(
+            epochs=6, config=CFG, loader=loader, subjects=(1,),
+            paths=tmp_paths, seed=0, save_models=False, **kw)
+
+    def test_chunked_matches_fused(self, tmp_paths):
+        """Segmenting the epoch scan must be bit-identical to one program."""
+        fused = self._run(tmp_paths)
+        chunked = self._run(tmp_paths, checkpoint_every=2)
+        np.testing.assert_array_equal(chunked.fold_test_acc,
+                                      fused.fold_test_acc)
+        for a, b in zip(chunked.best_states, fused.best_states):
+            for la, lb in zip(*(map(np.asarray, __import__("jax").tree_util
+                                    .tree_leaves(t)) for t in (a, b))):
+                np.testing.assert_array_equal(la, lb)
+        # completed run cleans up its snapshot
+        assert not (tmp_paths.models / "within_subject_eegnet.run.npz").exists()
+
+    def test_crash_and_resume_bit_identical(self, tmp_paths):
+        """Kill after the first chunk; --resume completes to the same result."""
+        uninterrupted = self._run(tmp_paths, checkpoint_every=2)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        snap = tmp_paths.models / "within_subject_eegnet.run.npz"
+        assert snap.exists()
+        resumed = self._run(tmp_paths, checkpoint_every=2, resume=True)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+        assert not snap.exists()
+
+    def test_stale_snapshot_rejected(self, tmp_paths):
+        """A snapshot from a different run must refuse to resume."""
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        with pytest.raises(ValueError, match="different run"):
+            within_subject_training(
+                epochs=4, config=CFG, loader=loader, subjects=(1,),
+                paths=tmp_paths, seed=0, save_models=False,
+                checkpoint_every=2, resume=True)
