@@ -96,7 +96,10 @@ def bench_tpu(x, y, folds) -> tuple[float, float]:
     val_pad = max(len(f[1]) for f in folds)
     test_pad = max(len(f[2]) for f in folds)
 
+    from eegnetreplication_tpu.ops.fused_eegnet import probe_pallas
+
     model = EEGNet(n_channels=C, n_times=T)
+    probe_pallas(model)  # validate/enable the TPU eval kernel before jitting
     tx = make_optimizer()
     trainer = make_multi_fold_trainer(
         model, tx, batch_size=BATCH, epochs=EPOCHS, train_pad=train_pad,

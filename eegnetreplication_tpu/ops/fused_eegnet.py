@@ -31,10 +31,12 @@ block 2/classifier via the regular flax modules) and matches
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
+
+from eegnetreplication_tpu.utils.logging import logger
 
 TEMPORAL_K = 32
 PAD_LEFT = 15   # torch/XLA SAME padding for an even kernel: (15, 16)
@@ -157,13 +159,79 @@ def fused_eval_forward(model, params, batch_stats, x, *,
     and the classifier reuse the regular flax submodule parameters via a
     functional re-implementation (they are a small fraction of the FLOPs).
 
-    ``use_pallas=None`` auto-selects: the Pallas path on TPU backends, the
-    jnp reference elsewhere.  The whole function (BN folding included) is
+    ``use_pallas=None`` auto-selects: the Pallas path when the eager probe
+    (:func:`probe_pallas`) has validated the kernel on this backend, the jnp
+    reference otherwise.  The whole function (BN folding included) is
     jitted, so repeated calls compile once.
     """
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu"
+        # The cache key is shape-based; the supports gate re-checks model
+        # type/dtype so a stock-f32 verdict can't leak onto a subclass or a
+        # non-f32 model sharing the same shapes.
+        use_pallas = (supports_fused_eval(model)
+                      and _PALLAS_OK.get(_pallas_key(model), False))
     return _fused_eval_forward_jit(model, params, batch_stats, x, use_pallas)
+
+
+def supports_fused_eval(model) -> bool:
+    """True when ``model`` is the stock EEGNet the fused kernel encodes.
+
+    ``type`` (not ``isinstance``): a subclass may change the architecture
+    the algebraic fusion hard-codes.  ``EEGTPU_FUSED_EVAL=0`` disables the
+    fused path entirely (escape hatch).
+    """
+    from eegnetreplication_tpu.models.eegnet import EEGNet
+
+    if os.environ.get("EEGTPU_FUSED_EVAL") == "0":
+        return False
+    return type(model) is EEGNet and model.dtype == jnp.float32
+
+
+def _pallas_key(model) -> tuple:
+    return (jax.default_backend(), model.n_channels, model.n_times,
+            model.F1, model.D)
+
+
+_PALLAS_OK: dict[tuple, bool] = {}
+
+
+def probe_pallas(model) -> bool:
+    """Eagerly compile+run the Pallas kernel for this model's shapes.
+
+    Must be called at host level (NOT under a trace) before building jitted
+    programs that might use the kernel: a Pallas kernel that fails to
+    compile on the real backend would otherwise take the whole protocol
+    program down with it.  On failure the fused eval path falls back to the
+    jnp reference — same algebraic fusion, XLA-compiled.  Non-TPU backends
+    always use the reference (interpret-mode Pallas is a test tool, not a
+    product path).  Results are cached per (backend, shape) key.
+    """
+    if jax.default_backend() != "tpu" or not supports_fused_eval(model):
+        return False  # not cached: cheap, and must not poison the shape key
+    key = _pallas_key(model)
+    if key in _PALLAS_OK:
+        return _PALLAS_OK[key]
+    try:
+        f2 = model.F1 * model.D
+        c, t = model.n_channels, model.n_times
+        x = jnp.zeros((2, c, t), jnp.float32)
+        S = jnp.zeros((f2, c), jnp.float32)
+        W = jnp.zeros((f2, TEMPORAL_K), jnp.float32)
+        A = jnp.zeros((f2,), jnp.float32)
+        B = jnp.zeros((f2,), jnp.float32)
+        jax.block_until_ready(block1_pallas(x, S, W, A, B))
+        # The protocols evaluate fold-stacked states under vmap; make sure
+        # the kernel's batching path compiles too.
+        jax.block_until_ready(jax.vmap(
+            lambda s, w, a, b: block1_pallas(x, s, w, a, b)
+        )(S[None], W[None], A[None], B[None]))
+        _PALLAS_OK[key] = True
+        logger.info("Pallas block-1 kernel validated on TPU for %s", key[1:])
+    except Exception as exc:  # noqa: BLE001 — any failure means fall back
+        logger.warning("Pallas block-1 kernel unavailable (%s: %s); eval "
+                       "uses the jnp fused path", type(exc).__name__, exc)
+        _PALLAS_OK[key] = False
+    return _PALLAS_OK[key]
 
 
 @functools.partial(jax.jit, static_argnames=("model", "use_pallas"))

@@ -115,6 +115,16 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     chunk boundary instead of epoch 0 (the reference cannot resume at all,
     SURVEY §5).  ``_crash_after_chunk`` is a test-only fault-injection hook.
     """
+    from eegnetreplication_tpu.ops.fused_eegnet import (
+        probe_pallas,
+        supports_fused_eval,
+    )
+
+    if supports_fused_eval(model):
+        probe_pallas(model)  # host-level: validate the TPU kernel (or fall
+        #                      back to the jnp fused path) BEFORE it is baked
+        #                      into the jitted protocol program
+
     tx = make_optimizer(config.learning_rate, config.adam_eps)
     n_folds = len(specs)
     train_pad = specs[0].train_idx.shape[0]

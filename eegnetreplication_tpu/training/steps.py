@@ -170,9 +170,29 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
     return new_state, jnp.where(has_real, loss, 0.0)
 
 
+def eval_forward(model, params, batch_stats, x):
+    """Eval-mode logits for any model.
+
+    EEGNet routes through the algebraically fused block-1 forward
+    (``ops/fused_eegnet.py``): one (F2,C)@(C,T) matmul replaces the
+    temporal+spatial conv pair, as a Pallas kernel on TPU (when
+    ``probe_pallas`` validated it) or its XLA-compiled jnp twin elsewhere.
+    Other architectures use the plain module apply.
+    """
+    from eegnetreplication_tpu.ops.fused_eegnet import (
+        fused_eval_forward,
+        supports_fused_eval,
+    )
+
+    if supports_fused_eval(model):
+        return fused_eval_forward(model, params, batch_stats, x)
+    logits, _ = apply_model(model, params, batch_stats, x, train=False)
+    return logits
+
+
 def eval_step(model, state: TrainState, x, y, w):
     """Eval-mode forward: returns (batch_loss, n_correct) on real samples."""
-    logits, _ = apply_model(model, state.params, state.batch_stats, x, train=False)
+    logits = eval_forward(model, state.params, state.batch_stats, x)
     loss = weighted_cross_entropy(logits, y, w)
     pred = jnp.argmax(logits, axis=-1)
     correct = jnp.sum((pred == y) * w)

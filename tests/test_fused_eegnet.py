@@ -87,5 +87,52 @@ class TestPallasKernel(unittest.TestCase):
         self._parity(perturb_bn=True, batch=4)
 
 
+class TestProductPathWiring(unittest.TestCase):
+    """The fused forward must be what evaluate_pool/eval_step actually run."""
+
+    def test_eval_step_matches_module_apply(self):
+        from eegnetreplication_tpu.training.steps import (
+            TrainState,
+            eval_forward,
+            eval_step,
+            make_optimizer,
+        )
+
+        model, v, x = _setup(batch=6, perturb_bn=True)
+        state = TrainState.create(v, make_optimizer())
+        y = jnp.asarray(np.random.RandomState(9).randint(0, 4, 6))
+        w = jnp.ones(6)
+
+        logits_fused = eval_forward(model, v["params"], v["batch_stats"], x)
+        logits_apply = model.apply(v, x, train=False)
+        np.testing.assert_allclose(np.asarray(logits_fused),
+                                   np.asarray(logits_apply),
+                                   rtol=1e-4, atol=1e-5)
+        loss, correct = jax.jit(
+            lambda s, bx, by, bw: eval_step(model, s, bx, by, bw)
+        )(state, x, y, w)
+        self.assertTrue(np.isfinite(float(loss)))
+        self.assertTrue(0 <= float(correct) <= 6)
+
+    def test_escape_hatch_disables_fused(self):
+        import os
+
+        from eegnetreplication_tpu.ops.fused_eegnet import supports_fused_eval
+
+        model, _, _ = _setup()
+        self.assertTrue(supports_fused_eval(model))
+        os.environ["EEGTPU_FUSED_EVAL"] = "0"
+        try:
+            self.assertFalse(supports_fused_eval(model))
+        finally:
+            del os.environ["EEGTPU_FUSED_EVAL"]
+
+    def test_probe_is_false_off_tpu(self):
+        from eegnetreplication_tpu.ops.fused_eegnet import probe_pallas
+
+        model, _, _ = _setup()
+        self.assertFalse(probe_pallas(model))  # CPU backend in tests
+
+
 if __name__ == "__main__":
     unittest.main()
