@@ -110,11 +110,21 @@ def _linear_slots(idx, n, n_slots):
     return idx[pos], weights
 
 
+def _shard_slice(arr, data_axis: str, n_shards: int):
+    """This data-shard's contiguous slice of a leading-batch array."""
+    local = arr.shape[0] // n_shards
+    start = jax.lax.axis_index(data_axis) * local
+    return jax.lax.dynamic_slice_in_dim(arr, start, local, axis=0)
+
+
 def evaluate_pool(model, state: TrainState, pool_x, pool_y, idx, n,
-                  batch_size: int) -> jnp.ndarray:
+                  batch_size: int, data_axis: str | None = None,
+                  data_shards: int = 1) -> jnp.ndarray:
     """Accuracy (percentage) of ``state`` on pool[idx[:n]].
 
     TPU-native counterpart of ``evaluate_model`` (``model.py:191-226``).
+    With ``data_axis`` each batch is split across that mesh axis and the
+    correct-counts are ``psum``-reduced (requires running under shard_map).
     """
     n_pad = idx.shape[0]
     n_steps = max(1, math.ceil(n_pad / batch_size))
@@ -122,8 +132,12 @@ def evaluate_pool(model, state: TrainState, pool_x, pool_y, idx, n,
 
     def body(carry, sl):
         batch_idx, w = sl
+        if data_axis is not None:
+            batch_idx = _shard_slice(batch_idx, data_axis, data_shards)
+            w = _shard_slice(w, data_axis, data_shards)
         _, correct = steps_lib.eval_step(
-            model, state, pool_x[batch_idx], pool_y[batch_idx], w
+            model, state, pool_x[batch_idx], pool_y[batch_idx], w,
+            data_axis=data_axis,
         )
         return carry + correct, None
 
@@ -136,7 +150,8 @@ def evaluate_pool(model, state: TrainState, pool_x, pool_y, idx, n,
 
 
 def make_epoch_scanner(model, tx, *, batch_size: int,
-                       maxnorm_mode: str = "reference"):
+                       maxnorm_mode: str = "reference",
+                       data_axis: str | None = None, data_shards: int = 1):
     """Build ``segment(pool_x, pool_y, spec, carry, epoch_keys)``.
 
     The segment scans ``epoch_keys.shape[0]`` epochs from an explicit carry
@@ -146,6 +161,11 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
     to one full-length scan — this is what makes mid-run checkpoint/resume
     possible without giving up epoch fusion.  Index-pad sizes are read from
     the spec's static shapes at trace time.
+
+    With ``data_axis``/``data_shards`` every batch additionally splits over
+    the mesh's data axis (psum grads, synced BN — the model must carry
+    ``bn_axis_name=data_axis``), composing within-fold data parallelism with
+    the fold sharding.
     """
     def run_epoch(pool_x, pool_y, spec: FoldSpec, state: TrainState, key):
         train_steps = math.ceil(spec.train_idx.shape[0] / batch_size)
@@ -158,9 +178,12 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
 
         def train_body(state, inp):
             batch_idx, w, rng = inp
+            if data_axis is not None:
+                batch_idx = _shard_slice(batch_idx, data_axis, data_shards)
+                w = _shard_slice(w, data_axis, data_shards)
             state, loss = steps_lib.train_step(
                 model, tx, state, pool_x[batch_idx], pool_y[batch_idx], w,
-                rng, maxnorm_mode=maxnorm_mode,
+                rng, maxnorm_mode=maxnorm_mode, data_axis=data_axis,
             )
             return state, loss
 
@@ -182,10 +205,14 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
 
         def val_body(carry, sl):
             batch_idx, w = sl
+            has_real = jnp.sum(w) > 0  # global: padding is whole batches
+            if data_axis is not None:
+                batch_idx = _shard_slice(batch_idx, data_axis, data_shards)
+                w = _shard_slice(w, data_axis, data_shards)
             loss, correct = steps_lib.eval_step(
-                model, state, pool_x[batch_idx], pool_y[batch_idx], w
+                model, state, pool_x[batch_idx], pool_y[batch_idx], w,
+                data_axis=data_axis,
             )
-            has_real = jnp.sum(w) > 0
             loss_sum, correct_sum = carry
             return (loss_sum + jnp.where(has_real, loss, 0.0),
                     correct_sum + correct), None
@@ -229,7 +256,8 @@ def init_fold_carry(init_state: TrainState):
 
 def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
                       train_pad: int, val_pad: int, test_pad: int,
-                      maxnorm_mode: str = "reference"):
+                      maxnorm_mode: str = "reference",
+                      data_axis: str | None = None, data_shards: int = 1):
     """Build ``fold_trainer(pool_x, pool_y, spec, init_state, key) -> FoldResult``.
 
     All sizes are static so one compilation serves every fold of a protocol;
@@ -239,7 +267,8 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
     """
     del train_pad, val_pad, test_pad  # encoded in the spec's static shapes
     segment = make_epoch_scanner(model, tx, batch_size=batch_size,
-                                 maxnorm_mode=maxnorm_mode)
+                                 maxnorm_mode=maxnorm_mode,
+                                 data_axis=data_axis, data_shards=data_shards)
 
     def fold_trainer(pool_x, pool_y, spec: FoldSpec, init_state: TrainState,
                      key) -> FoldResult:
@@ -250,7 +279,7 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
         train_losses, val_losses, val_accs = per_epoch
         test_acc = evaluate_pool(
             model, best_state, pool_x, pool_y, spec.test_idx, spec.test_n,
-            batch_size,
+            batch_size, data_axis=data_axis, data_shards=data_shards,
         )
         return FoldResult(
             best_state=best_state,
@@ -282,6 +311,20 @@ def shard_over_fold_axis(fn, mesh, fold_axis: str, mapped: tuple[bool, ...]):
                      out_specs=P(fold_axis), check_rep=False)
 
 
+def _mesh_data_sharding(mesh, batch_size: int):
+    """Derive (data_axis, data_shards) from the mesh's data axis, validated."""
+    from eegnetreplication_tpu.parallel.mesh import DATA_AXIS
+
+    n_data = int(mesh.shape.get(DATA_AXIS, 1)) if mesh is not None else 1
+    if n_data <= 1:
+        return None, 1
+    if batch_size % n_data:
+        raise ValueError(
+            f"batch_size {batch_size} is not divisible by the mesh data "
+            f"axis ({n_data}); pick batch_size % meshData == 0")
+    return DATA_AXIS, n_data
+
+
 def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
                             train_pad: int, val_pad: int, test_pad: int,
                             maxnorm_mode: str = "reference",
@@ -295,10 +338,21 @@ def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
     cross-device traffic — run-level parallelism, the TPU answer to the
     reference's sequential 36/90-fold loops (SURVEY rows P1-P3).  The fold
     count must be a multiple of the mesh's fold-axis size (callers pad).
+
+    A mesh data axis > 1 additionally splits every batch within each fold
+    across that axis (psum grads + synced BN; the model must be built with
+    ``bn_axis_name="data"``), composing DP with the fold sharding.
     """
+    data_axis, data_shards = _mesh_data_sharding(mesh, batch_size)
+    if data_axis is not None and getattr(model, "bn_axis_name", None) != data_axis:
+        raise ValueError(
+            f"mesh data axis is {data_shards}-wide but the model was built "
+            f"with bn_axis_name={getattr(model, 'bn_axis_name', None)!r}; "
+            f"pass bn_axis_name={data_axis!r} for synced BatchNorm under DP")
     fold_trainer = make_fold_trainer(
         model, tx, batch_size=batch_size, epochs=epochs, train_pad=train_pad,
         val_pad=val_pad, test_pad=test_pad, maxnorm_mode=maxnorm_mode,
+        data_axis=data_axis, data_shards=data_shards,
     )
     vmapped = jax.vmap(fold_trainer, in_axes=(None, None, 0, 0, 0))
 
@@ -320,8 +374,15 @@ def make_multi_fold_segment(model, tx, *, batch_size: int,
     what lets protocols checkpoint between chunks (SURVEY §5: the reference
     cannot resume mid-run at all).
     """
+    data_axis, data_shards = _mesh_data_sharding(mesh, batch_size)
+    if data_axis is not None and getattr(model, "bn_axis_name", None) != data_axis:
+        raise ValueError(
+            f"mesh data axis is {data_shards}-wide but the model was built "
+            f"with bn_axis_name={getattr(model, 'bn_axis_name', None)!r}; "
+            f"pass bn_axis_name={data_axis!r} for synced BatchNorm under DP")
     segment = make_epoch_scanner(model, tx, batch_size=batch_size,
-                                 maxnorm_mode=maxnorm_mode)
+                                 maxnorm_mode=maxnorm_mode,
+                                 data_axis=data_axis, data_shards=data_shards)
     vmapped = jax.vmap(segment, in_axes=(None, None, 0, 0, 0))
     if mesh is None:
         return jax.jit(vmapped)

@@ -100,6 +100,15 @@ def _round_up(n: int, multiple: int) -> int:
     return multiple * math.ceil(max(n, 1) / multiple)
 
 
+def _model_kwargs_for_mesh(mesh) -> dict:
+    """Extra model kwargs a mesh demands: synced BN when its data axis > 1."""
+    from eegnetreplication_tpu.parallel.mesh import DATA_AXIS
+
+    if mesh is not None and int(mesh.shape.get(DATA_AXIS, 1)) > 1:
+        return {"bn_axis_name": DATA_AXIS}
+    return {}
+
+
 def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                config: TrainingConfig, epochs: int, seed: int, mesh=None,
                checkpoint_every: int | None = None,
@@ -295,7 +304,8 @@ def within_subject_training(epochs: int | None = None, *,
     pool_x, pool_y, offsets = _build_pool(datasets)
     n_ch, n_t = pool_x.shape[1], pool_x.shape[2]
     model = get_model(model_name, n_channels=n_ch, n_times=n_t,
-                      dropout_rate=config.dropout_within_subject)
+                      dropout_rate=config.dropout_within_subject,
+                      **_model_kwargs_for_mesh(mesh))
 
     # Build the 4 folds per subject (reference fold order preserved).
     raw_folds: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
@@ -378,7 +388,8 @@ def cross_subject_training(epochs: int | None = None, *,
     eval_off = {s: offsets[n_subjects + i] for i, s in enumerate(subjects)}
     n_ch, n_t = pool_x.shape[1], pool_x.shape[2]
     model = get_model(model_name, n_channels=n_ch, n_times=n_t,
-                      dropout_rate=config.dropout_cross_subject)
+                      dropout_rate=config.dropout_cross_subject,
+                      **_model_kwargs_for_mesh(mesh))
 
     raw_folds = []
     fold_count = 0

@@ -104,15 +104,20 @@ def project_paper_maxnorm(params: Any, limits: dict | None = None) -> Any:
 
 
 def weighted_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
-                           weights: jnp.ndarray) -> jnp.ndarray:
+                           weights: jnp.ndarray,
+                           data_axis: str | None = None) -> jnp.ndarray:
     """Mean softmax cross-entropy over samples with weight > 0.
 
     Equals torch ``CrossEntropyLoss()`` (mean reduction) on the real samples
-    of a padded batch.
+    of a padded batch.  With ``data_axis`` the batch is sharded over that
+    mesh axis: the local weighted sum is normalized by the GLOBAL weight sum,
+    so ``psum`` of the per-shard losses equals the full-batch mean.
     """
     ce = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-    denom = jnp.maximum(jnp.sum(weights), 1.0)
-    return jnp.sum(ce * weights) / denom
+    denom = jnp.sum(weights)
+    if data_axis is not None:
+        denom = jax.lax.psum(denom, axis_name=data_axis)
+    return jnp.sum(ce * weights) / jnp.maximum(denom, 1.0)
 
 
 def apply_model(model, params, batch_stats, x, *, train: bool,
@@ -130,20 +135,36 @@ def apply_model(model, params, batch_stats, x, *, train: bool,
 
 
 def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
-               maxnorm_mode: str = "reference"):
+               maxnorm_mode: str = "reference",
+               data_axis: str | None = None):
     """One optimization step on a (possibly padding-weighted) batch.
 
     Returns ``(new_state, batch_loss)``.  If the batch contains no real
     samples (all weights zero), the state is returned unchanged — the
     reference never runs empty batches, so neither do we (and Adam moments
     must not decay on phantom steps).
+
+    With ``data_axis`` the step runs batch-sharded inside a ``shard_map``
+    over that mesh axis: gradients and the loss are ``psum``-reduced, the
+    dropout key is decorrelated per shard, and the model must carry
+    ``bn_axis_name=data_axis`` for cross-shard BatchNorm statistics — the
+    result matches the same global batch on one device.
     """
+    if data_axis is not None:
+        dropout_rng = jax.random.fold_in(
+            dropout_rng, jax.lax.axis_index(data_axis))
+
     def loss_fn(params):
         logits, new_bs = apply_model(model, params, state.batch_stats, x,
                                      train=True, dropout_rng=dropout_rng)
-        return weighted_cross_entropy(logits, y, w), new_bs
+        return weighted_cross_entropy(logits, y, w, data_axis), new_bs
 
     (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)
+    if data_axis is not None:
+        # Loss is normalized by the global weight sum, so shard-gradient and
+        # shard-loss sums equal the full-batch gradient and loss.
+        grads = jax.lax.psum(grads, axis_name=data_axis)
+        loss = jax.lax.psum(loss, axis_name=data_axis)
 
     # Max-norm treatment is per-architecture: models declare their constrained
     # layers (EEGNet does; the ConvNet baselines declare none).
@@ -156,6 +177,8 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
         new_params = project_paper_maxnorm(new_params, limits)
 
     has_real = jnp.sum(w) > 0
+    if data_axis is not None:
+        has_real = jax.lax.psum(jnp.sum(w), axis_name=data_axis) > 0
 
     def select(new, old):
         return jax.tree_util.tree_map(
@@ -190,10 +213,18 @@ def eval_forward(model, params, batch_stats, x):
     return logits
 
 
-def eval_step(model, state: TrainState, x, y, w):
-    """Eval-mode forward: returns (batch_loss, n_correct) on real samples."""
+def eval_step(model, state: TrainState, x, y, w,
+              data_axis: str | None = None):
+    """Eval-mode forward: returns (batch_loss, n_correct) on real samples.
+
+    With ``data_axis`` (batch-sharded under ``shard_map``) both outputs are
+    globally reduced, matching the full batch on one device.
+    """
     logits = eval_forward(model, state.params, state.batch_stats, x)
-    loss = weighted_cross_entropy(logits, y, w)
+    loss = weighted_cross_entropy(logits, y, w, data_axis)
     pred = jnp.argmax(logits, axis=-1)
     correct = jnp.sum((pred == y) * w)
+    if data_axis is not None:
+        loss = jax.lax.psum(loss, axis_name=data_axis)
+        correct = jax.lax.psum(correct, axis_name=data_axis)
     return loss, correct

@@ -101,6 +101,17 @@ class TestCLIBoundary(unittest.TestCase):
             if (self.tmp / "reports").exists() else set()
         self.assertEqual(before, after)
 
+    def test_4_train_cli_data_axis(self):
+        """--meshData 2 composes within-fold DP with the fold sharding on
+        the virtual 8-device mesh (conftest's XLA_FLAGS is inherited)."""
+        proc = _run(["eegnetreplication_tpu.train",
+                     "--trainingType", "Within-Subject", "--epochs", "2",
+                     "--generateReport", "False", "--meshFold", "4",
+                     "--meshData", "2", "--subjects", "1,2"],
+                    self.tmp, timeout=600)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        self.assertIn("'data': 2", proc.stderr + proc.stdout)
+
     def test_fetch_cli_errors_cleanly_without_backend(self):
         proc = _run(["eegnetreplication_tpu.fetch", "--src", "kaggle"],
                     self.tmp, timeout=120)

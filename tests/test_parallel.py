@@ -124,6 +124,33 @@ class TestFoldSharding:
         np.testing.assert_allclose(sharded.fold_test_acc,
                                    plain.fold_test_acc, atol=1e-3)
 
+    def test_ws_protocol_data_sharded_matches_unsharded(self, devices8,
+                                                        tmp_path):
+        """Full protocol with a 2-wide data axis == unsharded result.
+
+        Dropout off: under DP the dropout key decorrelates per shard by
+        design, so exact equivalence is only defined for the deterministic
+        parts (grads psum + synced BN + global-mean loss).
+        """
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        cfg = DEFAULT_TRAINING.replace(batch_size=16,
+                                       dropout_within_subject=0.0)
+        kw = dict(epochs=3, config=cfg, loader=loader, subjects=(1, 2),
+                  save_models=False, seed=0, paths=Paths.from_root(tmp_path))
+        plain = within_subject_training(**kw)
+        dp = within_subject_training(mesh=make_mesh(n_fold=4, n_data=2), **kw)
+        np.testing.assert_allclose(dp.fold_test_acc, plain.fold_test_acc,
+                                   atol=1e-3)
+
+    def test_indivisible_batch_rejected(self, devices8, tmp_path):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        cfg = DEFAULT_TRAINING.replace(batch_size=15)
+        with pytest.raises(ValueError, match="not divisible"):
+            within_subject_training(
+                epochs=2, config=cfg, loader=loader, subjects=(1,),
+                save_models=False, seed=0, paths=Paths.from_root(tmp_path),
+                mesh=make_mesh(n_fold=4, n_data=2))
+
     def test_fold_count_not_divisible_by_devices(self, devices8, tmp_path):
         """8 folds from 3 subjects x 4 = 12 folds over 8 devices: padding."""
         loader = make_loader(n_trials=24, n_channels=4, n_times=64)
