@@ -54,8 +54,12 @@ _EMIT_ONCE = threading.Lock()
 
 C, T, N_POOL, BATCH = 22, 257, 576, 64
 N_FOLDS = 4
-EPOCHS = 2 if os.environ.get("BENCH_SMOKE") else 100
-TORCH_EPOCHS = 1 if os.environ.get("BENCH_SMOKE") else 6
+# The CPU path is the contract-safety fallback, not the measurement of
+# record; run it at smoke scale so the JSON line lands well inside the
+# watchdog deadline (100 epochs of the fused trainer on CPU takes >25 min).
+EPOCHS = (2 if os.environ.get("BENCH_SMOKE")
+          else 100 if PLATFORM != "cpu" else 10)
+TORCH_EPOCHS = 1 if os.environ.get("BENCH_SMOKE") or PLATFORM == "cpu" else 6
 
 
 def _synthetic_pool(seed: int = 0):
@@ -96,10 +100,7 @@ def bench_tpu(x, y, folds) -> tuple[float, float]:
     val_pad = max(len(f[1]) for f in folds)
     test_pad = max(len(f[2]) for f in folds)
 
-    from eegnetreplication_tpu.ops.fused_eegnet import probe_pallas
-
     model = EEGNet(n_channels=C, n_times=T)
-    probe_pallas(model)  # validate/enable the TPU eval kernel before jitting
     tx = make_optimizer()
     trainer = make_multi_fold_trainer(
         model, tx, batch_size=BATCH, epochs=EPOCHS, train_pad=train_pad,
@@ -121,10 +122,63 @@ def bench_tpu(x, y, folds) -> tuple[float, float]:
     t0 = time.perf_counter()
     jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
     compile_s = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
-    dt = time.perf_counter() - t0
-    return N_FOLDS * EPOCHS / dt, compile_s
+    # Timed reps use a DIFFERENT key each time: re-running with inputs
+    # identical to the warmup let the tunneled remote backend serve a cached
+    # result in ~7 ms, inflating round-1-style numbers ~250x.  Median of 3
+    # honest reps.
+    rates = []
+    for rep in range(1, 4):
+        rep_keys = jax.random.split(jax.random.PRNGKey(rep), N_FOLDS)
+        t0 = time.perf_counter()
+        jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
+                                      rep_keys))
+        rates.append(N_FOLDS * EPOCHS / (time.perf_counter() - t0))
+    return float(np.median(rates)), compile_s
+
+
+def bench_eval_kernels() -> dict:
+    """Eval-forward microbench: plain apply vs fused-jnp vs Pallas kernel.
+
+    Measures the standalone inference path (``steps.eval_forward``) the
+    Pallas block-1 kernel serves; the fused *training* programs use the jnp
+    twin (see ``eval_forward``'s docstring for why).  Each variant runs 3
+    reps on distinct inputs (the tunneled backend caches repeat executions).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.ops.fused_eegnet import (
+        fused_eval_forward,
+        probe_pallas,
+    )
+
+    model = EEGNet(n_channels=C, n_times=T)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, C, T)),
+                           train=False)
+    params, bs = variables["params"], variables["batch_stats"]
+    pools = [jnp.asarray(np.random.RandomState(i).randn(N_POOL, C, T),
+                         jnp.float32) for i in range(4)]
+
+    plain = jax.jit(lambda xx: model.apply(
+        {"params": params, "batch_stats": bs}, xx, train=False))
+    variants = {"eval_plain": plain,
+                "eval_fused": lambda xx: fused_eval_forward(
+                    model, params, bs, xx, use_pallas=False)}
+    if probe_pallas(model):
+        variants["eval_pallas"] = lambda xx: fused_eval_forward(
+            model, params, bs, xx, use_pallas=True)
+
+    out = {}
+    for name, fn in variants.items():
+        jax.block_until_ready(fn(pools[0]))  # compile
+        reps = []
+        for i in (1, 2, 3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(pools[i]))
+            reps.append(N_POOL / (time.perf_counter() - t0))
+        out[name + "_trials_per_s"] = round(float(np.median(reps)))
+    return out
 
 
 def bench_torch_reference_style(x, y, folds) -> float:
@@ -242,6 +296,11 @@ def main() -> None:
             vs_baseline=round(ours / baseline, 2),
             baseline=round(baseline, 2),
         )
+        try:
+            record.update(bench_eval_kernels())
+        except Exception as exc:  # noqa: BLE001 — optional add-on: a
+            # failure here must not mark the (already valid) main metric
+            record["eval_bench_error"] = f"{type(exc).__name__}: {exc}"[:200]
     except Exception as exc:  # noqa: BLE001 — contract: always emit the line
         record["error"] = f"{type(exc).__name__}: {exc}"[:300]
     if _EMIT_ONCE.acquire(blocking=False):

@@ -10,5 +10,11 @@ re-export the shared ``logger``.
 """
 
 from eegnetreplication_tpu.utils.logging import logger  # noqa: F401
+from eegnetreplication_tpu.utils.platform import apply_platform_override
+
+# Honor EEGTPU_PLATFORM for EVERY entry point (examples, user scripts, REPLs)
+# — not just the CLIs.  No-op unless the env var is set; must run before the
+# first JAX backend init, which package import almost always precedes.
+apply_platform_override()
 
 __version__ = "0.1.0"

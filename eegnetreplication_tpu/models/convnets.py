@@ -52,14 +52,20 @@ class ShallowConvNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        min_t = self.filter_time_length + self.pool_time_length - 1
+        if x.shape[-1] < min_t:
+            raise ValueError(
+                f"ShallowConvNet needs n_times >= {min_t} "
+                f"(filter {self.filter_time_length} + pool "
+                f"{self.pool_time_length}); got {x.shape[-1]}")
         use_ra = not train
         x = x.astype(self.dtype)[..., None]  # (B, C, T, 1)
         x = nn.Conv(self.n_filters_time, (1, self.filter_time_length),
                     padding="VALID", use_bias=False,
-                    kernel_init=torch_kernel_init, dtype=self.dtype,
+                    precision="highest", kernel_init=torch_kernel_init, dtype=self.dtype,
                     name="temporal_conv")(x)
         x = nn.Conv(self.n_filters_spat, (self.n_channels, 1), padding="VALID",
-                    use_bias=False, kernel_init=torch_kernel_init,
+                    use_bias=False, precision="highest", kernel_init=torch_kernel_init,
                     dtype=self.dtype, name="spatial_conv")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -70,7 +76,7 @@ class ShallowConvNet(nn.Module):
         x = _safe_log(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.n_classes, kernel_init=torch_kernel_init,
+        x = nn.Dense(self.n_classes, precision="highest", kernel_init=torch_kernel_init,
                      dtype=self.dtype, name="classifier")(x)
         return x.astype(jnp.float32)
 
@@ -99,15 +105,24 @@ class DeepConvNet(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        t = x.shape[-1]
+        for _ in self.filters:
+            t = (t - (self.kernel_length - 1)) // self.pool_length
+        if t < 1:
+            raise ValueError(
+                f"DeepConvNet's {len(self.filters)} conv/pool blocks "
+                f"(kernel {self.kernel_length}, pool {self.pool_length}) "
+                f"consume n_times={x.shape[-1]} to nothing; need a longer "
+                f"window (>= ~{self.kernel_length * 2 ** len(self.filters)})")
         use_ra = not train
         x = x.astype(self.dtype)[..., None]  # (B, C, T, 1)
 
         # Block 1: temporal conv + spatial conv + BN + ELU + maxpool.
         x = nn.Conv(self.filters[0], (1, self.kernel_length), padding="VALID",
-                    use_bias=False, kernel_init=torch_kernel_init,
+                    use_bias=False, precision="highest", kernel_init=torch_kernel_init,
                     dtype=self.dtype, name="temporal_conv")(x)
         x = nn.Conv(self.filters[0], (self.n_channels, 1), padding="VALID",
-                    use_bias=False, kernel_init=torch_kernel_init,
+                    use_bias=False, precision="highest", kernel_init=torch_kernel_init,
                     dtype=self.dtype, name="spatial_conv")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -119,7 +134,7 @@ class DeepConvNet(nn.Module):
         for i, width in enumerate(self.filters[1:], start=1):
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
             x = nn.Conv(width, (1, self.kernel_length), padding="VALID",
-                        use_bias=False, kernel_init=torch_kernel_init,
+                        use_bias=False, precision="highest", kernel_init=torch_kernel_init,
                         dtype=self.dtype, name=f"conv_{i}")(x)
             x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -129,6 +144,6 @@ class DeepConvNet(nn.Module):
                             strides=(1, self.pool_length))
 
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.n_classes, kernel_init=torch_kernel_init,
+        x = nn.Dense(self.n_classes, precision="highest", kernel_init=torch_kernel_init,
                      dtype=self.dtype, name="classifier")(x)
         return x.astype(jnp.float32)

@@ -69,6 +69,11 @@ class EEGNet(nn.Module):
     momentum: float = 0.9  # = 1 - torch BatchNorm2d momentum (0.1)
     bn_epsilon: float = 1e-5
     dtype: jnp.dtype = jnp.float32
+    # MXU precision for convs/dense.  "highest" keeps TPU matmuls in full
+    # f32 (the backend default rounds operands to bf16, which drifts the
+    # 500-epoch training trajectory away from the torch-f32 reference);
+    # these matmuls are tiny enough that the cost is noise.
+    precision: str | None = "highest"
     # Named mesh axis for cross-device BatchNorm stat sync under data
     # parallelism (None = local-batch stats, the single-device semantics).
     bn_axis_name: str | None = None
@@ -89,6 +94,7 @@ class EEGNet(nn.Module):
         # --- Block 1: temporal filter bank + depthwise spatial filters ---
         x = nn.Conv(self.F1, (1, 32), padding="SAME", use_bias=False,
                     kernel_init=torch_kernel_init, dtype=self.dtype,
+                    precision=self.precision,
                     name="temporal_conv")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -97,6 +103,7 @@ class EEGNet(nn.Module):
         x = nn.Conv(self.D * self.F1, (self.n_channels, 1), padding="VALID",
                     feature_group_count=self.F1, use_bias=False,
                     kernel_init=torch_kernel_init, dtype=self.dtype,
+                    precision=self.precision,
                     name="spatial_conv")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -110,9 +117,11 @@ class EEGNet(nn.Module):
         x = nn.Conv(self.D * self.F1, (1, 16), padding="SAME",
                     feature_group_count=self.D * self.F1, use_bias=False,
                     kernel_init=torch_kernel_init, dtype=self.dtype,
+                    precision=self.precision,
                     name="separable_depthwise")(x)
         x = nn.Conv(self.F2, (1, 1), padding="SAME", use_bias=False,
                     kernel_init=torch_kernel_init, dtype=self.dtype,
+                    precision=self.precision,
                     name="separable_pointwise")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -128,7 +137,7 @@ class EEGNet(nn.Module):
         x = nn.Dense(self.n_classes, use_bias=True,
                      kernel_init=torch_kernel_init,
                      bias_init=_torch_bias_init(fan_in), dtype=self.dtype,
-                     name="classifier")(x)
+                     precision=self.precision, name="classifier")(x)
         return x.astype(jnp.float32)
 
 

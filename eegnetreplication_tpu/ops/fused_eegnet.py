@@ -87,7 +87,8 @@ def _elu(x):
 
 def block1_reference(x, S, W, A, B):
     """jnp twin of the fused kernel: ``(B, C, T) -> (B, F2, T_pool)``."""
-    mixed = jnp.einsum("fc,bct->bft", S, x)
+    mixed = jnp.einsum("fc,bct->bft", S, x,
+                       precision=jax.lax.Precision.HIGHEST)
     padded = jnp.pad(mixed, ((0, 0), (0, 0), (PAD_LEFT, PAD_RIGHT)))
     t = x.shape[-1]
     acc = jnp.zeros_like(mixed)
@@ -106,14 +107,27 @@ def _block1_kernel(x_ref, s_ref, w_ref, a_ref, b_ref, out_ref):
     out_ref: (1, F2, T_pool).
     """
     t = out_ref.shape[-1] * 4
+    # HIGHEST: keep the MXU in full f32 (default bf16 rounding costs ~1e-3
+    # abs error vs the f32 reference; these matmuls are tiny).
     mixed = jnp.dot(s_ref[:], x_ref[0],
-                    preferred_element_type=jnp.float32)    # (F2, T+31) on MXU
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)   # (F2, T+31) on MXU
     acc = jnp.zeros((s_ref.shape[0], t), jnp.float32)
     for k in range(TEMPORAL_K):                            # static unroll, VPU
         acc = acc + w_ref[:, k:k + 1] * mixed[:, k:k + t]
-    act = _elu(a_ref[:] * acc + b_ref[:])                  # (F2,1) broadcasts
-    pooled = act.reshape(act.shape[0], -1, 4)
-    out_ref[0] = jnp.mean(pooled, axis=-1)
+    pre = a_ref[:] * acc + b_ref[:]                        # (F2,1) broadcasts
+    # expm1 has no Pallas TPU lowering; exp-1 differs by <1e-7 abs in f32
+    # over ELU's negative branch, within the kernel's parity tolerance.
+    act = jnp.where(pre > 0, pre, jnp.exp(pre) - 1.0)
+    # AvgPool(4) as a matmul: Mosaic rejects the (F2,T)->(F2,T/4,4) lane
+    # reshape ("unsupported shape cast"), so pool on the MXU instead with a
+    # one-hot/4 pooling matrix built from iota.
+    t_pool = out_ref.shape[-1]
+    rows = jax.lax.broadcasted_iota(jnp.int32, (t, t_pool), 0)
+    cols = jax.lax.broadcasted_iota(jnp.int32, (t, t_pool), 1)
+    pool = jnp.where(rows // 4 == cols, 0.25, 0.0)
+    out_ref[0] = jnp.dot(act, pool, preferred_element_type=jnp.float32,
+                         precision=jax.lax.Precision.HIGHEST)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -177,14 +191,18 @@ def supports_fused_eval(model) -> bool:
     """True when ``model`` is the stock EEGNet the fused kernel encodes.
 
     ``type`` (not ``isinstance``): a subclass may change the architecture
-    the algebraic fusion hard-codes.  ``EEGTPU_FUSED_EVAL=0`` disables the
-    fused path entirely (escape hatch).
+    the algebraic fusion hard-codes.  The precision gate matters too: the
+    fused path computes in ``Precision.HIGHEST``, so a model configured for
+    default (bf16-on-TPU) matmuls would get different eval numerics than its
+    own ``model.apply`` — such models use the plain forward instead.
+    ``EEGTPU_FUSED_EVAL=0`` disables the fused path entirely (escape hatch).
     """
     from eegnetreplication_tpu.models.eegnet import EEGNet
 
     if os.environ.get("EEGTPU_FUSED_EVAL") == "0":
         return False
-    return type(model) is EEGNet and model.dtype == jnp.float32
+    return (type(model) is EEGNet and model.dtype == jnp.float32
+            and model.precision == "highest")
 
 
 def _pallas_key(model) -> tuple:
@@ -247,11 +265,13 @@ def _fused_eval_forward_jit(model, params, batch_stats, x, use_pallas):
     h = jax.lax.conv_general_dilated(
         h, w_dw, window_strides=(1, 1), padding="SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
-        feature_group_count=h.shape[-1])
+        feature_group_count=h.shape[-1],
+        precision=jax.lax.Precision.HIGHEST)
     w_pw = params["separable_pointwise"]["kernel"]   # (1, 1, F2, F2)
     h = jax.lax.conv_general_dilated(
         h, w_pw, window_strides=(1, 1), padding="SAME",
-        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=jax.lax.Precision.HIGHEST)
     bn_p, bn_s = params["block2_bn"], batch_stats["block2_bn"]
     inv = 1.0 / jnp.sqrt(bn_s["var"] + model.bn_epsilon)
     h = (h - bn_s["mean"]) * inv * bn_p["scale"] + bn_p["bias"]
@@ -259,4 +279,6 @@ def _fused_eval_forward_jit(model, params, batch_stats, x, use_pallas):
     b_, _, t_, f_ = h.shape
     h = h[:, :, : (t_ // 8) * 8, :].reshape(b_, 1, t_ // 8, 8, f_).mean(axis=3)
     h = h.reshape(b_, -1)
-    return h @ params["classifier"]["kernel"] + params["classifier"]["bias"]
+    return (jnp.dot(h, params["classifier"]["kernel"],
+                    precision=jax.lax.Precision.HIGHEST)
+            + params["classifier"]["bias"])

@@ -76,13 +76,8 @@ def permutation_test(X: np.ndarray, y: np.ndarray, *,
 
     model = get_model(model_name, n_channels=X.shape[1], n_times=X.shape[2],
                       dropout_rate=config.dropout_within_subject)
-    from eegnetreplication_tpu.ops.fused_eegnet import (
-        probe_pallas,
-        supports_fused_eval,
-    )
-
-    if supports_fused_eval(model):
-        probe_pallas(model)  # host-level: enable the TPU eval kernel if valid
+    # In-program eval uses the fused jnp path (eval_step pins
+    # allow_pallas=False inside large scanned programs; see steps.py).
     tx = make_optimizer(config.learning_rate, config.adam_eps)
     spec = make_fold_spec(train_ids, val_ids, test_ids,
                           train_pad=len(train_ids), val_pad=len(val_ids),

@@ -25,7 +25,6 @@ Protocol-defining details reproduced exactly:
 from __future__ import annotations
 
 import math
-import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable
@@ -55,6 +54,7 @@ from eegnetreplication_tpu.training.loop import (
 )
 from eegnetreplication_tpu.training.steps import make_optimizer
 from eegnetreplication_tpu.utils.logging import logger
+from eegnetreplication_tpu.utils.profiling import StepTimer
 
 LoadFn = Callable[[int, str], BCICI2ADataset]
 
@@ -124,16 +124,10 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     chunk boundary instead of epoch 0 (the reference cannot resume at all,
     SURVEY §5).  ``_crash_after_chunk`` is a test-only fault-injection hook.
     """
-    from eegnetreplication_tpu.ops.fused_eegnet import (
-        probe_pallas,
-        supports_fused_eval,
-    )
-
-    if supports_fused_eval(model):
-        probe_pallas(model)  # host-level: validate the TPU kernel (or fall
-        #                      back to the jnp fused path) BEFORE it is baked
-        #                      into the jitted protocol program
-
+    # The protocol programs use the algebraically fused jnp eval path only;
+    # the Pallas kernel stays out of these large scanned programs (it
+    # multiplies their Mosaic+XLA compile time ~20x on the real TPU) and
+    # serves the standalone inference API (steps.eval_forward) instead.
     tx = make_optimizer(config.learning_rate, config.adam_eps)
     n_folds = len(specs)
     train_pad = specs[0].train_idx.shape[0]
@@ -172,10 +166,11 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             train_pad=train_pad, val_pad=val_pad, test_pad=test_pad,
             maxnorm_mode=config.maxnorm_mode, mesh=mesh,
         )
-        t0 = time.perf_counter()
-        results = trainer(pool_x, pool_y, stacked, states, keys)
-        results = jax.block_until_ready(results)
-        wall = time.perf_counter() - t0
+        timer = StepTimer()
+        with timer:
+            results = trainer(pool_x, pool_y, stacked, states, keys)
+            results = jax.block_until_ready(results)
+        wall = timer.total
         if padded != n_folds:
             results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds],
                                              results)
@@ -214,13 +209,14 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                 "scratch (check the model/protocol names match the crashed "
                 "run)", checkpoint_path)
 
-    t0 = time.perf_counter()
+    timer = StepTimer()
     chunk_no = 0
     for lo in range(start_epoch, epochs, checkpoint_every):
         hi = min(lo + checkpoint_every, epochs)
-        carry, per_epoch = segment(pool_x, pool_y, stacked, carry,
-                                   epoch_keys[:, lo:hi])
-        carry = jax.block_until_ready(carry)
+        with timer:
+            carry, per_epoch = segment(pool_x, pool_y, stacked, carry,
+                                       epoch_keys[:, lo:hi])
+            carry = jax.block_until_ready(carry)
         for name, arr in zip(
                 ("train_losses", "val_losses", "val_accuracies"), per_epoch):
             metrics[name].append(np.asarray(arr))
@@ -237,9 +233,10 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
 
     _, best_state, best_acc, min_loss = carry
     evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size)
-    test_acc = jax.block_until_ready(
-        evaluator(pool_x, pool_y, stacked, best_state))
-    wall = time.perf_counter() - t0
+    with timer:
+        test_acc = jax.block_until_ready(
+            evaluator(pool_x, pool_y, stacked, best_state))
+    wall = timer.total
 
     results = FoldResult(
         best_state=best_state,

@@ -193,7 +193,7 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
     return new_state, jnp.where(has_real, loss, 0.0)
 
 
-def eval_forward(model, params, batch_stats, x):
+def eval_forward(model, params, batch_stats, x, allow_pallas: bool = True):
     """Eval-mode logits for any model.
 
     EEGNet routes through the algebraically fused block-1 forward
@@ -201,6 +201,12 @@ def eval_forward(model, params, batch_stats, x):
     temporal+spatial conv pair, as a Pallas kernel on TPU (when
     ``probe_pallas`` validated it) or its XLA-compiled jnp twin elsewhere.
     Other architectures use the plain module apply.
+
+    ``allow_pallas=False`` pins the jnp twin: callers tracing this into a
+    large scanned program (the fused protocol trainers) must use it —
+    embedding the Pallas call in a vmapped multi-epoch scan sends the
+    Mosaic+XLA compile time from ~1 min to >20 min on the real TPU (measured
+    round 2), while the standalone kernel compiles in seconds.
     """
     from eegnetreplication_tpu.ops.fused_eegnet import (
         fused_eval_forward,
@@ -208,19 +214,21 @@ def eval_forward(model, params, batch_stats, x):
     )
 
     if supports_fused_eval(model):
-        return fused_eval_forward(model, params, batch_stats, x)
+        return fused_eval_forward(model, params, batch_stats, x,
+                                  use_pallas=None if allow_pallas else False)
     logits, _ = apply_model(model, params, batch_stats, x, train=False)
     return logits
 
 
 def eval_step(model, state: TrainState, x, y, w,
-              data_axis: str | None = None):
+              data_axis: str | None = None, allow_pallas: bool = False):
     """Eval-mode forward: returns (batch_loss, n_correct) on real samples.
 
     With ``data_axis`` (batch-sharded under ``shard_map``) both outputs are
     globally reduced, matching the full batch on one device.
     """
-    logits = eval_forward(model, state.params, state.batch_stats, x)
+    logits = eval_forward(model, state.params, state.batch_stats, x,
+                          allow_pallas=allow_pallas)
     loss = weighted_cross_entropy(logits, y, w, data_axis)
     pred = jnp.argmax(logits, axis=-1)
     correct = jnp.sum((pred == y) * w)

@@ -1,0 +1,20 @@
+"""Eval-label verification sweep (runnable twin of notebook 06).
+
+Cross-checks every subject's derived trial labels against the competition's
+``TrueLabels/*.mat`` files (``notebooks/06_eval_data.ipynb`` cells 3-10) via
+``eegnetreplication_tpu.data.verify``.  Needs preprocessed data under
+``data/processed`` (run ``python -m eegnetreplication_tpu.dataset`` first).
+
+Usage: python examples/05_verify_labels.py [Train|Eval|both]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from eegnetreplication_tpu.data.verify import main
+
+if __name__ == "__main__":
+    mode = sys.argv[1] if len(sys.argv) > 1 else "both"
+    raise SystemExit(main(["--mode", mode]))

@@ -1,0 +1,119 @@
+"""Tests of the label-verification utility (notebook 06 twin).
+
+The reference validates eval labels manually in
+``notebooks/06_eval_data.ipynb`` cells 3-10; ``data/verify.py`` is the
+runnable equivalent.  These tests build a synthetic processed tree with a
+known cue/label layout and check every verdict the verifier can return.
+"""
+
+import shutil
+import tempfile
+import unittest
+from pathlib import Path
+
+import numpy as np
+from scipy.io import savemat
+
+from eegnetreplication_tpu.config import Paths
+from eegnetreplication_tpu.data.preprocess import ProcessedRecording
+from eegnetreplication_tpu.data.verify import verify_labels, verify_session
+
+SFREQ = 128.0
+
+
+def _write_session(paths: Paths, stem: str, mode: str, cue_typ, classlabel,
+                   n_samples: int = 4000):
+    """One -preprocessed.npz + its TrueLabels .mat."""
+    rng = np.random.RandomState(hash(stem) % 2**31)
+    pos = (np.arange(len(cue_typ)) * 450 + 100).astype(np.int64)
+    rec = ProcessedRecording(
+        data=rng.randn(4, n_samples).astype(np.float32), sfreq=SFREQ,
+        labels=[f"C{i}" for i in range(4)], event_pos=pos,
+        event_typ=np.asarray(cue_typ, np.int64))
+    rec.save(paths.data_processed / mode / f"{stem}-preprocessed.npz")
+    tl = paths.data_raw / "TrueLabels"
+    tl.mkdir(parents=True, exist_ok=True)
+    savemat(tl / f"{stem}.mat", {"classlabel": np.asarray(classlabel)})
+
+
+class TestVerifyLabels(unittest.TestCase):
+    def setUp(self):
+        self.tmp = Path(tempfile.mkdtemp(prefix="eegtpu_verify_"))
+        self.paths = Paths.from_root(self.tmp)
+
+    def tearDown(self):
+        shutil.rmtree(self.tmp, ignore_errors=True)
+
+    def test_train_session_agreement(self):
+        # Cues 769..772 -> classes 0..3; classlabel is 1-based.
+        cues = [769, 770, 771, 772, 770, 769, 772, 771]
+        classlabel = [1, 2, 3, 4, 2, 1, 4, 3]
+        _write_session(self.paths, "A01T", "Train", cues, classlabel)
+        r = verify_session("A01T", "Train", self.paths)
+        self.assertTrue(r.ok, r.errors)
+        self.assertEqual(r.n_compared, 8)
+        self.assertEqual(r.n_mismatched, 0)
+        self.assertEqual(r.classes_seen, (0, 1, 2, 3))
+
+    def test_train_session_mismatch_detected(self):
+        cues = [769, 770, 771, 772]
+        _write_session(self.paths, "A02T", "Train", cues, [1, 2, 4, 3])
+        r = verify_session("A02T", "Train", self.paths)
+        self.assertFalse(r.ok)
+        self.assertEqual(r.n_mismatched, 2)
+        self.assertIn("disagree", r.errors[0])
+
+    def test_count_mismatch_detected(self):
+        cues = [783] * 6
+        _write_session(self.paths, "A03E", "Eval", cues, [1, 2, 3, 4])
+        r = verify_session("A03E", "Eval", self.paths)
+        self.assertFalse(r.ok)
+        self.assertIn("cue events", r.errors[0])
+
+    def test_eval_session_ok(self):
+        cues = [783] * 8
+        _write_session(self.paths, "A04E", "Eval", cues, [1, 2, 3, 4] * 2)
+        r = verify_session("A04E", "Eval", self.paths)
+        self.assertTrue(r.ok, r.errors)
+        self.assertEqual(r.n_cue_events, 8)
+        self.assertEqual(r.classes_seen, (0, 1, 2, 3))
+
+    def test_missing_class_flagged(self):
+        cues = [769, 770, 769, 770]
+        _write_session(self.paths, "A05T", "Train", cues, [1, 2, 1, 2])
+        r = verify_session("A05T", "Train", self.paths)
+        self.assertFalse(r.ok)
+        self.assertTrue(any("classes" in e for e in r.errors))
+
+    def test_missing_files_reported_not_raised(self):
+        r = verify_session("A09T", "Train", self.paths)
+        self.assertFalse(r.ok)
+        self.assertIn("no preprocessed recording", r.errors[0])
+        # recording present, .mat absent
+        rng = np.random.RandomState(0)
+        rec = ProcessedRecording(
+            data=rng.randn(4, 4000).astype(np.float32), sfreq=SFREQ,
+            labels=["C0"], event_pos=np.array([100], np.int64),
+            event_typ=np.array([769], np.int64))
+        rec.save(self.paths.data_processed / "Train" / "A09T-preprocessed.npz")
+        r = verify_session("A09T", "Train", self.paths)
+        self.assertFalse(r.ok)
+        self.assertIn("True labels not found", r.errors[0])
+
+    def test_verify_labels_sweeps_both_modes(self):
+        cues_t = [769, 770, 771, 772]
+        cues_e = [783] * 4
+        for s in (1, 2):
+            _write_session(self.paths, f"A0{s}T", "Train", cues_t,
+                           [1, 2, 3, 4])
+            _write_session(self.paths, f"A0{s}E", "Eval", cues_e,
+                           [4, 3, 2, 1])
+        results = verify_labels(subjects=(1, 2), mode="both",
+                                paths=self.paths)
+        self.assertEqual(len(results), 4)
+        self.assertTrue(all(r.ok for r in results),
+                        [r.errors for r in results if not r.ok])
+
+
+if __name__ == "__main__":
+    unittest.main()
