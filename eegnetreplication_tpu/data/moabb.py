@@ -1,0 +1,165 @@
+"""MOABB (BNCI2014-001) preprocessing: per-run ``.fif`` -> session trials.
+
+The reference's moabb pipeline is **broken**: ``preprocess_moabb_data``
+(``src/eegnet_repl/dataset.py:285-314``) never saves its output and reads a
+``Paths`` attribute that does not exist (quirk Q3); the README flags the
+whole path "Non-functional".  This module is the repaired, native
+equivalent:
+
+- :func:`load_moabb_run` reads one fetched run ``.fif`` (MNE-gated import),
+  picks the EEG channels, converts V -> uV (``dataset.py:304``), and maps
+  moabb's named annotations (``left_hand`` ...) or numeric descriptions to
+  the competition's GDF cue codes — producing the same
+  :class:`~eegnetreplication_tpu.data.gdf.GDFRecording` contract the kaggle
+  path uses, so the entire downstream chain (DSP, EMS, epoching) is shared.
+- :func:`merge_processed` concatenates per-run processed recordings into one
+  session recording with event positions offset — pure numpy, testable
+  without MNE.
+- :func:`preprocess_moabb_data` drives the whole tree:
+  ``data/moabb/{Train,Eval}/*.fif`` -> ``data/moabb_processed/{Train,Eval}``
+  with the same two artifacts per session as the kaggle path.
+
+MOABB's Eval runs carry true labels in their annotations (unlike the
+competition GDFs, which need the ``TrueLabels`` overlay), so both splits
+epoch with cue-code labels directly.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.config import Paths
+from eegnetreplication_tpu.data.containers import BCICI2ADataset
+from eegnetreplication_tpu.data.epoching import extract_epochs
+from eegnetreplication_tpu.data.gdf import GDFRecording
+from eegnetreplication_tpu.data.preprocess import (
+    ProcessedRecording,
+    preprocess_recording,
+)
+from eegnetreplication_tpu.utils.logging import logger
+
+# moabb standardizes BNCI2014-001 annotations to class names; competition
+# files use the raw numeric GDF codes.  Both map onto the cue codes the
+# shared epoching layer selects on (epoching.py TRAIN_CUE_TO_CLASS).
+MOABB_DESC_TO_CODE = {
+    "left_hand": 769, "right_hand": 770, "feet": 771, "tongue": 772,
+    "769": 769, "770": 770, "771": 771, "772": 772,
+}
+
+
+def load_moabb_run(path: str | Path) -> GDFRecording:
+    """One fetched moabb run ``.fif`` as a :class:`GDFRecording`.
+
+    Requires MNE (the storage format of ``fetch --src moabb``); raises an
+    actionable ImportError otherwise.
+    """
+    try:
+        import mne
+    except ImportError as e:
+        raise ImportError(
+            "Reading moabb .fif runs requires MNE, which is not installed. "
+            "The kaggle path (`--src kaggle`) has no such dependency."
+        ) from e
+
+    raw = mne.io.read_raw_fif(Path(path), preload=True, verbose="ERROR")
+    raw.pick("eeg")  # reference: Preprocessor('pick_types', eeg=True)
+    signals = (raw.get_data() * 1e6).astype(np.float32)  # V -> uV
+    pos, typ = [], []
+    sfreq = float(raw.info["sfreq"])
+    for onset, desc in zip(raw.annotations.onset,
+                           raw.annotations.description):
+        code = MOABB_DESC_TO_CODE.get(str(desc))
+        if code is not None:
+            pos.append(int(round(onset * sfreq)))
+            typ.append(code)
+    return GDFRecording(
+        signals=signals, sfreq=sfreq,
+        labels=list(raw.ch_names),
+        event_pos=np.asarray(pos, np.int64),
+        event_typ=np.asarray(typ, np.int64),
+        event_durations=np.zeros(len(pos), np.int64),
+        version=0.0,
+    )
+
+
+def merge_processed(parts: list[ProcessedRecording]) -> ProcessedRecording:
+    """Concatenate per-run processed recordings into one session recording.
+
+    Event positions are offset by the cumulative sample count so they stay
+    aligned; runs keep their individually-seeded EMS statistics (each run is
+    standardized independently, like the reference's per-recording
+    braindecode chain).
+    """
+    if not parts:
+        raise ValueError("merge_processed needs at least one recording")
+    sfreqs = {p.sfreq for p in parts}
+    if len(sfreqs) != 1:
+        raise ValueError(f"Runs disagree on sampling rate: {sorted(sfreqs)}")
+    pos, typ, offset = [], [], 0
+    for p in parts:
+        pos.append(p.event_pos + offset)
+        typ.append(p.event_typ)
+        offset += p.data.shape[1]
+    return ProcessedRecording(
+        data=np.concatenate([p.data for p in parts], axis=1),
+        sfreq=parts[0].sfreq,
+        labels=parts[0].labels,
+        event_pos=np.concatenate(pos),
+        event_typ=np.concatenate(typ),
+    )
+
+
+def preprocess_moabb_data(paths: Paths | None = None) -> list[Path]:
+    """Preprocess + epoch the fetched moabb tree; returns written npz paths.
+
+    Sessions are the run groups ``A{ss}{T|E}_*.fif`` that
+    :func:`~eegnetreplication_tpu.fetch.fetch_from_moabb` writes.  Each run
+    goes through the shared native chain (22ch -> resample 128 Hz -> FIR
+    4-38 Hz -> EMS), runs merge into one session recording, and both the
+    continuous ``-preprocessed.npz`` and the epoched ``-trials.npz`` are
+    written under ``data/moabb_processed/{Train,Eval}``.
+    """
+    from eegnetreplication_tpu.data.io import save_trials, trials_filename
+
+    paths = paths or Paths.from_here()
+    written = []
+    for mode in ("Train", "Eval"):
+        src_dir = paths.data_moabb / mode
+        out_dir = paths.data_moabb_processed / mode
+        out_dir.mkdir(parents=True, exist_ok=True)
+        groups: dict[str, list[Path]] = defaultdict(list)
+        for f in sorted(src_dir.glob("*.fif")):
+            groups[f.name[:4]].append(f)
+        if not groups:
+            logger.warning("No moabb .fif runs under %s (run "
+                           "`fetch --src moabb` first)", src_dir)
+            continue
+        for stem, run_files in sorted(groups.items()):
+            runs = [preprocess_recording(load_moabb_run(f))
+                    for f in run_files]
+            merged = merge_processed(runs)
+            out = merged.save(out_dir / f"{stem}-preprocessed.npz")
+            written.append(out)
+            # moabb Eval runs carry true labels in their annotations (moabb
+            # standardizes them to class names), so both splits epoch on cue
+            # codes directly — no TrueLabels .mat overlay;
+            # extract_epochs(mode="Train") returns classes 0..3 already.
+            X, y, _ = extract_epochs(
+                merged.data, merged.sfreq, merged.event_pos,
+                merged.event_typ, mode="Train")
+            if len(y) == 0:
+                logger.error(
+                    "moabb %s [%s]: no labelable cue events (runs whose "
+                    "annotations carry only the unknown-cue marker have no "
+                    "labels without the competition's TrueLabels overlay); "
+                    "skipping the trials file", stem, mode)
+                continue
+            subject = int(stem[1:3])
+            save_trials(BCICI2ADataset(X=X, y=y.astype(np.int64)),
+                        out_dir / trials_filename(subject, mode))
+            logger.info("moabb %s [%s]: %d runs -> %d trials",
+                        stem, mode, len(run_files), len(y))
+    return written

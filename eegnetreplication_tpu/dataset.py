@@ -11,8 +11,9 @@ Two artifacts per recording, both plain ``.npz``:
   eagerly so training never re-epochs (the reference re-epochs on every run,
   ``dataset.py:239-281``).
 
-The moabb path is stubbed: it is broken in the reference too (quirk Q3 —
-``Paths.data_moabb_processed`` missing, README calls it "Non-functional").
+The moabb path (broken in the reference — quirk Q3: missing Paths attribute,
+README "Non-functional") is repaired here: ``data/moabb.py`` routes fetched
+per-run ``.fif`` files through the same native DSP/epoching chain.
 """
 
 from __future__ import annotations
@@ -63,12 +64,13 @@ def main() -> None:
     if args.src == "kaggle":
         build_processed_tree()
     else:
-        # Quirk Q3: the reference's moabb path references a Paths attribute
-        # that doesn't exist and its README flags moabb "Non-functional".
-        raise NotImplementedError(
-            "The moabb preprocessing path is non-functional in the reference "
-            "(README.md:29) and is not implemented here; use --src kaggle."
-        )
+        # The reference's moabb path is broken (quirk Q3: missing Paths
+        # attribute, README "Non-functional"); ours is repaired — it shares
+        # the kaggle path's native DSP/epoching chain (data/moabb.py) and
+        # needs MNE only to read the fetched .fif runs.
+        from eegnetreplication_tpu.data.moabb import preprocess_moabb_data
+
+        preprocess_moabb_data()
 
 
 if __name__ == "__main__":

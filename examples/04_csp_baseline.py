@@ -70,18 +70,34 @@ def load_subject(subject: int):
 
 
 def csp_lda_cv(X, y, n_splits=4, seed=42) -> float:
-    """Mean KFold test accuracy of CSP+LDA, all folds in one vmap."""
+    """Mean KFold test accuracy of CSP+LDA, all folds in one vmap.
+
+    Ragged folds (n not divisible by n_splits) are handled the same way the
+    training engine's FoldSpec does: wraparound padding to a common static
+    length, with padded test slots weight-0 so every real trial is scored
+    exactly once.  (Train padding duplicates <n_splits trials in the
+    covariance means — a <1% weighting effect, no data dropped.)
+    """
     folds = list(kfold_indices(len(y), n_splits, seed))
-    tr_pad = min(len(tr) for tr, _ in folds)
-    te_pad = min(len(te) for _, te in folds)
-    tr_idx = jnp.stack([jnp.asarray(tr[:tr_pad]) for tr, _ in folds])
-    te_idx = jnp.stack([jnp.asarray(te[:te_pad]) for _, te in folds])
+    tr_pad = max(len(tr) for tr, _ in folds)
+    te_pad = max(len(te) for _, te in folds)
+
+    def pad(ids, to):
+        reps = np.resize(np.asarray(ids), to)  # wraparound padding
+        return reps, (np.arange(to) < len(ids)).astype(np.float32)
+
+    tr_idx = jnp.stack([jnp.asarray(pad(tr, tr_pad)[0]) for tr, _ in folds])
+    te_parts = [pad(te, te_pad) for _, te in folds]
+    te_idx = jnp.stack([jnp.asarray(p[0]) for p in te_parts])
+    te_w = jnp.stack([jnp.asarray(p[1]) for p in te_parts])
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
 
     preds = jax.vmap(
         lambda tr, te: csp_lda_fit_predict(Xd[tr], yd[tr], Xd[te])
     )(tr_idx, te_idx)
-    accs = jax.vmap(lambda p, te: jnp.mean(p == yd[te]) * 100.0)(preds, te_idx)
+    accs = jax.vmap(
+        lambda p, te, w: 100.0 * jnp.sum((p == yd[te]) * w) / jnp.sum(w)
+    )(preds, te_idx, te_w)
     return float(jnp.mean(accs))
 
 

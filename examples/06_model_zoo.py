@@ -17,8 +17,6 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
-import numpy as np
-
 from eegnetreplication_tpu.models.registry import MODEL_REGISTRY
 from eegnetreplication_tpu.training.protocols import (
     cross_subject_training,
@@ -58,19 +56,21 @@ def main() -> None:
         subjects = tuple(range(1, 8))
 
     rows = []
+    n_folds = 0
     for name in sorted(MODEL_REGISTRY):
         logger.info("=== %s: %s ===", protocol.__name__, name)
         res = protocol(epochs=epochs, subjects=subjects, model_name=name,
                        save_models=False, **loader_kw)
         rows.append((name, res.avg_test_acc, res.epoch_throughput))
+        n_folds = len(res.fold_test_acc)
 
     print(f"\n{'model':>16} {'test acc':>10} {'fold-epochs/s':>14}")
     for name, acc, thr in rows:
         print(f"{name:>16} {acc:>9.2f}% {thr:>14.1f}")
     best = max(rows, key=lambda r: r[1])
     print(f"\nbest: {best[0]} at {best[1]:.2f}% "
-          f"(chance {100.0 / 4:.0f}%, n={len(subjects)} subjects x "
-          f"{np.where(protocol is cross_subject_training, 10, 4)} folds)")
+          f"(chance {100.0 / 4:.0f}%, {len(subjects)} subjects, "
+          f"{n_folds} folds)")
 
 
 if __name__ == "__main__":
