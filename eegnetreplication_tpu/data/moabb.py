@@ -131,8 +131,18 @@ def preprocess_moabb_data(paths: Paths | None = None) -> list[Path]:
         out_dir = paths.data_moabb_processed / mode
         out_dir.mkdir(parents=True, exist_ok=True)
         groups: dict[str, list[Path]] = defaultdict(list)
+        session_letter = mode[0]  # T / E
         for f in sorted(src_dir.glob("*.fif")):
-            groups[f.name[:4]].append(f)
+            stem = f.name[:4]
+            # Only session groups the fetcher writes (A{ss}{T|E}_*); a stray
+            # file must not abort the tree after expensive preprocessing.
+            if not (len(f.name) > 4 and stem[0] == "A"
+                    and stem[1:3].isdigit() and stem[3] == session_letter):
+                logger.warning("Skipping unrecognized moabb file %s "
+                               "(expected A{ss}%s_<run>.fif)", f,
+                               session_letter)
+                continue
+            groups[stem].append(f)
         if not groups:
             logger.warning("No moabb .fif runs under %s (run "
                            "`fetch --src moabb` first)", src_dir)

@@ -1,0 +1,136 @@
+"""Inference CLI: ``python -m eegnetreplication_tpu.predict``.
+
+The reference has no inference entry point at all — trained checkpoints are
+only ever loaded for filter visualization (``ui.py:26-36``).  This CLI makes
+trained models usable: it loads a checkpoint (native ``.npz`` or a reference
+``.pth`` via the interop layer), classifies trials (a ``-trials.npz`` file,
+or a subject's processed session), and reports per-class counts plus
+accuracy when labels are present.
+
+This is also the product home of the Pallas block-1 kernel: batch inference
+runs through ``steps.eval_forward`` with ``allow_pallas=True``, which on a
+TPU backend uses the VMEM-resident fused kernel validated by
+``probe_pallas`` (``ops/fused_eegnet.py``) — measured at ~8x the plain
+forward on CPU and bench'd on TPU via ``bench.py``'s
+``eval_*_trials_per_s`` fields.
+
+Examples:
+    python -m eegnetreplication_tpu.predict --checkpoint models/subject_01_best_model.npz --subject 1 --mode Eval
+    python -m eegnetreplication_tpu.predict --checkpoint models/cross_subject_best_model.pth --input data/processed/Eval/A05E-trials.npz
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.utils.logging import logger
+
+CLASS_NAMES = ("left hand", "right hand", "feet", "tongue")
+
+
+def load_model_from_checkpoint(path: str | Path):
+    """(model, params, batch_stats) from a native .npz or reference .pth."""
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.training import checkpoint as ckpt_lib
+
+    path = Path(path)
+    if path.suffix == ".pth":
+        # Reference-format checkpoint; geometry inferred from tensor shapes
+        # (handles eegnet_wide exports too).
+        params, batch_stats, meta = ckpt_lib.load_pth_auto(path)
+        model = EEGNet(n_channels=meta["n_channels"],
+                       n_times=meta["n_times"], F1=meta["F1"], D=meta["D"])
+        return model, params, batch_stats
+    params, batch_stats, meta = ckpt_lib.load_checkpoint(path)
+    kwargs = {k: meta[k] for k in ("n_channels", "n_times", "F1", "D")
+              if k in meta}
+    if meta.get("model", "eegnet") != "eegnet":
+        from eegnetreplication_tpu.models import get_model
+
+        return (get_model(meta["model"], **{k: v for k, v in kwargs.items()
+                                            if k in ("n_channels", "n_times")}),
+                params, batch_stats)
+    return EEGNet(**kwargs), params, batch_stats
+
+
+def predict_trials(model, params, batch_stats, X: np.ndarray,
+                   batch_size: int = 256) -> np.ndarray:
+    """Class predictions for ``(n, C, T)`` trials (Pallas-fused on TPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.ops.fused_eegnet import (
+        probe_pallas,
+        supports_fused_eval,
+    )
+    from eegnetreplication_tpu.training.steps import eval_forward
+
+    if supports_fused_eval(model):
+        probe_pallas(model)  # validate/enable the TPU kernel eagerly
+
+    n = len(X)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    fwd = jax.jit(lambda xx: jnp.argmax(
+        eval_forward(model, params, batch_stats, xx, allow_pallas=True),
+        axis=-1))
+    out = []
+    # One padded batch shape -> one compilation.
+    for start in range(0, n, batch_size):
+        batch = X[start:start + batch_size]
+        pad = batch_size - len(batch)
+        if pad:
+            batch = np.concatenate([batch, batch[-1:].repeat(pad, axis=0)])
+        out.append(np.asarray(fwd(jnp.asarray(batch)))[: batch_size - pad
+                                                       if pad else None])
+    return np.concatenate(out)[:n]
+
+
+def main(argv=None) -> int:
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+    parser = argparse.ArgumentParser(
+        description="Classify EEG trials with a trained checkpoint.")
+    parser.add_argument("--checkpoint", required=True,
+                        help=".npz (native) or .pth (reference format).")
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument("--input", help="A -trials.npz file to classify.")
+    src.add_argument("--subject", type=int,
+                     help="Classify this subject's processed session.")
+    parser.add_argument("--mode", default="Eval",
+                        choices=["Train", "Eval"],
+                        help="Session to use with --subject.")
+    parser.add_argument("--batchSize", type=int, default=256)
+    args = parser.parse_args(argv)
+
+    model, params, batch_stats = load_model_from_checkpoint(args.checkpoint)
+    if args.input:
+        from eegnetreplication_tpu.data.io import load_trials
+
+        ds = load_trials(args.input)
+    else:
+        from eegnetreplication_tpu.data.io import load_subject_dataset
+
+        ds = load_subject_dataset(subject=args.subject, mode=args.mode)
+
+    pred = predict_trials(model, params, batch_stats,
+                          ds.X.astype(np.float32), args.batchSize)
+    counts = np.bincount(pred, minlength=len(CLASS_NAMES))
+    for k, name in enumerate(CLASS_NAMES):
+        logger.info("class %d (%s): %d trials", k, name, counts[k])
+    if ds.y is not None and len(ds.y):
+        acc = 100.0 * float(np.mean(pred == ds.y))
+        logger.info("accuracy vs labels: %.2f%% (%d trials)", acc, len(pred))
+        print(f"accuracy: {acc:.2f}%")
+    else:
+        print(f"predicted {len(pred)} trials: "
+              + ", ".join(f"{n}={c}" for n, c in zip(CLASS_NAMES, counts)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

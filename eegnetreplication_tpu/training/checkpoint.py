@@ -281,3 +281,31 @@ def load_pth(path: str | Path, f2: int, t_prime: int) -> tuple[dict, dict]:
     # untrusted .pth pickles must not execute code.
     sd = torch.load(Path(path), map_location="cpu", weights_only=True)
     return from_torch_state_dict(sd, f2, t_prime)
+
+
+def load_pth_auto(path: str | Path) -> tuple[dict, dict, dict]:
+    """Load a reference ``.pth``, inferring the architecture from shapes.
+
+    Works for any EEGNet geometry the interop layer can write (stock or
+    eegnet_wide): F1/F2/C come from the conv weights, T' from the classifier
+    fan-in.  ``n_times`` is reported as ``T'*32 + 1`` — the pipeline's
+    inclusive-window convention (quirk Q4: the reference is ambiguous
+    between 256 and 257; both give the same T').  Returns
+    ``(params, batch_stats, metadata)``.
+    """
+    import torch
+
+    sd = torch.load(Path(path), map_location="cpu", weights_only=True)
+    f1 = int(sd["temporal.0.weight"].shape[0])
+    f2 = int(sd["spatial.weight"].shape[0])
+    n_channels = int(sd["spatial.weight"].shape[2])
+    fan_in = int(sd["classifier.weight"].shape[1])
+    if f2 <= 0 or fan_in % f2:
+        raise ValueError(
+            f"Unrecognized EEGNet .pth geometry: classifier fan-in {fan_in} "
+            f"is not a multiple of F2={f2}")
+    t_prime = fan_in // f2
+    params, batch_stats = from_torch_state_dict(sd, f2, t_prime)
+    meta = {"model": "eegnet", "n_channels": n_channels,
+            "n_times": t_prime * 32 + 1, "F1": f1, "D": f2 // f1}
+    return params, batch_stats, meta
