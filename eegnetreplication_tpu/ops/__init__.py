@@ -6,6 +6,7 @@ from eegnetreplication_tpu.ops.dsp import (  # noqa: F401
     resample_fft,
 )
 from eegnetreplication_tpu.ops.ems import (  # noqa: F401
+    ems_time_sharded,
     exponential_moving_standardize,
     raw_exponential_moving_standardize,
 )

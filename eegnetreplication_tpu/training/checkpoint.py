@@ -304,6 +304,10 @@ def load_pth_auto(path: str | Path) -> tuple[dict, dict, dict]:
         raise ValueError(
             f"Unrecognized EEGNet .pth geometry: classifier fan-in {fan_in} "
             f"is not a multiple of F2={f2}")
+    if f1 <= 0 or f2 % f1:
+        raise ValueError(
+            f"Unrecognized EEGNet .pth geometry: F2={f2} is not a multiple "
+            f"of F1={f1} (depth multiplier D must be integral)")
     t_prime = fan_in // f2
     params, batch_stats = from_torch_state_dict(sd, f2, t_prime)
     meta = {"model": "eegnet", "n_channels": n_channels,

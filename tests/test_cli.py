@@ -115,14 +115,19 @@ class TestCLIBoundary(unittest.TestCase):
     def test_5_train_cli_convnet_model(self):
         """The ConvNet baselines run the full protocol end-to-end through
         the CLI registry switch (VERDICT round-1 item 8)."""
+        ckpt = self.tmp / "models" / "subject_01_best_model.npz"
+        ckpt.unlink(missing_ok=True)  # test_2 wrote an eegnet one
         proc = _run(["eegnetreplication_tpu.train",
                      "--trainingType", "Within-Subject", "--epochs", "1",
                      "--subjects", "1", "--generateReport", "False",
                      "--model", "shallow_convnet"],
                     self.tmp, timeout=600)
         self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
-        self.assertTrue(
-            (self.tmp / "models" / "subject_01_best_model.npz").exists())
+        self.assertTrue(ckpt.exists())
+        from eegnetreplication_tpu.training.checkpoint import load_checkpoint
+
+        _, _, meta = load_checkpoint(ckpt)
+        self.assertEqual(meta["model"], "shallow_convnet")
 
     def test_6_predict_cli(self):
         """Inference CLI classifies a session with a trained checkpoint."""

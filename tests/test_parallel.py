@@ -160,3 +160,50 @@ class TestFoldSharding:
             save_models=False, seed=0, mesh=make_mesh(),
             paths=Paths.from_root(tmp_path))
         assert result.fold_test_acc.shape == (12,)
+
+
+class TestSequenceParallelEMS:
+    """Time-sharded EMS == single-device EMS (the long-context path)."""
+
+    def test_matches_unsharded(self, devices8):
+        from eegnetreplication_tpu.ops.ems import (
+            ems_time_sharded,
+            exponential_moving_standardize,
+        )
+
+        rng = np.random.RandomState(0)
+        x = (rng.randn(4, 4096) * 3 + 5).astype(np.float32)
+        mesh = make_mesh(n_fold=1, n_data=8)
+        sharded = np.asarray(ems_time_sharded(
+            x, mesh, factor_new=1e-3, init_block_size=256))
+        ref = np.asarray(exponential_moving_standardize(
+            jnp.asarray(x), factor_new=1e-3, init_block_size=256))
+        np.testing.assert_allclose(sharded, ref, atol=2e-4, rtol=2e-3)
+
+    def test_matches_sequential_scan(self, devices8):
+        """Against the O(T) sequential formulation, not just the other
+        parallel one."""
+        from eegnetreplication_tpu.ops.ems import (
+            ems_time_sharded,
+            exponential_moving_standardize,
+        )
+
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 1024).astype(np.float32)
+        mesh = make_mesh(n_fold=2, n_data=4)
+        sharded = np.asarray(ems_time_sharded(
+            x, mesh, factor_new=5e-3, init_block_size=128))
+        seq = np.asarray(exponential_moving_standardize(
+            jnp.asarray(x), factor_new=5e-3, init_block_size=128,
+            method="scan"))
+        np.testing.assert_allclose(sharded, seq, atol=2e-4, rtol=2e-3)
+
+    def test_rejects_bad_shapes(self, devices8):
+        from eegnetreplication_tpu.ops.ems import ems_time_sharded
+
+        mesh = make_mesh(n_fold=1, n_data=8)
+        with pytest.raises(ValueError, match="divide"):
+            ems_time_sharded(np.zeros((2, 1001), np.float32), mesh)
+        with pytest.raises(ValueError, match="shard length"):
+            ems_time_sharded(np.zeros((2, 4096), np.float32), mesh,
+                             init_block_size=1000)
