@@ -136,6 +136,68 @@ def bench_tpu(x, y, folds) -> tuple[float, float]:
     return float(np.median(rates)), compile_s
 
 
+def bench_fold_scale() -> dict:
+    """Throughput of the REAL protocol scale: 36 folds in one program.
+
+    The headline bench trains 4 folds (one subject); the actual
+    within-subject protocol vmaps all 9 subjects x 4 folds together.  This
+    measures that program (20 epochs, 3 honest reps) and reports
+    fold-epochs/s at scale — the number that shows fold-vmapping's
+    near-linear win over the reference's sequential 36-run loop.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.training import (
+        init_fold_states,
+        make_fold_spec,
+        make_multi_fold_trainer,
+        make_optimizer,
+    )
+
+    n_subjects, epochs = 9, 20
+    rng = np.random.RandomState(1)
+    pool_x = jnp.asarray(rng.randn(n_subjects * N_POOL, C, T), jnp.float32)
+    pool_y = jnp.asarray(rng.randint(0, 4, n_subjects * N_POOL), jnp.int32)
+
+    base_folds = _fold_indices()
+    specs = []
+    for s in range(n_subjects):
+        off = s * N_POOL
+        for tr, va, te in base_folds:
+            specs.append(make_fold_spec(
+                tr + off, va + off, te + off,
+                train_pad=max(len(f[0]) for f in base_folds),
+                val_pad=max(len(f[1]) for f in base_folds),
+                test_pad=max(len(f[2]) for f in base_folds)))
+    n_folds = len(specs)
+    stacked = jax.tree_util.tree_map(lambda *l: jnp.stack(l), *specs)
+
+    model = EEGNet(n_channels=C, n_times=T)
+    tx = make_optimizer()
+    trainer = make_multi_fold_trainer(
+        model, tx, batch_size=BATCH, epochs=epochs,
+        train_pad=specs[0].train_idx.shape[0],
+        val_pad=specs[0].val_idx.shape[0],
+        test_pad=specs[0].test_idx.shape[0])
+    states = init_fold_states(model, tx, n_folds, (C, T))
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
+                                  jax.random.split(jax.random.PRNGKey(0),
+                                                   n_folds)))
+    compile_s = time.perf_counter() - t0
+    rates = []
+    for rep in (1, 2, 3):
+        keys = jax.random.split(jax.random.PRNGKey(rep), n_folds)
+        t0 = time.perf_counter()
+        jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
+        rates.append(n_folds * epochs / (time.perf_counter() - t0))
+    return {"fold36_epochs_per_s": round(float(np.median(rates)), 2),
+            "fold36_compile_s": round(compile_s, 2)}
+
+
 def bench_eval_kernels() -> dict:
     """Eval-forward microbench: plain apply vs fused-jnp vs Pallas kernel.
 
@@ -301,6 +363,11 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — optional add-on: a
             # failure here must not mark the (already valid) main metric
             record["eval_bench_error"] = f"{type(exc).__name__}: {exc}"[:200]
+        if PLATFORM != "cpu" and not os.environ.get("BENCH_SMOKE"):
+            try:
+                record.update(bench_fold_scale())
+            except Exception as exc:  # noqa: BLE001 — same: optional add-on
+                record["fold36_error"] = f"{type(exc).__name__}: {exc}"[:200]
     except Exception as exc:  # noqa: BLE001 — contract: always emit the line
         record["error"] = f"{type(exc).__name__}: {exc}"[:300]
     if _EMIT_ONCE.acquire(blocking=False):

@@ -165,8 +165,8 @@ def ems_time_sharded(x, mesh, axis_name: str | None = None,
     t_total = x.shape[-1]
     if t_total % n_shards:
         raise ValueError(
-            f"Time axis ({t_total}) must divide the mesh's {axis_name!r} "
-            f"axis ({n_shards}) for sequence parallelism")
+            f"The mesh's {axis_name!r} axis size ({n_shards}) must divide "
+            f"the time axis ({t_total}) for sequence parallelism")
     local_t = t_total // n_shards
     block = min(init_block_size, t_total)
     if block > local_t:

@@ -189,6 +189,11 @@ class App(tk.Tk):
             ttk.Button(viz_frame, text=label,
                        command=lambda f=fn: self._plot_with_selection(f)).grid(
                 row=0, column=col, padx=5, pady=5)
+        # Beyond the reference: evaluate the selected checkpoint on the
+        # held-out Eval session (predict CLI, same subprocess boundary).
+        ttk.Button(viz_frame, text="Evaluate on Eval Session",
+                   command=self.evaluate_model).grid(
+            row=0, column=3, padx=5, pady=5)
 
     # ---------------------------------------------------- subprocess jobs
     def _launch(self, cmd: list[str], busy_message: str, success_message: str):
@@ -216,6 +221,29 @@ class App(tk.Tk):
         self._launch([sys.executable, "-m", f"{PKG}.dataset",
                       "--src", self.source_var.get()],
                      "Preprocessing data...", "Data preprocessing completed")
+
+    def evaluate_model(self):
+        """Classify the selected subject's Eval session with the selected
+        checkpoint (accuracy lands in the Logs tab)."""
+        try:
+            subject = int(self.subject_var.get())
+        except ValueError:
+            messagebox.showerror(
+                "Invalid Input",
+                f"Invalid subject: {self.subject_var.get()!r}")
+            return
+        path = get_model_path(self.model_type_var.get(),
+                              self.subject_var.get())
+        if not Path(path).exists():
+            messagebox.showerror("Model Not Found",
+                                 f"No checkpoint at {path}; train first.")
+            return
+        self._launch(
+            [sys.executable, "-m", f"{PKG}.predict",
+             "--checkpoint", str(path),
+             "--subject", str(subject),
+             "--mode", "Eval"],
+            "Evaluating checkpoint...", "Evaluation completed")
 
     def train_model(self):
         try:
