@@ -83,67 +83,14 @@ def _fold_indices():
     return folds
 
 
-def bench_tpu(x, y, folds) -> tuple[float, float]:
-    """(fold-epochs/sec, compile seconds) of the fused vmapped trainer."""
-    import jax
-    import jax.numpy as jnp
+def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs):
+    """Shared timing core: (fold-epochs/sec, compile seconds).
 
-    from eegnetreplication_tpu.models import EEGNet
-    from eegnetreplication_tpu.training import (
-        init_fold_states,
-        make_fold_spec,
-        make_multi_fold_trainer,
-        make_optimizer,
-    )
-
-    train_pad = max(len(f[0]) for f in folds)
-    val_pad = max(len(f[1]) for f in folds)
-    test_pad = max(len(f[2]) for f in folds)
-
-    model = EEGNet(n_channels=C, n_times=T)
-    tx = make_optimizer()
-    trainer = make_multi_fold_trainer(
-        model, tx, batch_size=BATCH, epochs=EPOCHS, train_pad=train_pad,
-        val_pad=val_pad, test_pad=test_pad,
-    )
-    specs = [
-        make_fold_spec(tr, va, te, train_pad=train_pad, val_pad=val_pad,
-                       test_pad=test_pad)
-        for tr, va, te in folds
-    ]
-    stacked = jax.tree_util.tree_map(lambda *l: jnp.stack(l), *specs)
-    states = init_fold_states(model, tx, N_FOLDS, (C, T))
-    keys = jax.random.split(jax.random.PRNGKey(0), N_FOLDS)
-    pool_x, pool_y = jnp.asarray(x), jnp.asarray(y)
-
-    # Warmup: compile (first TPU compile is the slow part; it is amortized
-    # over the 36-fold x 500-epoch real protocol, so excluded from the rate
-    # but reported separately as compile_s).
-    t0 = time.perf_counter()
-    jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
-    compile_s = time.perf_counter() - t0
-    # Timed reps use a DIFFERENT key each time: re-running with inputs
-    # identical to the warmup let the tunneled remote backend serve a cached
-    # result in ~7 ms, inflating round-1-style numbers ~250x.  Median of 3
-    # honest reps.
-    rates = []
-    for rep in range(1, 4):
-        rep_keys = jax.random.split(jax.random.PRNGKey(rep), N_FOLDS)
-        t0 = time.perf_counter()
-        jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
-                                      rep_keys))
-        rates.append(N_FOLDS * EPOCHS / (time.perf_counter() - t0))
-    return float(np.median(rates)), compile_s
-
-
-def bench_fold_scale() -> dict:
-    """Throughput of the REAL protocol scale: 36 folds in one program.
-
-    The headline bench trains 4 folds (one subject); the actual
-    within-subject protocol vmaps all 9 subjects x 4 folds together.  This
-    measures that program (20 epochs, 3 honest reps) and reports
-    fold-epochs/s at scale — the number that shows fold-vmapping's
-    near-linear win over the reference's sequential 36-run loop.
+    ``raw_folds`` is a list of (train_ids, val_ids, test_ids) over the pool.
+    Warmup compiles; timed reps use a DIFFERENT key each time — re-running
+    with inputs identical to the warmup lets the tunneled remote backend
+    serve a cached result in ~7 ms, inflating round-1-style numbers ~250x.
+    Median of 3 honest reps.
     """
     import jax
     import jax.numpy as jnp
@@ -156,46 +103,75 @@ def bench_fold_scale() -> dict:
         make_optimizer,
     )
 
-    n_subjects, epochs = 9, 20
-    rng = np.random.RandomState(1)
-    pool_x = jnp.asarray(rng.randn(n_subjects * N_POOL, C, T), jnp.float32)
-    pool_y = jnp.asarray(rng.randint(0, 4, n_subjects * N_POOL), jnp.int32)
-
-    base_folds = _fold_indices()
-    specs = []
-    for s in range(n_subjects):
-        off = s * N_POOL
-        for tr, va, te in base_folds:
-            specs.append(make_fold_spec(
-                tr + off, va + off, te + off,
-                train_pad=max(len(f[0]) for f in base_folds),
-                val_pad=max(len(f[1]) for f in base_folds),
-                test_pad=max(len(f[2]) for f in base_folds)))
-    n_folds = len(specs)
-    stacked = jax.tree_util.tree_map(lambda *l: jnp.stack(l), *specs)
+    train_pad = max(len(f[0]) for f in raw_folds)
+    val_pad = max(len(f[1]) for f in raw_folds)
+    test_pad = max(len(f[2]) for f in raw_folds)
+    n_folds = len(raw_folds)
 
     model = EEGNet(n_channels=C, n_times=T)
     tx = make_optimizer()
     trainer = make_multi_fold_trainer(
-        model, tx, batch_size=BATCH, epochs=epochs,
-        train_pad=specs[0].train_idx.shape[0],
-        val_pad=specs[0].val_idx.shape[0],
-        test_pad=specs[0].test_idx.shape[0])
+        model, tx, batch_size=BATCH, epochs=epochs, train_pad=train_pad,
+        val_pad=val_pad, test_pad=test_pad,
+    )
+    specs = [
+        make_fold_spec(tr, va, te, train_pad=train_pad, val_pad=val_pad,
+                       test_pad=test_pad)
+        for tr, va, te in raw_folds
+    ]
+    stacked = jax.tree_util.tree_map(lambda *l: jnp.stack(l), *specs)
     states = init_fold_states(model, tx, n_folds, (C, T))
+    pool_x, pool_y = jnp.asarray(pool_x), jnp.asarray(pool_y)
 
     t0 = time.perf_counter()
-    jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
-                                  jax.random.split(jax.random.PRNGKey(0),
-                                                   n_folds)))
+    jax.block_until_ready(trainer(
+        pool_x, pool_y, stacked, states,
+        jax.random.split(jax.random.PRNGKey(0), n_folds)))
     compile_s = time.perf_counter() - t0
     rates = []
-    for rep in (1, 2, 3):
-        keys = jax.random.split(jax.random.PRNGKey(rep), n_folds)
+    for rep in range(1, 4):
+        rep_keys = jax.random.split(jax.random.PRNGKey(rep), n_folds)
         t0 = time.perf_counter()
-        jax.block_until_ready(trainer(pool_x, pool_y, stacked, states, keys))
+        jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
+                                      rep_keys))
         rates.append(n_folds * epochs / (time.perf_counter() - t0))
-    return {"fold36_epochs_per_s": round(float(np.median(rates)), 2),
-            "fold36_compile_s": round(compile_s, 2)}
+    return float(np.median(rates)), compile_s
+
+
+def bench_tpu(x, y, folds) -> tuple[float, float]:
+    """(fold-epochs/sec, compile seconds) of the fused vmapped trainer.
+
+    First TPU compile is the slow part; it is amortized over the 36-fold x
+    500-epoch real protocol, so excluded from the rate but reported
+    separately as compile_s.
+    """
+    return _time_fused_trainer(x, y, folds, EPOCHS)
+
+
+def bench_fold_scale(n_subjects: int = 9, epochs: int = 20) -> dict:
+    """Throughput of the REAL protocol scale: 9 subjects x 4 folds fused.
+
+    The headline bench trains 4 folds (one subject); the actual
+    within-subject protocol vmaps all 36 folds together.  This measures
+    that program and reports fold-epochs/s at scale — the number that shows
+    fold-vmapping's near-linear win over the reference's sequential
+    36-run loop.  (BENCH_SMOKE runs it at 2 subjects x 1 epoch so the code
+    path stays exercised off-TPU.)
+    """
+    rng = np.random.RandomState(1)
+    pool_x = rng.randn(n_subjects * N_POOL, C, T).astype(np.float32)
+    pool_y = rng.randint(0, 4, n_subjects * N_POOL).astype(np.int32)
+
+    base_folds = _fold_indices()
+    raw_folds = [
+        (tr + s * N_POOL, va + s * N_POOL, te + s * N_POOL)
+        for s in range(n_subjects)
+        for tr, va, te in base_folds
+    ]
+    rate, compile_s = _time_fused_trainer(pool_x, pool_y, raw_folds, epochs)
+    return {"fold36_epochs_per_s": round(rate, 2),
+            "fold36_compile_s": round(compile_s, 2),
+            "fold36_n_folds": len(raw_folds)}
 
 
 def bench_eval_kernels() -> dict:
@@ -348,6 +324,7 @@ def main() -> None:
     except ValueError:
         deadline_s = 1500.0
     watchdog = _arm_watchdog(record, deadline_s)
+    t_start = time.perf_counter()
     try:
         x, y = _synthetic_pool()
         folds = _fold_indices()
@@ -363,11 +340,24 @@ def main() -> None:
         except Exception as exc:  # noqa: BLE001 — optional add-on: a
             # failure here must not mark the (already valid) main metric
             record["eval_bench_error"] = f"{type(exc).__name__}: {exc}"[:200]
-        if PLATFORM != "cpu" and not os.environ.get("BENCH_SMOKE"):
-            try:
-                record.update(bench_fold_scale())
-            except Exception as exc:  # noqa: BLE001 — same: optional add-on
+        if os.environ.get("BENCH_SMOKE"):
+            try:  # keep the code path exercised off-TPU, at toy scale
+                record.update(bench_fold_scale(n_subjects=2, epochs=1))
+            except Exception as exc:  # noqa: BLE001 — optional add-on
                 record["fold36_error"] = f"{type(exc).__name__}: {exc}"[:200]
+        elif PLATFORM != "cpu":
+            # Budget guard: the 36-fold compile is the most expensive stage;
+            # only start it while at least half the watchdog budget remains,
+            # so a slow run degrades to a missing add-on field instead of a
+            # watchdog error over an already-valid headline metric.
+            if time.perf_counter() - t_start < 0.5 * deadline_s:
+                try:
+                    record.update(bench_fold_scale())
+                except Exception as exc:  # noqa: BLE001 — optional add-on
+                    record["fold36_error"] = (
+                        f"{type(exc).__name__}: {exc}"[:200])
+            else:
+                record["fold36_error"] = "skipped: insufficient time budget"
     except Exception as exc:  # noqa: BLE001 — contract: always emit the line
         record["error"] = f"{type(exc).__name__}: {exc}"[:300]
     if _EMIT_ONCE.acquire(blocking=False):

@@ -227,13 +227,16 @@ class App(tk.Tk):
         checkpoint (accuracy lands in the Logs tab)."""
         try:
             subject = int(self.subject_var.get())
+            if not 1 <= subject <= 9:
+                raise ValueError("subject must be 1-9")
         except ValueError:
             messagebox.showerror(
                 "Invalid Input",
                 f"Invalid subject: {self.subject_var.get()!r}")
             return
-        path = get_model_path(self.model_type_var.get(),
-                              self.subject_var.get())
+        # Parsed + zero-padded: a hand-typed '1' must resolve the same
+        # checkpoint name the protocols save ('subject_01_...').
+        path = get_model_path(self.model_type_var.get(), f"{subject:02d}")
         if not Path(path).exists():
             messagebox.showerror("Model Not Found",
                                  f"No checkpoint at {path}; train first.")
