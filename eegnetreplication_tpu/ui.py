@@ -63,6 +63,12 @@ def get_model_path(model_type: str, subject: str,
     """
     paths = paths or Paths.from_here()
     if model_type == "Within-Subject":
+        try:
+            # Normalize here so every caller (plots, evaluate) resolves a
+            # hand-typed '1' to the 'subject_01_...' name protocols save.
+            subject = f"{int(subject):02d}"
+        except ValueError:
+            pass  # non-numeric: let the not-found path report it
         stem = f"subject_{subject}_best_model"
     else:
         stem = "cross_subject_best_model"

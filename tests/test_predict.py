@@ -1,0 +1,101 @@
+"""Unit tests of the inference layer (predict.py) below the CLI boundary."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.predict import (  # noqa: E402
+    load_model_from_checkpoint,
+    predict_trials,
+)
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = EEGNet(n_channels=6, n_times=64)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 6, 64)),
+                           train=False)
+    return model, variables["params"], variables["batch_stats"]
+
+
+class TestPredictTrials:
+    def test_matches_direct_forward(self, small_model):
+        model, params, bs = small_model
+        x = np.random.RandomState(0).randn(40, 6, 64).astype(np.float32)
+        pred = predict_trials(model, params, bs, x, batch_size=16)
+        logits = model.apply({"params": params, "batch_stats": bs},
+                             jnp.asarray(x), train=False)
+        np.testing.assert_array_equal(pred, np.argmax(np.asarray(logits), 1))
+
+    def test_ragged_final_batch_padding(self, small_model):
+        """n not divisible by batch_size: padded tail predictions dropped."""
+        model, params, bs = small_model
+        x = np.random.RandomState(1).randn(37, 6, 64).astype(np.float32)
+        pred = predict_trials(model, params, bs, x, batch_size=16)
+        assert pred.shape == (37,)
+        full = predict_trials(model, params, bs, x, batch_size=64)
+        np.testing.assert_array_equal(pred, full)
+
+    def test_empty_input(self, small_model):
+        model, params, bs = small_model
+        pred = predict_trials(model, params, bs,
+                              np.zeros((0, 6, 64), np.float32))
+        assert pred.shape == (0,)
+
+
+class TestCheckpointGeometry:
+    def test_npz_roundtrip_any_registry_model(self, tmp_path):
+        from eegnetreplication_tpu.models import get_model
+        from eegnetreplication_tpu.training.checkpoint import save_checkpoint
+
+        model = get_model("shallow_convnet", n_channels=6, n_times=64)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 6, 64)),
+                               train=False)
+        p = tmp_path / "m.npz"
+        save_checkpoint(p, variables["params"], variables["batch_stats"],
+                        metadata={"model": "shallow_convnet",
+                                  "n_channels": 6, "n_times": 64})
+        loaded_model, params, bs = load_model_from_checkpoint(p)
+        x = np.random.RandomState(0).randn(4, 6, 64).astype(np.float32)
+        a = model.apply(variables, jnp.asarray(x), train=False)
+        b = loaded_model.apply(
+            {"params": jax.tree_util.tree_map(jnp.asarray, params),
+             "batch_stats": jax.tree_util.tree_map(jnp.asarray, bs)},
+            jnp.asarray(x), train=False)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+    def test_pth_auto_infers_wide_geometry(self, tmp_path):
+        torch = pytest.importorskip("torch")  # noqa: F841
+        from eegnetreplication_tpu.models import eegnet_wide
+        from eegnetreplication_tpu.training.checkpoint import (
+            load_pth_auto,
+            save_pth,
+        )
+
+        model = eegnet_wide(n_channels=10, n_times=257)
+        variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 10, 257)),
+                               train=False)
+        p = tmp_path / "wide.pth"
+        save_pth(p, variables["params"], variables["batch_stats"],
+                 f2=model.F2, t_prime=257 // 32)
+        _, _, meta = load_pth_auto(p)
+        assert meta == {"model": "eegnet", "n_channels": 10, "n_times": 257,
+                        "F1": 16, "D": 4}
+
+    def test_pth_auto_rejects_bad_geometry(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        from eegnetreplication_tpu.training.checkpoint import load_pth_auto
+
+        sd = {
+            "temporal.0.weight": torch.zeros(8, 1, 1, 32),
+            "spatial.weight": torch.zeros(20, 1, 22, 1),  # F2=20, F1=8
+            "classifier.weight": torch.zeros(4, 160),
+            "classifier.bias": torch.zeros(4),
+        }
+        p = tmp_path / "bad.pth"
+        torch.save(sd, p)
+        with pytest.raises(ValueError, match="multiple of F1"):
+            load_pth_auto(p)
