@@ -60,10 +60,20 @@ def make_hybrid_mesh(n_data_per_host: int = 1) -> Mesh:
     local = jax.local_device_count()
     if n_proc == 1:
         return make_mesh(n_data=n_data_per_host)
+    if local % n_data_per_host:
+        raise ValueError(
+            f"mesh shape: data axis ({n_data_per_host}) must divide the "
+            f"{local} local devices per host")
     n_fold_per_host = local // n_data_per_host
+    # DCN shape (n_proc, 1) demands exactly one granule per process, so
+    # granulate by process unconditionally — equivalent to slice
+    # granulation when slices==processes, and the only valid choice
+    # everywhere else (incl. multi-process CPU, where every device reports
+    # slice 0).
     arr = mesh_utils.create_hybrid_device_mesh(
         mesh_shape=(n_fold_per_host, n_data_per_host),
         dcn_mesh_shape=(n_proc, 1),
+        process_is_granule=True,
     )
     return Mesh(arr, (FOLD_AXIS, DATA_AXIS))
 

@@ -1,0 +1,92 @@
+"""True multi-process test of the distributed backend (DCN-path twin).
+
+Round 1 shipped ``initialize_distributed`` / ``make_hybrid_mesh`` untested
+("no hardware").  No hardware is still true — but ``jax.distributed`` works
+across *processes* on the CPU backend, which exercises the identical
+code path (coordinator bring-up, global device view, cross-process
+collectives) that a TPU pod's DCN uses.  Two local processes with 4 virtual
+devices each form a (4 fold, 2 data) hybrid mesh and run a psum over the
+full 8-device global mesh.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import unittest
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+WORKER = r"""
+import sys
+port, pid = sys.argv[1], int(sys.argv[2])
+
+from eegnetreplication_tpu.utils.platform import force_cpu
+force_cpu(4)  # 4 virtual CPU devices per process, before any backend init
+
+from eegnetreplication_tpu.parallel.mesh import (
+    DATA_AXIS, FOLD_AXIS, initialize_distributed, make_hybrid_mesh,
+)
+initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+mesh = make_hybrid_mesh(n_data_per_host=2)
+assert dict(mesh.shape) == {FOLD_AXIS: 4, DATA_AXIS: 2}, dict(mesh.shape)
+
+def f(x):
+    # reduce over BOTH axes: crosses the process (DCN-analog) boundary
+    return jax.lax.psum(jax.lax.psum(x, FOLD_AXIS), DATA_AXIS)
+
+fm = jax.jit(shard_map(f, mesh=mesh, in_specs=P(FOLD_AXIS, DATA_AXIS),
+                       out_specs=P(FOLD_AXIS, DATA_AXIS)))
+with mesh:
+    x = jax.device_put(
+        jnp.ones((8, 2), jnp.float32),
+        NamedSharding(mesh, P(FOLD_AXIS, DATA_AXIS)))
+    out = fm(x)
+    # every element is the sum over all 8 shards' ones * their block size
+    total = float(jax.block_until_ready(out).max())
+assert total == 8.0, total
+print(f"proc {pid} OK: global psum over hybrid mesh = {total}")
+"""
+
+
+class TestMultiProcessBackend(unittest.TestCase):
+    def test_two_process_hybrid_mesh_psum(self):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ, PYTHONPATH=str(REPO), EEGTPU_NO_LOG_FILE="1")
+        env.pop("JAX_PLATFORMS", None)
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", WORKER, str(port), str(pid)],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            for pid in (0, 1)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for p, out in zip(procs, outs):
+            self.assertEqual(p.returncode, 0, out[-3000:])
+        self.assertIn("proc 0 OK", outs[0] + outs[1])
+        self.assertIn("proc 1 OK", outs[0] + outs[1])
+
+
+if __name__ == "__main__":
+    unittest.main()
