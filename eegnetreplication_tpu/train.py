@@ -62,6 +62,10 @@ def build_parser() -> argparse.ArgumentParser:
     parser.add_argument("--resume", action="store_true",
                         help="Resume from the run snapshot if one exists "
                              "(requires --checkpointEvery).")
+    parser.add_argument("--debugNans", action="store_true",
+                        help="Numerics sanitizer: re-run any computation "
+                             "that produced a NaN un-jitted and raise with "
+                             "the originating op (jax_debug_nans; slower).")
     return parser
 
 
@@ -105,6 +109,13 @@ def main() -> None:
     import jax
 
     from eegnetreplication_tpu.utils.profiling import trace
+
+    if args.debugNans:
+        # The framework's sanitizer (SURVEY §5: the reference has none):
+        # surfaces the op that produced the first NaN instead of letting it
+        # poison 500 epochs of fused training silently.
+        jax.config.update("jax_debug_nans", True)
+        logger.info("NaN debugging enabled (jax_debug_nans)")
 
     if len(jax.devices()) > 1 or args.meshFold is not None:
         mesh = make_mesh(n_fold=args.meshFold, n_data=args.meshData)

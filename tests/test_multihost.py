@@ -1,12 +1,13 @@
-"""True multi-process test of the distributed backend (DCN-path twin).
+"""True multi-process tests of the distributed backend (DCN-path twin).
 
 Round 1 shipped ``initialize_distributed`` / ``make_hybrid_mesh`` untested
 ("no hardware").  No hardware is still true — but ``jax.distributed`` works
 across *processes* on the CPU backend, which exercises the identical
 code path (coordinator bring-up, global device view, cross-process
 collectives) that a TPU pod's DCN uses.  Two local processes with 4 virtual
-devices each form a (4 fold, 2 data) hybrid mesh and run a psum over the
-full 8-device global mesh.
+devices each form a hybrid mesh over all 8 devices; one test checks a psum
+crossing the process boundary, the other trains the fused fold trainer over
+the mesh and asserts numeric equivalence with the unsharded run.
 """
 
 import os
@@ -18,7 +19,7 @@ from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
 
-WORKER = r"""
+PSUM_WORKER = r"""
 import sys
 port, pid = sys.argv[1], int(sys.argv[2])
 
@@ -52,42 +53,10 @@ with mesh:
         jnp.ones((8, 2), jnp.float32),
         NamedSharding(mesh, P(FOLD_AXIS, DATA_AXIS)))
     out = fm(x)
-    # every element is the sum over all 8 shards' ones * their block size
     total = float(jax.block_until_ready(out).max())
 assert total == 8.0, total
 print(f"proc {pid} OK: global psum over hybrid mesh = {total}")
 """
-
-
-class TestMultiProcessBackend(unittest.TestCase):
-    def test_two_process_hybrid_mesh_psum(self):
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        env = dict(os.environ, PYTHONPATH=str(REPO), EEGTPU_NO_LOG_FILE="1")
-        env.pop("JAX_PLATFORMS", None)
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-c", WORKER, str(port), str(pid)],
-                cwd=REPO, env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True)
-            for pid in (0, 1)
-        ]
-        outs = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=300)
-                outs.append(out)
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        for p, out in zip(procs, outs):
-            self.assertEqual(p.returncode, 0, out[-3000:])
-        self.assertIn("proc 0 OK", outs[0] + outs[1])
-        self.assertIn("proc 1 OK", outs[0] + outs[1])
-
-
 
 TRAIN_WORKER = r"""
 import sys
@@ -110,51 +79,82 @@ px = jnp.asarray(rng.randn(64, C, T), jnp.float32)
 py = jnp.asarray(rng.randint(0, 4, 64), jnp.int32)
 model = EEGNet(n_channels=C, n_times=T)
 tx = make_optimizer()
-trainer = make_multi_fold_trainer(model, tx, batch_size=B, epochs=1,
-                                  train_pad=32, val_pad=16, test_pad=16,
-                                  mesh=mesh)
 idx = np.arange(64)
 specs = [make_fold_spec(idx[:32], idx[32:48], idx[48:], train_pad=32,
                         val_pad=16, test_pad=16) for _ in range(8)]
 stacked = jax.tree_util.tree_map(lambda *l: jnp.stack(l), *specs)
 states = init_fold_states(model, tx, 8, (C, T))
-res = jax.block_until_ready(trainer(
-    px, py, stacked, states, jax.random.split(jax.random.PRNGKey(0), 8)))
-assert res.val_accuracies.shape == (8, 1), res.val_accuracies.shape
-print(f"proc {pid} TRAIN OK")
+keys = jax.random.split(jax.random.PRNGKey(0), 8)
+
+kw = dict(batch_size=B, epochs=1, train_pad=32, val_pad=16, test_pad=16)
+sharded = jax.block_until_ready(make_multi_fold_trainer(
+    model, tx, mesh=mesh, **kw)(px, py, stacked, states, keys))
+# Numeric equivalence: the same program unsharded (plain vmap, local) must
+# produce the same metrics — a collective bug that garbles remote folds'
+# results would diverge here, not just change a shape.
+local = jax.block_until_ready(make_multi_fold_trainer(
+    model, tx, **kw)(px, py, stacked, states, keys))
+from jax.experimental import multihost_utils
+for name in ("val_accuracies", "test_accuracy", "train_losses"):
+    # the sharded metrics span both processes: gather the global value
+    a = np.asarray(multihost_utils.process_allgather(
+        getattr(sharded, name), tiled=True))
+    b = np.asarray(getattr(local, name))
+    assert np.all(np.isfinite(a)), (name, a)
+    np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4, err_msg=name)
+print(f"proc {pid} TRAIN OK: sharded == unsharded")
 """
+
+
+def run_two_process_workers(worker_src: str, timeout: int = 300):
+    """Launch worker_src in 2 coordinated processes; return their outputs.
+
+    Raises AssertionError with the failing worker's output on nonzero exit.
+    """
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ, PYTHONPATH=str(REPO), EEGTPU_NO_LOG_FILE="1")
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker_src, str(port), str(pid)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-3000:]
+    return outs
+
+
+class TestMultiProcessBackend(unittest.TestCase):
+    def test_two_process_hybrid_mesh_psum(self):
+        outs = run_two_process_workers(PSUM_WORKER)
+        joined = "".join(outs)
+        self.assertIn("proc 0 OK", joined)
+        self.assertIn("proc 1 OK", joined)
 
 
 class TestMultiProcessTraining(unittest.TestCase):
     def test_fold_sharded_training_across_processes(self):
         """The actual product path: the fused fold trainer sharded over a
-        hybrid mesh whose fold axis spans the process (DCN) boundary."""
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            port = s.getsockname()[1]
-        env = dict(os.environ, PYTHONPATH=str(REPO), EEGTPU_NO_LOG_FILE="1")
-        env.pop("JAX_PLATFORMS", None)
-        procs = [
-            subprocess.Popen(
-                [sys.executable, "-c", TRAIN_WORKER, str(port), str(pid)],
-                cwd=REPO, env=env, stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT, text=True)
-            for pid in (0, 1)
-        ]
-        outs = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=300)
-                outs.append(out)
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
-        for p, out in zip(procs, outs):
-            self.assertEqual(p.returncode, 0, out[-3000:])
+        hybrid mesh whose fold axis spans the process (DCN) boundary,
+        numerically equivalent to the unsharded run."""
+        outs = run_two_process_workers(TRAIN_WORKER)
         joined = "".join(outs)
         self.assertIn("proc 0 TRAIN OK", joined)
         self.assertIn("proc 1 TRAIN OK", joined)
+
 
 if __name__ == "__main__":
     unittest.main()
