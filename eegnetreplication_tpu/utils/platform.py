@@ -35,12 +35,76 @@ def apply_platform_override() -> str | None:
     return platform or None
 
 
+_PROBE_CACHE_TTL_S = 600.0
+_MISS = object()
+
+
+def _probe_cache_path() -> str:
+    # Per-user: a world-shared path would let one user's (or one poisoned)
+    # entry redirect another user's platform selection.
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return f"/tmp/eegtpu_probe_cache.{uid}.json"
+
+
+def _probe_env_key() -> str:
+    """Env vars that change the probe's outcome; part of the cache key."""
+    return "|".join(f"{k}={os.environ.get(k, '')}"
+                    for k in ("JAX_PLATFORMS", "XLA_FLAGS"))
+
+
+def _read_probe_cache() -> str | None | object:
+    """Cached probe outcome, or the sentinel ``_MISS`` when absent/stale."""
+    import json
+    import time
+
+    if os.environ.get("EEGTPU_PROBE_CACHE") == "0":
+        return _MISS
+    try:
+        with open(_probe_cache_path()) as f:
+            entry = json.load(f)
+        age = time.time() - float(entry["ts"])
+        result = entry["result"]
+        if (0 <= age <= _PROBE_CACHE_TTL_S          # future ts = poisoned
+                and entry.get("env") == _probe_env_key()
+                and isinstance(result, (str, type(None)))):
+            return result
+    except Exception:  # noqa: BLE001 — any cache problem = miss
+        pass
+    return _MISS
+
+
+def _write_probe_cache(result: str | None) -> None:
+    import json
+    import time
+
+    if os.environ.get("EEGTPU_PROBE_CACHE") == "0":
+        return
+    path = _probe_cache_path()
+    tmp = f"{path}.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump({"ts": time.time(), "result": result,
+                       "env": _probe_env_key()}, f)
+        os.replace(tmp, path)
+    except Exception:  # noqa: BLE001 — cache is best-effort
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def probe_accelerator(timeout_s: float = 90.0) -> str | None:
     """Try accelerator backend init in a subprocess; backend name or None.
 
     Runs out-of-process because a broken tunneled backend can hang inside
-    its C++ init where no in-process timeout can reach it.
+    its C++ init where no in-process timeout can reach it.  The outcome is
+    cached for 10 minutes (``/tmp``): a GUI session launches fetch/dataset/
+    train CLIs serially and each would otherwise pay the full timeout when
+    the tunnel is down.  ``EEGTPU_PROBE_CACHE=0`` disables the cache.
     """
+    cached = _read_probe_cache()
+    if cached is not _MISS:
+        return cached
     env = dict(os.environ)
     env.pop("EEGTPU_PLATFORM", None)
     # Own session + process-group kill: a tunneled backend can spawn helper
@@ -54,7 +118,7 @@ def probe_accelerator(timeout_s: float = 90.0) -> str | None:
             start_new_session=True,
         )
     except OSError:
-        return None
+        return None  # transient spawn failure: don't cache
     try:
         stdout, _ = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -66,10 +130,13 @@ def probe_accelerator(timeout_s: float = 90.0) -> str | None:
             proc.communicate(timeout=5)
         except Exception:
             pass
+        _write_probe_cache(None)  # a hung tunnel: exactly what to remember
         return None
     if proc.returncode != 0:
+        _write_probe_cache(None)
         return None
     name = stdout.strip().splitlines()[-1] if stdout.strip() else ""
+    _write_probe_cache(name or None)
     return name or None
 
 
