@@ -189,7 +189,7 @@ def _build_sp_ems(mesh, axis_name: str, ndim: int, factor_new: float,
     Keyed on (mesh, axis, rank, hyperparams) so the 18-session preprocessing
     sweep compiles once per shape instead of re-tracing per call.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     def fn(x_local):

@@ -303,12 +303,12 @@ def shard_over_fold_axis(fn, mesh, fold_axis: str, mapped: tuple[bool, ...]):
     permutation test); callers pad the mapped axis to a multiple of
     ``mesh.shape[fold_axis]``.
     """
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     in_specs = tuple(P(fold_axis) if m else P() for m in mapped)
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                     out_specs=P(fold_axis), check_rep=False)
+                     out_specs=P(fold_axis), check_vma=False)
 
 
 def _mesh_data_sharding(mesh, batch_size: int):

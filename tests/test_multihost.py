@@ -33,7 +33,7 @@ initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 assert jax.process_count() == 2, jax.process_count()
