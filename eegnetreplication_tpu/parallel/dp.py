@@ -10,7 +10,6 @@ numerically equivalent to the same global batch on one device.
 
 from __future__ import annotations
 
-import functools
 
 import jax
 import jax.numpy as jnp
