@@ -21,6 +21,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from eegnetreplication_tpu.utils.platform import select_platform
+
+select_platform()  # probe the accelerator (cached); fall back to CPU if wedged
+
 import numpy as np
 
 import jax

@@ -13,6 +13,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from eegnetreplication_tpu.utils.platform import select_platform
+
+select_platform()  # probe the accelerator (cached); fall back to CPU if wedged
+
 from eegnetreplication_tpu.data.verify import main
 
 if __name__ == "__main__":

@@ -17,6 +17,10 @@ from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 
+from eegnetreplication_tpu.utils.platform import select_platform
+
+select_platform()  # probe the accelerator (cached); fall back to CPU if wedged
+
 from eegnetreplication_tpu.models.registry import MODEL_REGISTRY
 from eegnetreplication_tpu.training.protocols import (
     cross_subject_training,
