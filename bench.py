@@ -44,6 +44,11 @@ import numpy as np
 
 from eegnetreplication_tpu.utils.platform import select_platform
 
+# The persistent compile cache would turn the second invocation's "compile"
+# into a cache read, silently corrupting the reported compile_s metric —
+# keep benchmark compiles honest (explicit env overrides still win).
+os.environ.setdefault("EEGTPU_COMPILE_CACHE", "0")
+
 PLATFORM = select_platform()  # never raises; falls back to CPU
 
 # Exactly-one-JSON-line guard: whichever of main() / the watchdog acquires
@@ -54,6 +59,14 @@ _EMIT_ONCE = threading.Lock()
 
 C, T, N_POOL, BATCH = 22, 257, 576, 64
 N_FOLDS = 4
+# Run-unique salt folded into every timed execution's PRNG keys.  Distinct
+# keys per rep defeat WITHIN-run result caching, but the tunneled backend
+# was also observed (round 2) replaying results ACROSS bench invocations:
+# deterministic keys made rep N of this run byte-identical to rep N of
+# yesterday's, and the "measurement" came back in ~4 ms (~112k fold-epochs/s,
+# a ~500x overstatement).  Fresh entropy per process makes every submitted
+# execution globally unique.
+RUN_SALT = int.from_bytes(os.urandom(4), "little")
 # The CPU path is the contract-safety fallback, not the measurement of
 # record; run it at smoke scale so the JSON line lands well inside the
 # watchdog deadline (100 epochs of the fused trainer on CPU takes >25 min).
@@ -83,14 +96,15 @@ def _fold_indices():
     return folds
 
 
-def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs):
+def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs, model_kwargs=None):
     """Shared timing core: (fold-epochs/sec, compile seconds).
 
     ``raw_folds`` is a list of (train_ids, val_ids, test_ids) over the pool.
     Warmup compiles; timed reps use a DIFFERENT key each time — re-running
     with inputs identical to the warmup lets the tunneled remote backend
     serve a cached result in ~7 ms, inflating round-1-style numbers ~250x.
-    Median of 3 honest reps.
+    Median of 3 honest reps.  ``model_kwargs`` overrides EEGNet fields (the
+    reduced-precision stage passes ``precision=None``).
     """
     import jax
     import jax.numpy as jnp
@@ -108,7 +122,7 @@ def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs):
     test_pad = max(len(f[2]) for f in raw_folds)
     n_folds = len(raw_folds)
 
-    model = EEGNet(n_channels=C, n_times=T)
+    model = EEGNet(n_channels=C, n_times=T, **(model_kwargs or {}))
     tx = make_optimizer()
     trainer = make_multi_fold_trainer(
         model, tx, batch_size=BATCH, epochs=epochs, train_pad=train_pad,
@@ -123,14 +137,15 @@ def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs):
     states = init_fold_states(model, tx, n_folds, (C, T))
     pool_x, pool_y = jnp.asarray(pool_x), jnp.asarray(pool_y)
 
+    base = jax.random.fold_in(jax.random.PRNGKey(0), RUN_SALT)
     t0 = time.perf_counter()
     jax.block_until_ready(trainer(
         pool_x, pool_y, stacked, states,
-        jax.random.split(jax.random.PRNGKey(0), n_folds)))
+        jax.random.split(jax.random.fold_in(base, 0), n_folds)))
     compile_s = time.perf_counter() - t0
     rates = []
     for rep in range(1, 4):
-        rep_keys = jax.random.split(jax.random.PRNGKey(rep), n_folds)
+        rep_keys = jax.random.split(jax.random.fold_in(base, rep), n_folds)
         t0 = time.perf_counter()
         jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
                                       rep_keys))
@@ -174,6 +189,27 @@ def bench_fold_scale(n_subjects: int = 9, epochs: int = 20) -> dict:
             "fold36_n_folds": len(raw_folds)}
 
 
+def bench_precision_modes(x, y, folds) -> dict:
+    """Headline workload at the MXU's native bf16-operand precision.
+
+    The headline metric runs the model's parity default (full-f32 MXU
+    passes, ``EEGNet.precision="highest"``); this stage measures the same
+    workload with backend-default matmul precision (`--precision default` on
+    the train CLI).  Known confound, flagged in the emitted record: a
+    non-"highest" model also fails the ``supports_fused_eval`` gate, so the
+    per-epoch validation passes use the plain conv-pair forward instead of
+    the algebraically fused one — the delta vs the headline mixes the
+    precision change with that (small: validation is ~1/5 of each epoch's
+    batches) eval-kernel change.
+    """
+    rate, compile_s = _time_fused_trainer(x, y, folds, EPOCHS,
+                                          model_kwargs={"precision": None})
+    return {"mxu_default_fold_epochs_per_s": round(rate, 2),
+            "mxu_default_compile_s": round(compile_s, 2),
+            "mxu_default_note": "eval path differs from headline "
+                                "(plain vs fused forward); see bench.py"}
+
+
 def bench_eval_kernels() -> dict:
     """Eval-forward microbench: plain apply vs fused-jnp vs Pallas kernel.
 
@@ -195,8 +231,9 @@ def bench_eval_kernels() -> dict:
     variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, C, T)),
                            train=False)
     params, bs = variables["params"], variables["batch_stats"]
-    pools = [jnp.asarray(np.random.RandomState(i).randn(N_POOL, C, T),
-                         jnp.float32) for i in range(4)]
+    pool_rng = np.random.RandomState(RUN_SALT % (2 ** 31))
+    pools = [jnp.asarray(pool_rng.randn(N_POOL, C, T), jnp.float32)
+             for _ in range(4)]
 
     plain = jax.jit(lambda xx: model.apply(
         {"params": params, "batch_stats": bs}, xx, train=False))
@@ -349,7 +386,9 @@ def main() -> None:
             # Budget guard: the 36-fold compile is the most expensive stage;
             # only start it while at least half the watchdog budget remains,
             # so a slow run degrades to a missing add-on field instead of a
-            # watchdog error over an already-valid headline metric.
+            # watchdog error over an already-valid headline metric.  Runs
+            # before the precision stage: fold36 is the older, richer metric
+            # and must not be starved by the newer add-on.
             if time.perf_counter() - t_start < 0.5 * deadline_s:
                 try:
                     record.update(bench_fold_scale())
@@ -358,6 +397,19 @@ def main() -> None:
                         f"{type(exc).__name__}: {exc}"[:200])
             else:
                 record["fold36_error"] = "skipped: insufficient time budget"
+        if os.environ.get("BENCH_SMOKE") or PLATFORM != "cpu":
+            # Same budget-guard pattern: a second full trainer compile must
+            # never risk the watchdog firing over a valid headline metric.
+            if (os.environ.get("BENCH_SMOKE")
+                    or time.perf_counter() - t_start < 0.6 * deadline_s):
+                try:  # reduced-precision twin of the headline workload
+                    record.update(bench_precision_modes(x, y, folds))
+                except Exception as exc:  # noqa: BLE001 — optional add-on
+                    record["mxu_default_error"] = (
+                        f"{type(exc).__name__}: {exc}"[:200])
+            else:
+                record["mxu_default_error"] = (
+                    "skipped: insufficient time budget")
     except Exception as exc:  # noqa: BLE001 — contract: always emit the line
         record["error"] = f"{type(exc).__name__}: {exc}"[:300]
     if _EMIT_ONCE.acquire(blocking=False):

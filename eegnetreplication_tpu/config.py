@@ -103,6 +103,15 @@ class TrainingConfig:
     # norms (model.py:43-44,83-84).  "reference" reproduces that behaviour;
     # "paper" applies the true L2 max-norm projection from Lawhern et al.
     maxnorm_mode: str = "reference"
+    # Numerics mode for the model's matmuls/convs:
+    #   "highest" — full-f32 MXU passes; tracks the torch-f32 reference
+    #               trajectory (the parity default).
+    #   "default" — backend-default matmul precision: the TPU MXU rounds
+    #               operands to bf16 (f32 accumulate), its native fast path.
+    #   "bf16"    — bf16 activations end-to-end as well (params stay f32;
+    #               logits come out of the bf16 classifier matmul and are
+    #               cast to f32 for the loss).
+    precision: str = "highest"
 
     def replace(self, **kw) -> "TrainingConfig":
         return dataclasses.replace(self, **kw)

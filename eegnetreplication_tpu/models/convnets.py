@@ -46,6 +46,9 @@ class ShallowConvNet(nn.Module):
     dropout_rate: float = 0.5
     momentum: float = 0.9
     dtype: jnp.dtype = jnp.float32
+    # MXU precision for convs/dense (see EEGNet.precision): "highest" keeps
+    # f32 matmuls for parity; None lets the backend round operands to bf16.
+    precision: str | None = "highest"
     # Named mesh axis for cross-device BatchNorm stat sync under data
     # parallelism (None = local-batch stats, the single-device semantics).
     bn_axis_name: str | None = None
@@ -62,10 +65,10 @@ class ShallowConvNet(nn.Module):
         x = x.astype(self.dtype)[..., None]  # (B, C, T, 1)
         x = nn.Conv(self.n_filters_time, (1, self.filter_time_length),
                     padding="VALID", use_bias=False,
-                    precision="highest", kernel_init=torch_kernel_init, dtype=self.dtype,
+                    precision=self.precision, kernel_init=torch_kernel_init, dtype=self.dtype,
                     name="temporal_conv")(x)
         x = nn.Conv(self.n_filters_spat, (self.n_channels, 1), padding="VALID",
-                    use_bias=False, precision="highest", kernel_init=torch_kernel_init,
+                    use_bias=False, precision=self.precision, kernel_init=torch_kernel_init,
                     dtype=self.dtype, name="spatial_conv")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -76,7 +79,7 @@ class ShallowConvNet(nn.Module):
         x = _safe_log(x)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.n_classes, precision="highest", kernel_init=torch_kernel_init,
+        x = nn.Dense(self.n_classes, precision=self.precision, kernel_init=torch_kernel_init,
                      dtype=self.dtype, name="classifier")(x)
         return x.astype(jnp.float32)
 
@@ -99,6 +102,9 @@ class DeepConvNet(nn.Module):
     dropout_rate: float = 0.5
     momentum: float = 0.9
     dtype: jnp.dtype = jnp.float32
+    # MXU precision for convs/dense (see EEGNet.precision): "highest" keeps
+    # f32 matmuls for parity; None lets the backend round operands to bf16.
+    precision: str | None = "highest"
     # Named mesh axis for cross-device BatchNorm stat sync under data
     # parallelism (None = local-batch stats, the single-device semantics).
     bn_axis_name: str | None = None
@@ -119,10 +125,10 @@ class DeepConvNet(nn.Module):
 
         # Block 1: temporal conv + spatial conv + BN + ELU + maxpool.
         x = nn.Conv(self.filters[0], (1, self.kernel_length), padding="VALID",
-                    use_bias=False, precision="highest", kernel_init=torch_kernel_init,
+                    use_bias=False, precision=self.precision, kernel_init=torch_kernel_init,
                     dtype=self.dtype, name="temporal_conv")(x)
         x = nn.Conv(self.filters[0], (self.n_channels, 1), padding="VALID",
-                    use_bias=False, precision="highest", kernel_init=torch_kernel_init,
+                    use_bias=False, precision=self.precision, kernel_init=torch_kernel_init,
                     dtype=self.dtype, name="spatial_conv")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -134,7 +140,7 @@ class DeepConvNet(nn.Module):
         for i, width in enumerate(self.filters[1:], start=1):
             x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
             x = nn.Conv(width, (1, self.kernel_length), padding="VALID",
-                        use_bias=False, precision="highest", kernel_init=torch_kernel_init,
+                        use_bias=False, precision=self.precision, kernel_init=torch_kernel_init,
                         dtype=self.dtype, name=f"conv_{i}")(x)
             x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
@@ -144,6 +150,6 @@ class DeepConvNet(nn.Module):
                             strides=(1, self.pool_length))
 
         x = x.reshape((x.shape[0], -1))
-        x = nn.Dense(self.n_classes, precision="highest", kernel_init=torch_kernel_init,
+        x = nn.Dense(self.n_classes, precision=self.precision, kernel_init=torch_kernel_init,
                      dtype=self.dtype, name="classifier")(x)
         return x.astype(jnp.float32)

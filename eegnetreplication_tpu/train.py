@@ -51,6 +51,13 @@ def build_parser() -> argparse.ArgumentParser:
                         choices=["reference", "paper"],
                         help="Max-norm behaviour: reference grad-clamp (Q1) "
                              "or true paper weight projection.")
+    parser.add_argument("--precision", type=str, default="highest",
+                        choices=["highest", "default", "bf16"],
+                        help="Model numerics: 'highest' = full-f32 MXU "
+                             "passes (parity with the torch-f32 reference); "
+                             "'default' = backend matmul precision (TPU "
+                             "rounds operands to bf16 — faster); 'bf16' = "
+                             "bf16 activations end-to-end.")
     parser.add_argument("--subjects", type=str, default=None,
                         help="Comma-separated subject ids (default: 1-9).")
     parser.add_argument("--profileDir", type=str, default=None,
@@ -92,7 +99,8 @@ def main() -> None:
         generate_ws_report,
     )
 
-    config = DEFAULT_TRAINING.replace(maxnorm_mode=args.maxnormMode)
+    config = DEFAULT_TRAINING.replace(maxnorm_mode=args.maxnormMode,
+                                      precision=args.precision)
     subjects = (tuple(int(s) for s in args.subjects.split(","))
                 if args.subjects else tuple(range(1, 10)))
     if args.trainingType != "Within-Subject":

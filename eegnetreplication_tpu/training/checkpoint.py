@@ -128,6 +128,11 @@ def load_run_snapshot(path: str | Path, carry_template: Any,
     with np.load(Path(path), allow_pickle=False) as data:
         flat = {k: data[k] for k in data.files}
     stored = json.loads(bytes(flat.pop("__signature__")).decode())
+    # No backfilling of missing keys: "maxnorm_mode"'s flag predates its
+    # signature key, so a legacy snapshot may have run in either mode —
+    # guessing a default here would let a paper-mode carry resume under
+    # reference-mode rules.  Legacy snapshots are rejected loudly instead
+    # (they are short-lived crash artifacts).
     if stored != signature:
         raise ValueError(
             f"Snapshot {path} belongs to a different run: {stored} != "

@@ -74,8 +74,13 @@ def permutation_test(X: np.ndarray, y: np.ndarray, *,
     for p in range(1, n_permutations + 1):
         pool_ys[p, tv] = pool_ys[p, rng.permutation(tv)]
 
+    from eegnetreplication_tpu.training.protocols import (
+        _model_kwargs_for_precision,
+    )
+
     model = get_model(model_name, n_channels=X.shape[1], n_times=X.shape[2],
-                      dropout_rate=config.dropout_within_subject)
+                      dropout_rate=config.dropout_within_subject,
+                      **_model_kwargs_for_precision(config))
     # In-program eval uses the fused jnp path (eval_step pins
     # allow_pallas=False inside large scanned programs; see steps.py).
     tx = make_optimizer(config.learning_rate, config.adam_eps)

@@ -109,6 +109,21 @@ def _model_kwargs_for_mesh(mesh) -> dict:
     return {}
 
 
+def _model_kwargs_for_precision(config: TrainingConfig) -> dict:
+    """Model kwargs for the config's numerics mode (see TrainingConfig)."""
+    import jax.numpy as jnp
+
+    if config.precision == "highest":
+        return {}  # the models' parity default
+    if config.precision == "default":
+        return {"precision": None}
+    if config.precision == "bf16":
+        return {"precision": None, "dtype": jnp.bfloat16}
+    raise ValueError(
+        f"Unknown precision mode {config.precision!r}; "
+        "expected 'highest', 'default', or 'bf16'")
+
+
 def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                config: TrainingConfig, epochs: int, seed: int, mesh=None,
                checkpoint_every: int | None = None,
@@ -179,8 +194,12 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     # --- chunked, resumable path ---
     # padded_folds in the signature: a snapshot from a different device
     # topology (different fold padding) must not pour into this template.
+    # maxnorm_mode/precision too: resuming a carry under different update
+    # rules or matmul numerics would silently change the science.
     signature = dict(signature or {}, epochs=epochs, n_folds=n_folds,
-                     padded_folds=padded, seed=seed)
+                     padded_folds=padded, seed=seed,
+                     maxnorm_mode=config.maxnorm_mode,
+                     precision=config.precision)
     if epochs % checkpoint_every:
         logger.warning(
             "epochs (%d) is not a multiple of checkpoint_every (%d): the "
@@ -302,7 +321,8 @@ def within_subject_training(epochs: int | None = None, *,
     n_ch, n_t = pool_x.shape[1], pool_x.shape[2]
     model = get_model(model_name, n_channels=n_ch, n_times=n_t,
                       dropout_rate=config.dropout_within_subject,
-                      **_model_kwargs_for_mesh(mesh))
+                      **_model_kwargs_for_mesh(mesh),
+                      **_model_kwargs_for_precision(config))
 
     # Build the 4 folds per subject (reference fold order preserved).
     raw_folds: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
@@ -386,7 +406,8 @@ def cross_subject_training(epochs: int | None = None, *,
     n_ch, n_t = pool_x.shape[1], pool_x.shape[2]
     model = get_model(model_name, n_channels=n_ch, n_times=n_t,
                       dropout_rate=config.dropout_cross_subject,
-                      **_model_kwargs_for_mesh(mesh))
+                      **_model_kwargs_for_mesh(mesh),
+                      **_model_kwargs_for_precision(config))
 
     raw_folds = []
     fold_count = 0

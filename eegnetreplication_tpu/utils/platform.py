@@ -178,16 +178,87 @@ def force_cpu(n_devices: int | None = None) -> bool:
     return not initialized
 
 
+def enable_compilation_cache() -> str | None:
+    """Point JAX's persistent compilation cache at a per-user directory.
+
+    The fused protocol trainers are one large XLA program; its first compile
+    costs ~65 s on the tunneled TPU backend (measured round 2) and dominates
+    short CLI runs.  The persistent cache replays the compiled executable on
+    the next invocation with the same program/backend, cutting that fixed
+    cost to cache-read time.  Per-user path for the same reason as the probe
+    cache (a shared path would let one user poison another's executables);
+    ``EEGTPU_COMPILE_CACHE=0`` disables, any other value overrides the
+    directory.  Best-effort: returns the directory or None, never raises.
+
+    Only wired up for accelerator backends (see :func:`select_platform`):
+    XLA:CPU caches AOT machine code keyed loosely enough that a reload can
+    cross CPU-feature sets (observed here: error-level feature-mismatch spam
+    and a documented SIGILL risk) — and CPU compiles are fast anyway.
+    """
+    setting = os.environ.get("EEGTPU_COMPILE_CACHE", "")
+    if setting.lower() in ("0", "false", "no", "off"):
+        return None
+    explicit = bool(setting)  # user opted in/pointed somewhere: warn on drop
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    # "1"/"true"/... mean "enable with the default path", not a directory
+    # literally named "1" in the current cwd; other values are directories
+    # (relative ones anchored at the cwd explicitly, not dropped silently).
+    if setting.lower() in ("1", "true", "yes", "on"):
+        setting = ""
+    elif setting:
+        setting = os.path.abspath(setting)
+    path = setting or f"/tmp/eegtpu_xla_cache.{uid}"
+    try:
+        # The cache holds compiled executables JAX will deserialize and run,
+        # so the uid suffix alone is not enough: an attacker could pre-create
+        # the predictable path and own its contents — or plant a symlink
+        # into a victim-owned directory (lstat check).  Create 0700, verify
+        # not-a-link + ownership + mode; on any doubt, run without the cache.
+        os.makedirs(path, mode=0o700, exist_ok=True)
+        bad = None
+        if os.path.islink(path):
+            bad = "path is a symlink"
+        else:
+            st = os.stat(path)
+            if hasattr(os, "getuid") and st.st_uid != os.getuid():
+                bad = "directory not owned by this user"
+            elif st.st_mode & 0o022:
+                bad = "directory is group/world-writable"
+        if bad:
+            if explicit:  # the user explicitly opted in
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "EEGTPU_COMPILE_CACHE: %s rejected (%s); running "
+                    "without the compilation cache", path, bad)
+            return None
+
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # The model is tiny; default thresholds (2 s / 32 KiB) would skip
+        # exactly the small-but-tunnel-expensive programs we care about.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # noqa: BLE001 — cache is an optimization only
+        return None
+    return path
+
+
 def select_platform(probe_timeout_s: float | None = None) -> str:
     """Pick the JAX platform before any in-process backend init.
 
     ``EEGTPU_PLATFORM`` wins when set; otherwise probe the accelerator in a
     subprocess and fall back to CPU when the probe fails or hangs.  Never
-    raises — on any unexpected error the CPU fallback is applied.
+    raises — on any unexpected error the CPU fallback is applied.  When an
+    accelerator is selected, also enables the persistent compilation cache
+    (see :func:`enable_compilation_cache`).
     """
     try:
         forced = apply_platform_override()
         if forced:
+            if forced != "cpu":
+                enable_compilation_cache()
             return forced
         if probe_timeout_s is None:
             try:
@@ -197,6 +268,7 @@ def select_platform(probe_timeout_s: float | None = None) -> str:
                 probe_timeout_s = 90.0
         accel = probe_accelerator(probe_timeout_s)
         if accel is not None:
+            enable_compilation_cache()
             return accel  # ambient pin works; leave it in charge
     except Exception:
         pass

@@ -1,0 +1,77 @@
+"""Platform-selection utilities (offline-safe parts).
+
+The probe itself needs a subprocess + possibly a live accelerator, so these
+tests cover the pure-config pieces: the persistent compilation cache wiring
+and the EEGTPU_PLATFORM override plumbing.
+"""
+
+import os
+from unittest import mock
+
+import jax
+
+from eegnetreplication_tpu.utils.platform import enable_compilation_cache
+
+
+def _restore_cache_config():
+    return (
+        jax.config.jax_compilation_cache_dir,
+        jax.config.jax_persistent_cache_min_compile_time_secs,
+        jax.config.jax_persistent_cache_min_entry_size_bytes,
+    )
+
+
+def _set_cache_config(saved):
+    jax.config.update("jax_compilation_cache_dir", saved[0])
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", saved[1])
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", saved[2])
+
+
+def test_enable_compilation_cache_sets_config(tmp_path):
+    saved = _restore_cache_config()
+    try:
+        target = str(tmp_path / "xla_cache")
+        with mock.patch.dict(os.environ,
+                             {"EEGTPU_COMPILE_CACHE": target}):
+            assert enable_compilation_cache() == target
+        assert jax.config.jax_compilation_cache_dir == target
+        # Thresholds lowered so the tiny-but-tunnel-expensive programs cache.
+        assert jax.config.jax_persistent_cache_min_compile_time_secs <= 1.0
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+    finally:
+        _set_cache_config(saved)
+
+
+def test_enable_compilation_cache_disabled(tmp_path):
+    saved = _restore_cache_config()
+    try:
+        for off in ("0", "false", "off"):
+            with mock.patch.dict(os.environ, {"EEGTPU_COMPILE_CACHE": off}):
+                assert enable_compilation_cache() is None
+    finally:
+        _set_cache_config(saved)
+
+
+def test_enable_compilation_cache_truthy_means_default_path():
+    """'=1' must enable the default path, not create a cwd dir named '1'."""
+    saved = _restore_cache_config()
+    try:
+        for on in ("1", "true"):
+            with mock.patch.dict(os.environ, {"EEGTPU_COMPILE_CACHE": on}):
+                path = enable_compilation_cache()
+            assert path is not None
+            assert path.startswith("/tmp/eegtpu_xla_cache.")
+        assert not os.path.exists("1")
+    finally:
+        _set_cache_config(saved)
+
+
+def test_enable_compilation_cache_default_is_per_user():
+    saved = _restore_cache_config()
+    try:
+        with mock.patch.dict(os.environ, clear=False) as env:
+            env.pop("EEGTPU_COMPILE_CACHE", None)
+            path = enable_compilation_cache()
+        assert path is not None and path.startswith("/tmp/eegtpu_xla_cache.")
+    finally:
+        _set_cache_config(saved)

@@ -158,3 +158,48 @@ class TestChunkedResume:
                 epochs=4, config=CFG, loader=loader, subjects=(1,),
                 paths=tmp_paths, seed=0, save_models=False,
                 checkpoint_every=2, resume=True)
+
+    def test_numerics_change_rejected_on_resume(self, tmp_paths):
+        """Resuming a carry under different numerics or update rules would
+        silently change the science — the signature must refuse."""
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        for cfg in (CFG.replace(precision="bf16"),
+                    CFG.replace(maxnorm_mode="paper")):
+            loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+            with pytest.raises(ValueError, match="different run"):
+                within_subject_training(
+                    epochs=6, config=cfg, loader=loader, subjects=(1,),
+                    paths=tmp_paths, seed=0, save_models=False,
+                    checkpoint_every=2, resume=True)
+
+
+class TestPrecisionModes:
+    """The TPU numerics knob: 'highest' (parity default) vs 'default'/'bf16'."""
+
+    def test_model_kwargs_mapping(self):
+        import jax.numpy as jnp
+
+        from eegnetreplication_tpu.training.protocols import (
+            _model_kwargs_for_precision,
+        )
+
+        assert _model_kwargs_for_precision(CFG) == {}
+        assert (_model_kwargs_for_precision(CFG.replace(precision="default"))
+                == {"precision": None})
+        bf16 = _model_kwargs_for_precision(CFG.replace(precision="bf16"))
+        assert bf16 == {"precision": None, "dtype": jnp.bfloat16}
+        with pytest.raises(ValueError, match="precision"):
+            _model_kwargs_for_precision(CFG.replace(precision="fp8"))
+
+    @pytest.mark.parametrize("mode", ["default", "bf16"])
+    def test_protocol_trains_and_learns(self, tmp_paths, mode):
+        """Reduced-precision runs stay finite and beat chance on an easy
+        separable task (trajectories differ from f32 by design)."""
+        loader = make_loader(n_trials=32, n_channels=6, n_times=64,
+                             class_sep=1.5)
+        result = within_subject_training(
+            epochs=25, config=CFG.replace(precision=mode), loader=loader,
+            subjects=(1,), paths=tmp_paths, seed=0, save_models=False)
+        assert np.isfinite(result.avg_test_acc)
+        assert result.avg_test_acc > 40.0
