@@ -82,6 +82,20 @@ def _synthetic_pool(seed: int = 0):
     return x, y
 
 
+def _assert_fresh(digests: list[bytes], what: str) -> None:
+    """Replay guard: distinct-input executions must yield distinct bytes.
+
+    A broken tunnel was observed (2026-07-30) acknowledging repeat
+    executions instantly with stale result buffers; identical digests mean
+    the backend replayed a result instead of computing one, and the
+    measured rate is fiction.
+    """
+    if len(set(digests)) < len(digests):
+        raise RuntimeError(
+            f"backend replayed identical results across {what}; timing "
+            "invalid (tunnel result-cache or faulted device)")
+
+
 def _fold_indices():
     """4-fold split with inner 80/20 train/val, like train.py:70-79."""
     from eegnetreplication_tpu.data.splits import (
@@ -139,17 +153,24 @@ def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs, model_kwargs=None):
 
     base = jax.random.fold_in(jax.random.PRNGKey(0), RUN_SALT)
     t0 = time.perf_counter()
-    jax.block_until_ready(trainer(
-        pool_x, pool_y, stacked, states,
-        jax.random.split(jax.random.fold_in(base, 0), n_folds)))
+    warm = trainer(pool_x, pool_y, stacked, states,
+                   jax.random.split(jax.random.fold_in(base, 0), n_folds))
+    # Materialize to host bytes, not just block_until_ready: a broken
+    # tunnel was observed acknowledging executions instantly with stale
+    # buffers (2026-07-30), and real D2H bytes are the strongest liveness
+    # signal available from this side.
+    digests = [np.asarray(warm.val_accuracies).tobytes()]
     compile_s = time.perf_counter() - t0
     rates = []
     for rep in range(1, 4):
         rep_keys = jax.random.split(jax.random.fold_in(base, rep), n_folds)
         t0 = time.perf_counter()
-        jax.block_until_ready(trainer(pool_x, pool_y, stacked, states,
-                                      rep_keys))
+        out = trainer(pool_x, pool_y, stacked, states, rep_keys)
+        digests.append(np.asarray(out.val_accuracies).tobytes())
         rates.append(n_folds * epochs / (time.perf_counter() - t0))
+    # Distinct PRNG keys produce distinct epoch shuffles, so genuine
+    # executions cannot return identical validation trajectories.
+    _assert_fresh(digests, "distinct-key training reps")
     return float(np.median(rates)), compile_s
 
 
@@ -247,11 +268,12 @@ def bench_eval_kernels() -> dict:
     out = {}
     for name, fn in variants.items():
         jax.block_until_ready(fn(pools[0]))  # compile
-        reps = []
+        reps, digests = [], []
         for i in (1, 2, 3):
             t0 = time.perf_counter()
-            jax.block_until_ready(fn(pools[i]))
+            digests.append(np.asarray(fn(pools[i])).tobytes())  # real D2H
             reps.append(N_POOL / (time.perf_counter() - t0))
+        _assert_fresh(digests, f"distinct input pools ({name})")
         out[name + "_trials_per_s"] = round(float(np.median(reps)))
     return out
 

@@ -2,10 +2,11 @@
 
 The reference has no inference entry point at all — trained checkpoints are
 only ever loaded for filter visualization (``ui.py:26-36``).  This CLI makes
-trained models usable: it loads a checkpoint (native ``.npz`` or a reference
-``.pth`` via the interop layer), classifies trials (a ``-trials.npz`` file,
-or a subject's processed session), and reports per-class counts plus
-accuracy when labels are present.
+trained models usable: it loads a checkpoint (native ``.npz``, an Orbax
+checkpoint directory, or a reference ``.pth`` via the interop layer),
+classifies trials (a ``-trials.npz`` file, or a subject's processed
+session), and reports per-class counts plus accuracy when labels are
+present.
 
 This is also the product home of the Pallas block-1 kernel: batch inference
 runs through ``steps.eval_forward`` with ``allow_pallas=True``, which on a
@@ -32,7 +33,8 @@ CLASS_NAMES = ("left hand", "right hand", "feet", "tongue")
 
 
 def load_model_from_checkpoint(path: str | Path):
-    """(model, params, batch_stats) from a native .npz or reference .pth."""
+    """(model, params, batch_stats) from a native .npz, an Orbax checkpoint
+    directory, or a reference .pth."""
     from eegnetreplication_tpu.models import EEGNet
     from eegnetreplication_tpu.training import checkpoint as ckpt_lib
 
@@ -44,7 +46,12 @@ def load_model_from_checkpoint(path: str | Path):
         model = EEGNet(n_channels=meta["n_channels"],
                        n_times=meta["n_times"], F1=meta["F1"], D=meta["D"])
         return model, params, batch_stats
-    params, batch_stats, meta = ckpt_lib.load_checkpoint(path)
+    if path.is_dir():
+        from eegnetreplication_tpu.training import orbax_io
+
+        params, batch_stats, meta = orbax_io.load_orbax_checkpoint(path)
+    else:
+        params, batch_stats, meta = ckpt_lib.load_checkpoint(path)
     kwargs = {k: meta[k] for k in ("n_channels", "n_times", "F1", "D")
               if k in meta}
     if meta.get("model", "eegnet") != "eegnet":
@@ -96,7 +103,8 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Classify EEG trials with a trained checkpoint.")
     parser.add_argument("--checkpoint", required=True,
-                        help=".npz (native) or .pth (reference format).")
+                        help=".npz (native), an Orbax checkpoint directory, "
+                             "or .pth (reference format).")
     src = parser.add_mutually_exclusive_group(required=True)
     src.add_argument("--input", help="A -trials.npz file to classify.")
     src.add_argument("--subject", type=int,

@@ -38,7 +38,9 @@ def configure(log_file: str = "app.log", level: int = logging.DEBUG) -> logging.
         root.setLevel(level)
     # A DEBUG root logger would otherwise stream every JAX-internal dispatch
     # line; keep the framework's own logs at DEBUG but quiet the libraries.
-    for noisy in ("jax", "jax._src", "orbax", "absl", "matplotlib", "PIL"):
+    for noisy in ("jax", "jax._src", "orbax", "absl", "matplotlib", "PIL",
+                  "asyncio"):  # orbax drives asyncio; its selector DEBUG
+        # lines would otherwise flood the root-DEBUG contract
         logging.getLogger(noisy).setLevel(logging.WARNING)
     _configured = True
     return root

@@ -1,0 +1,141 @@
+"""Orbax checkpoint backend: roundtrip, resume, async, npz equivalence.
+
+Mirrors the guarantees tests of the native ``.npz`` format
+(``tests/test_checkpoint.py``) for the Orbax directory format that
+multi-host deployments use (SURVEY.md §5: Orbax-style (params, opt_state,
+step) checkpoints as the TPU equivalent of the reference's save-only
+``torch.save``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytest.importorskip("orbax.checkpoint")
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.training import checkpoint as ckpt
+from eegnetreplication_tpu.training import orbax_io
+from eegnetreplication_tpu.training.steps import (
+    TrainState,
+    make_optimizer,
+    train_step,
+)
+
+
+@pytest.fixture
+def small_net():
+    model = EEGNet(n_channels=8, n_times=64)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 64)),
+                           train=False)
+    return model, variables
+
+
+def _leaves_equal(a, b):
+    la, lb = (jax.tree_util.tree_leaves(t) for t in (a, b))
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+class TestOrbaxRoundtrip:
+    def test_roundtrip_and_metadata(self, tmp_path, small_net):
+        model, variables = small_net
+        meta = {"model": "eegnet", "n_times": 64}  # Q4: T stays explicit
+        p = orbax_io.save_orbax_checkpoint(
+            tmp_path / "ck_orbax", variables["params"],
+            variables["batch_stats"], meta)
+        params, batch_stats, metadata = orbax_io.load_orbax_checkpoint(p)
+        assert metadata == meta
+        _leaves_equal(variables["params"], params)
+        restored = {"params": params, "batch_stats": batch_stats}
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 8, 64), jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(model.apply(variables, x, train=False)),
+            np.asarray(model.apply(restored, x, train=False)))
+
+    def test_restore_with_target_tree(self, tmp_path, small_net):
+        _, variables = small_net
+        p = orbax_io.save_orbax_checkpoint(
+            tmp_path / "ck_target", variables["params"],
+            variables["batch_stats"])
+        target = {"params": variables["params"],
+                  "batch_stats": variables["batch_stats"]}
+        params, _, _ = orbax_io.load_orbax_checkpoint(p, target=target)
+        _leaves_equal(variables["params"], params)
+
+    def test_matches_npz_format(self, tmp_path, small_net):
+        """Both formats must carry the identical state."""
+        _, variables = small_net
+        npz = ckpt.save_checkpoint(tmp_path / "ck.npz", variables["params"],
+                                   variables["batch_stats"], {"m": 1})
+        orb = orbax_io.save_orbax_checkpoint(
+            tmp_path / "ck_orbax", variables["params"],
+            variables["batch_stats"], {"m": 1})
+        p_npz, bs_npz, meta_npz = ckpt.load_checkpoint(npz)
+        p_orb, bs_orb, meta_orb = orbax_io.load_orbax_checkpoint(orb)
+        assert meta_npz == meta_orb
+        _leaves_equal(p_npz, p_orb)
+        _leaves_equal(bs_npz, bs_orb)
+
+
+class TestOrbaxResume:
+    def test_train_state_resumes_identically(self, tmp_path, small_net):
+        model, variables = small_net
+        tx = make_optimizer()
+        state = TrainState.create(variables, tx)
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(16, 8, 64), jnp.float32)
+        y = jnp.asarray(rng.randint(0, 4, 16), jnp.int32)
+        w = jnp.ones(16)
+        for i in range(3):  # non-trivial Adam moments
+            state, _ = train_step(model, tx, state, x, y, w,
+                                  jax.random.PRNGKey(i))
+
+        p = orbax_io.save_orbax_checkpoint(
+            tmp_path / "resume_orbax", state.params, state.batch_stats,
+            {"model": "eegnet"}, opt_state=state.opt_state, step=3)
+        restored, step, meta = orbax_io.load_orbax_train_state(p, tx)
+        assert step == 3 and meta["model"] == "eegnet"
+
+        next_a, loss_a = train_step(model, tx, state, x, y, w,
+                                    jax.random.PRNGKey(9))
+        next_b, loss_b = train_step(model, tx, restored, x, y, w,
+                                    jax.random.PRNGKey(9))
+        assert float(loss_a) == float(loss_b)
+        _leaves_equal(next_a.params, next_b.params)
+        _leaves_equal(next_a.opt_state, next_b.opt_state)
+
+    def test_weights_only_is_not_resumable(self, tmp_path, small_net):
+        _, variables = small_net
+        p = orbax_io.save_orbax_checkpoint(
+            tmp_path / "wo_orbax", variables["params"],
+            variables["batch_stats"])
+        with pytest.raises(ValueError, match="not resumable"):
+            orbax_io.load_orbax_train_state(p, make_optimizer())
+
+
+class TestOrbaxAsync:
+    def test_background_save_commits_after_wait(self, tmp_path, small_net):
+        _, variables = small_net
+        p = orbax_io.save_orbax_checkpoint(
+            tmp_path / "async_orbax", variables["params"],
+            variables["batch_stats"], {"bg": True}, background=True)
+        orbax_io.wait_for_async_saves()
+        params, _, meta = orbax_io.load_orbax_checkpoint(p)
+        assert meta == {"bg": True}
+        _leaves_equal(variables["params"], params)
+
+
+class TestInterruptedSave:
+    def test_missing_metadata_rejected_loudly(self, tmp_path, small_net):
+        """A save that died between state commit and metadata write must not
+        silently load with default model geometry."""
+        _, variables = small_net
+        p = orbax_io.save_orbax_checkpoint(
+            tmp_path / "torn", variables["params"], variables["batch_stats"],
+            {"n_times": 64})
+        (p / "metadata.json").unlink()
+        with pytest.raises(FileNotFoundError, match="interrupted"):
+            orbax_io.load_orbax_checkpoint(p)

@@ -99,3 +99,24 @@ class TestCheckpointGeometry:
         torch.save(sd, p)
         with pytest.raises(ValueError, match="multiple of F1"):
             load_pth_auto(p)
+
+
+class TestOrbaxCheckpointLoading:
+    def test_orbax_directory_roundtrip(self, tmp_path, small_model):
+        """predict's loader accepts an Orbax checkpoint directory."""
+        pytest.importorskip("orbax.checkpoint")
+        from eegnetreplication_tpu.training.orbax_io import (
+            save_orbax_checkpoint,
+        )
+
+        model, params, bs = small_model
+        p = save_orbax_checkpoint(
+            tmp_path / "orbax_ck", params, bs,
+            {"model": "eegnet", "n_channels": 6, "n_times": 64,
+             "F1": 8, "D": 2})
+        loaded_model, lp, lbs = load_model_from_checkpoint(p)
+        assert (loaded_model.n_channels, loaded_model.n_times) == (6, 64)
+        x = np.random.RandomState(2).randn(8, 6, 64).astype(np.float32)
+        np.testing.assert_array_equal(
+            predict_trials(model, params, bs, x),
+            predict_trials(loaded_model, lp, lbs, x))
