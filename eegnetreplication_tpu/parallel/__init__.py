@@ -7,6 +7,7 @@ from eegnetreplication_tpu.parallel.dp import (  # noqa: F401
 from eegnetreplication_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     FOLD_AXIS,
+    initialize_distributed,
     make_hybrid_mesh,
     make_mesh,
     mesh_size,

@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Comma-separated subject ids (default: 1-9).")
     parser.add_argument("--profileDir", type=str, default=None,
                         help="Write a jax.profiler trace (TensorBoard) here.")
+    parser.add_argument("--ckptFormat", type=str, default="npz",
+                        choices=["npz", "orbax"],
+                        help="Native artifact format for saved models: npz "
+                             "single file, or an Orbax checkpoint directory "
+                             "(async/sharded-capable). The reference-interop "
+                             ".pth export is always written.")
     parser.add_argument("--checkpointEvery", type=int, default=0,
                         help="Snapshot the run every N epochs (0 = off); a "
                              "crashed run restarts from the last snapshot "
@@ -136,6 +142,7 @@ def main() -> None:
                                              seed=args.seed, mesh=mesh,
                                              model_name=args.model,
                                              subjects=subjects,
+                                             ckpt_format=args.ckptFormat,
                                              checkpoint_every=args.checkpointEvery,
                                              resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
@@ -152,6 +159,7 @@ def main() -> None:
                                             seed=args.seed, mesh=mesh,
                                             model_name=args.model,
                                             subjects=subjects,
+                                            ckpt_format=args.ckptFormat,
                                             checkpoint_every=args.checkpointEvery,
                                             resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",

@@ -53,16 +53,19 @@ def wait_for_async_saves() -> None:
     orphan an older, successfully committed checkpoint); failed entries
     stay pending for a retry and their errors are re-raised aggregated.
     """
-    failures: list[tuple[Any, Exception]] = []
+    failures: list[tuple[tuple, Exception]] = []
     while _ASYNC_PENDING:
-        entry = _ASYNC_PENDING.pop(0)  # oldest first
-        ckptr, path, metadata = entry
+        ckptr, path, metadata = _ASYNC_PENDING.pop(0)  # oldest first
         try:
-            ckptr.wait_until_finished()
-            ckptr.close()
+            if ckptr is not None:  # None: wait/close already done, only the
+                # metadata write is being retried (a closed checkpointer
+                # cannot be waited on again)
+                ckptr.wait_until_finished()
+                ckptr.close()
+                ckptr = None
             (path / _METADATA_FILE).write_text(json.dumps(metadata))
         except Exception as exc:  # noqa: BLE001 — aggregate, keep going
-            failures.append((entry, exc))
+            failures.append(((ckptr, path, metadata), exc))
     if failures:
         _ASYNC_PENDING.extend(entry for entry, _ in failures)
         raise RuntimeError(

@@ -109,6 +109,14 @@ def _model_kwargs_for_mesh(mesh) -> dict:
     return {}
 
 
+def _check_ckpt_format(ckpt_format: str) -> None:
+    """Reject unknown formats at protocol ENTRY: failing at save time would
+    throw away a completed (possibly hours-long) training run."""
+    if ckpt_format not in ("npz", "orbax"):
+        raise ValueError(
+            f"Unknown ckpt_format {ckpt_format!r}; expected 'npz' or 'orbax'")
+
+
 def _model_kwargs_for_precision(config: TrainingConfig) -> dict:
     """Model kwargs for the config's numerics mode (see TrainingConfig)."""
     import jax.numpy as jnp
@@ -282,7 +290,11 @@ def _fold_state(results, fold: int):
                                   results.best_state)
 
 
-def _save_model(state, model, model_name: str, path) -> None:
+def _save_model(state, model, model_name: str, path,
+                ckpt_format: str = "npz") -> None:
+    """Persist a trained model: reference-interop ``.pth`` (always, for the
+    GUI/visualization boundary) plus the native artifact in ``ckpt_format``
+    ("npz" single file, or "orbax" directory — async/sharded-capable)."""
     if isinstance(model, EEGNet):
         try:
             ckpt_lib.save_pth(path, state.params, state.batch_stats,
@@ -294,6 +306,16 @@ def _save_model(state, model, model_name: str, path) -> None:
                 "n_times": model.n_times}
     if isinstance(model, EEGNet):
         metadata.update(F1=model.F1, D=model.D)
+    if ckpt_format == "orbax":
+        from eegnetreplication_tpu.training import orbax_io
+
+        orbax_io.save_orbax_checkpoint(
+            str(path).replace(".pth", ".orbax"), state.params,
+            state.batch_stats, metadata=metadata)
+        return
+    if ckpt_format != "npz":
+        raise ValueError(
+            f"Unknown ckpt_format {ckpt_format!r}; expected 'npz' or 'orbax'")
     ckpt_lib.save_checkpoint(str(path).replace(".pth", ".npz"), state.params,
                              state.batch_stats, metadata=metadata)
 
@@ -306,10 +328,12 @@ def within_subject_training(epochs: int | None = None, *,
                             paths: Paths | None = None,
                             model_name: str = "eegnet",
                             save_models: bool = True,
+                            ckpt_format: str = "npz",
                             checkpoint_every: int | None = None,
                             resume: bool = False,
                             _crash_after_chunk: int | None = None) -> ProtocolResult:
     """Within-subject protocol: per subject, 4-fold CV over both sessions."""
+    _check_ckpt_format(ckpt_format)
     epochs = epochs if epochs is not None else config.epochs
     paths = paths or Paths.from_here()
 
@@ -366,7 +390,8 @@ def within_subject_training(epochs: int | None = None, *,
         if save_models:
             paths.models.mkdir(parents=True, exist_ok=True)
             _save_model(best_states[-1], model, model_name,
-                        paths.models / f"subject_{s:02d}_best_model.pth")
+                        paths.models / f"subject_{s:02d}_best_model.pth",
+                        ckpt_format=ckpt_format)
 
     avg = float(np.mean(per_subject_test_acc))
     logger.info("Overall Average Test Accuracy across all subjects: %.2f%%", avg)
@@ -382,10 +407,12 @@ def cross_subject_training(epochs: int | None = None, *,
                            paths: Paths | None = None,
                            model_name: str = "eegnet",
                            save_models: bool = True,
+                           ckpt_format: str = "npz",
                            checkpoint_every: int | None = None,
                            resume: bool = False,
                            _crash_after_chunk: int | None = None) -> ProtocolResult:
     """Cross-subject protocol: 5-train/3-val/1-test subjects, 10 repeats."""
+    _check_ckpt_format(ckpt_format)
     epochs = epochs if epochs is not None else config.epochs
     paths = paths or Paths.from_here()
     n_subjects = len(subjects)
@@ -457,7 +484,8 @@ def cross_subject_training(epochs: int | None = None, *,
     if save_models:
         paths.models.mkdir(parents=True, exist_ok=True)
         _save_model(best_state, model, model_name,
-                    paths.models / "cross_subject_best_model.pth")
+                    paths.models / "cross_subject_best_model.pth",
+                    ckpt_format=ckpt_format)
 
     return ProtocolResult(per_subject_test_acc, avg_all, [best_state],
                           fold_test, wall, epochs, tuple(subjects))

@@ -203,3 +203,26 @@ class TestPrecisionModes:
             subjects=(1,), paths=tmp_paths, seed=0, save_models=False)
         assert np.isfinite(result.avg_test_acc)
         assert result.avg_test_acc > 40.0
+
+
+class TestOrbaxArtifacts:
+    def test_ws_protocol_saves_orbax_directories(self, tmp_paths):
+        pytest.importorskip("orbax.checkpoint")
+        from eegnetreplication_tpu.predict import load_model_from_checkpoint
+
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        within_subject_training(
+            epochs=2, config=CFG, loader=loader, subjects=(1,),
+            paths=tmp_paths, seed=0, ckpt_format="orbax")
+        orbax_dir = tmp_paths.models / "subject_01_best_model.orbax"
+        assert orbax_dir.is_dir()
+        assert not (tmp_paths.models / "subject_01_best_model.npz").exists()
+        model, params, _ = load_model_from_checkpoint(orbax_dir)
+        assert (model.n_channels, model.n_times) == (4, 64)
+
+    def test_unknown_format_rejected(self, tmp_paths):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        with pytest.raises(ValueError, match="ckpt_format"):
+            within_subject_training(
+                epochs=2, config=CFG, loader=loader, subjects=(1,),
+                paths=tmp_paths, seed=0, ckpt_format="hdf5")
