@@ -39,6 +39,12 @@ from eegnetreplication_tpu.viz import (
 
 PKG = "eegnetreplication_tpu"
 
+# Names-only copy of models.registry.MODEL_REGISTRY for the training-tab
+# dropdown: importing the registry would pull flax/jax into the GUI process,
+# breaking the subprocess plugin boundary (ui.py's deps stay
+# numpy/matplotlib/tk).  Kept in sync by tests/test_viz_ui.py.
+MODEL_NAMES = ["deep_convnet", "eegnet", "eegnet_wide", "shallow_convnet"]
+
 
 def get_report(paths: Paths | None = None) -> dict:
     """Load the most recent training reports (``ui.py:597-620``)."""
@@ -137,6 +143,19 @@ class App(tk.Tk):
             row=0, column=4, padx=10)
         ttk.Button(step3, text="Train Model",
                    command=self.train_model).grid(row=0, column=5, padx=10)
+        # TPU-native extensions (defaults match the train CLI's).
+        ttk.Label(step3, text="Model:").grid(row=1, column=0, sticky=tk.W,
+                                             padx=5, pady=(5, 0))
+        self.train_model_var = tk.StringVar(value="eegnet")
+        ttk.Combobox(step3, textvariable=self.train_model_var,
+                     values=MODEL_NAMES).grid(
+            row=1, column=1, padx=5, pady=(5, 0))
+        ttk.Label(step3, text="Precision:").grid(row=1, column=2, sticky=tk.W,
+                                                 padx=5, pady=(5, 0))
+        self.precision_var = tk.StringVar(value="highest")
+        ttk.Combobox(step3, textvariable=self.precision_var,
+                     values=["highest", "default", "bf16"]).grid(
+            row=1, column=3, padx=5, pady=(5, 0))
 
         self.progress = Progressbar(frame, mode="indeterminate")
         self.progress.pack(fill=tk.X, padx=10, pady=10)
@@ -267,7 +286,9 @@ class App(tk.Tk):
             [sys.executable, "-m", f"{PKG}.train",
              "--trainingType", self.training_type_var.get(),
              "--epochs", str(epochs),
-             "--generateReport", str(self.generate_report_var.get())],
+             "--generateReport", str(self.generate_report_var.get()),
+             "--model", self.train_model_var.get(),
+             "--precision", self.precision_var.get()],
             "Training model...", "Model training completed")
         self.after(1000, self.load_reports)
 

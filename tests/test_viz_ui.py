@@ -166,6 +166,37 @@ class TestAppConstruction(unittest.TestCase):
         finally:
             app.destroy()
 
+    def test_train_command_carries_model_and_precision(self):
+        """The Training tab's TPU-native dropdowns reach the train CLI."""
+        from eegnetreplication_tpu.ui import App
+
+        app = App()
+        try:
+            captured = {}
+            app._launch = (lambda args, *a, **k:
+                           captured.setdefault("args", args))
+            app.train_model_var.set("shallow_convnet")
+            app.precision_var.set("bf16")
+            app.train_model()
+            args = captured["args"]
+            self.assertIn("--model", args)
+            self.assertEqual(args[args.index("--model") + 1],
+                             "shallow_convnet")
+            self.assertIn("--precision", args)
+            self.assertEqual(args[args.index("--precision") + 1], "bf16")
+        finally:
+            app.destroy()
+
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestModelNameSync(unittest.TestCase):
+    def test_ui_model_names_match_registry(self):
+        """ui.MODEL_NAMES is a names-only copy (the GUI must not import
+        flax/jax); it must track the real registry."""
+        from eegnetreplication_tpu.models.registry import MODEL_REGISTRY
+        from eegnetreplication_tpu.ui import MODEL_NAMES
+
+        self.assertEqual(MODEL_NAMES, sorted(MODEL_REGISTRY))
