@@ -106,6 +106,8 @@ class TrainingConfig:
     # Numerics mode for the model's matmuls/convs:
     #   "highest" — full-f32 MXU passes; tracks the torch-f32 reference
     #               trajectory (the parity default).
+    #   "high"    — 3-pass bf16x3 MXU dots: ~f32 quality at about half
+    #               HIGHEST's cost; a no-op off-TPU.
     #   "default" — backend-default matmul precision: the TPU MXU rounds
     #               operands to bf16 (f32 accumulate), its native fast path.
     #   "bf16"    — bf16 activations end-to-end as well (params stay f32;

@@ -52,12 +52,13 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Max-norm behaviour: reference grad-clamp (Q1) "
                              "or true paper weight projection.")
     parser.add_argument("--precision", type=str, default="highest",
-                        choices=["highest", "default", "bf16"],
+                        choices=["highest", "high", "default", "bf16"],
                         help="Model numerics: 'highest' = full-f32 MXU "
                              "passes (parity with the torch-f32 reference); "
-                             "'default' = backend matmul precision (TPU "
-                             "rounds operands to bf16 — faster); 'bf16' = "
-                             "bf16 activations end-to-end.")
+                             "'high' = 3-pass bf16x3 dots (~f32 quality, "
+                             "cheaper); 'default' = backend matmul precision "
+                             "(TPU rounds operands to bf16 — fastest f32 "
+                             "layout); 'bf16' = bf16 activations end-to-end.")
     parser.add_argument("--subjects", type=str, default=None,
                         help="Comma-separated subject ids (default: 1-9).")
     parser.add_argument("--profileDir", type=str, default=None,

@@ -154,7 +154,7 @@ class App(tk.Tk):
                                                  padx=5, pady=(5, 0))
         self.precision_var = tk.StringVar(value="highest")
         ttk.Combobox(step3, textvariable=self.precision_var,
-                     values=["highest", "default", "bf16"]).grid(
+                     values=["highest", "high", "default", "bf16"]).grid(
             row=1, column=3, padx=5, pady=(5, 0))
 
         self.progress = Progressbar(frame, mode="indeterminate")

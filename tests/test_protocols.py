@@ -185,6 +185,8 @@ class TestPrecisionModes:
         )
 
         assert _model_kwargs_for_precision(CFG) == {}
+        assert (_model_kwargs_for_precision(CFG.replace(precision="high"))
+                == {"precision": "high"})
         assert (_model_kwargs_for_precision(CFG.replace(precision="default"))
                 == {"precision": None})
         bf16 = _model_kwargs_for_precision(CFG.replace(precision="bf16"))
