@@ -134,7 +134,7 @@ def _torch_train_steps(tmodel, x, y, batches, mode, limits):
                     dims = tuple(range(1, w.ndim))  # per-output-filter norm
                     norms = w.pow(2).sum(dim=dims, keepdim=True).sqrt()
                     w.mul_(torch.clamp(lim / norms.clamp_min(1e-12), max=1.0))
-        losses.append(float(loss))
+        losses.append(float(loss.detach()))
     return losses
 
 
