@@ -69,13 +69,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "single file, or an Orbax checkpoint directory "
                              "(async/sharded-capable). The reference-interop "
                              ".pth export is always written.")
-    parser.add_argument("--checkpointEvery", type=int, default=0,
-                        help="Snapshot the run every N epochs (0 = off); a "
-                             "crashed run restarts from the last snapshot "
-                             "with --resume instead of epoch 0.")
+    parser.add_argument("--checkpointEvery", type=int, default=None,
+                        help="Snapshot the run every N epochs; a crashed "
+                             "run restarts from the last snapshot with "
+                             "--resume instead of epoch 0. Default: auto — "
+                             "runs over 100 epochs use 50-epoch segments "
+                             "(bit-identical, and long fused scans hit an "
+                             "XLA compile cliff). 0 forces one fused "
+                             "program.")
     parser.add_argument("--resume", action="store_true",
-                        help="Resume from the run snapshot if one exists "
-                             "(requires --checkpointEvery).")
+                        help="Resume from the run snapshot if one exists. "
+                             "Works with the auto default for runs over 100 "
+                             "epochs (leave --checkpointEvery unset — the "
+                             "cadence need not match the crashed run) or "
+                             "with an explicit positive --checkpointEvery.")
     parser.add_argument("--debugNans", action="store_true",
                         help="Numerics sanitizer: re-run any computation "
                              "that produced a NaN un-jitted and raise with "
@@ -90,11 +97,20 @@ def main() -> None:
     select_platform()  # honor EEGTPU_PLATFORM; probe accel; else CPU fallback
     parser = build_parser()
     args = parser.parse_args()
-    if args.checkpointEvery < 0:
+    from eegnetreplication_tpu.training.protocols import AUTO_CHUNK_THRESHOLD
+
+    if args.checkpointEvery is not None and args.checkpointEvery < 0:
         parser.error("--checkpointEvery must be >= 0")
-    if args.resume and not args.checkpointEvery:
-        parser.error("--resume requires --checkpointEvery (the snapshot "
-                     "cadence must match a resumable run)")
+    if args.resume and args.checkpointEvery == 0:
+        parser.error("--resume needs a chunked run: drop --checkpointEvery 0 "
+                     "(auto) or pass a positive cadence")
+    if (args.resume and args.checkpointEvery is None
+            and args.epochs <= AUTO_CHUNK_THRESHOLD):
+        # Fail at parse time, not after minutes of data loading.
+        parser.error(
+            f"--resume with {args.epochs} epochs: auto-chunking only "
+            f"engages above {AUTO_CHUNK_THRESHOLD} epochs — pass an "
+            "explicit positive --checkpointEvery")
 
     from eegnetreplication_tpu.parallel import make_mesh
     from eegnetreplication_tpu.training.protocols import (

@@ -58,6 +58,27 @@ from eegnetreplication_tpu.utils.profiling import StepTimer
 
 LoadFn = Callable[[int, str], BCICI2ADataset]
 
+# Auto-chunking (checkpoint_every=None): XLA compile time grows
+# superlinearly with lax.scan length through this toolchain — a 500-epoch
+# program did not finish compiling in 50 min on the TPU while a 50-epoch
+# segment compiles in ~3 and is bit-identical run in sequence (see
+# BENCH_NOTES.md).  Runs longer than the threshold therefore default to
+# chunked segments (which also makes them crash-resumable).
+AUTO_CHUNK_THRESHOLD = 100
+AUTO_CHUNK_EPOCHS = 50
+
+
+def _auto_chunk_size(epochs: int) -> int:
+    """Segment length for auto-chunked runs: a divisor of ``epochs`` near
+    :data:`AUTO_CHUNK_EPOCHS` when one exists (every chunk then shares one
+    compiled program); otherwise :data:`AUTO_CHUNK_EPOCHS` itself, accepting
+    one differently-sized final segment (a second, smaller compile)."""
+    for size in sorted(range(25, 101),
+                       key=lambda s: abs(s - AUTO_CHUNK_EPOCHS)):
+        if epochs % size == 0:
+            return size
+    return AUTO_CHUNK_EPOCHS
+
 
 def _default_loader(subject: int, mode: str) -> BCICI2ADataset:
     from eegnetreplication_tpu.data.io import load_subject_dataset
@@ -144,12 +165,15 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                _crash_after_chunk: int | None = None):
     """Train all folds fused; returns stacked FoldResult.
 
-    Without ``checkpoint_every`` the whole run is ONE compiled program (the
-    round-1 design).  With it, the epoch scan runs in chunks of that many
-    epochs with a run snapshot persisted between chunks — same key schedule,
-    bit-identical results — so a crash at epoch 490/500 resumes from the last
-    chunk boundary instead of epoch 0 (the reference cannot resume at all,
-    SURVEY §5).  ``_crash_after_chunk`` is a test-only fault-injection hook.
+    ``checkpoint_every`` — ``0``: the whole run is ONE compiled program (the
+    round-1 design); ``N``: the epoch scan runs in N-epoch chunks with a run
+    snapshot persisted between chunks (same key schedule, bit-identical
+    results), so a crash at epoch 490/500 resumes from the last chunk
+    boundary instead of epoch 0 (the reference cannot resume at all, SURVEY
+    §5); ``None`` (default): auto — runs over :data:`AUTO_CHUNK_THRESHOLD`
+    epochs chunk at :func:`_auto_chunk_size` (long fused scans hit an XLA
+    compile cliff, BENCH_NOTES.md), shorter runs stay single-program.
+    ``_crash_after_chunk`` is a test-only fault-injection hook.
     """
     # The protocol programs use the algebraically fused jnp eval path only;
     # the Pallas kernel stays out of these large scanned programs (it
@@ -187,6 +211,21 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
 
     if checkpoint_every is not None and checkpoint_every < 0:
         raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
+    explicit_cadence = checkpoint_every is not None
+    if checkpoint_every is None:  # auto: chunk long runs (compile cliff)
+        checkpoint_every = (_auto_chunk_size(epochs)
+                            if epochs > AUTO_CHUNK_THRESHOLD else 0)
+        if checkpoint_every:
+            logger.info(
+                "Auto-chunking %d epochs into %d-epoch segments (bit-"
+                "identical to one program, avoids the long-scan compile "
+                "cliff, resumable with --resume); pass checkpoint_every=0 "
+                "to force a single fused program", epochs, checkpoint_every)
+    if resume and not checkpoint_every:
+        raise ValueError(
+            "resume requires a chunked run (checkpoint_every > 0, or the "
+            "auto default with epochs > "
+            f"{AUTO_CHUNK_THRESHOLD}); this run is a single fused program")
     if not checkpoint_every:
         trainer = make_multi_fold_trainer(
             model, tx, batch_size=config.batch_size, epochs=epochs,
@@ -213,8 +252,10 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                      maxnorm_mode=config.maxnorm_mode,
                      precision=config.precision)
     if epochs % checkpoint_every:
-        logger.warning(
-            "epochs (%d) is not a multiple of checkpoint_every (%d): the "
+        # Blame the flag only when the user actually set one; the auto
+        # fallback (no divisor of epochs near the target) is deliberate.
+        log = logger.warning if explicit_cadence else logger.info
+        log("epochs (%d) is not a multiple of the %d-epoch segment: the "
             "final %d-epoch chunk compiles a second XLA program",
             epochs, checkpoint_every, epochs % checkpoint_every)
     segment = make_multi_fold_segment(

@@ -228,3 +228,51 @@ class TestOrbaxArtifacts:
             within_subject_training(
                 epochs=2, config=CFG, loader=loader, subjects=(1,),
                 paths=tmp_paths, seed=0, ckpt_format="hdf5")
+
+
+class TestAutoChunking:
+    """checkpoint_every=None auto-chunks long runs (XLA long-scan compile
+    cliff, BENCH_NOTES.md); short runs and explicit 0 stay single-program."""
+
+    def _run(self, tmp_paths, epochs, **kw):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        return within_subject_training(
+            epochs=epochs, config=CFG, loader=loader, subjects=(1,),
+            paths=tmp_paths, seed=0, save_models=False, **kw)
+
+    def test_long_run_auto_chunks(self, tmp_paths):
+        # The crash hook only fires inside the chunked loop: raising proves
+        # the auto default picked chunked segments.
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, epochs=120, _crash_after_chunk=1)
+        assert (tmp_paths.models / "within_subject_eegnet.run.npz").exists()
+
+    def test_short_run_stays_fused(self, tmp_paths):
+        result = self._run(tmp_paths, epochs=4, _crash_after_chunk=1)
+        assert np.isfinite(result.avg_test_acc)  # hook never fired
+
+    def test_explicit_zero_forces_single_program(self, tmp_paths):
+        result = self._run(tmp_paths, epochs=120, checkpoint_every=0,
+                           _crash_after_chunk=1)
+        assert np.isfinite(result.avg_test_acc)  # hook never fired
+
+    def test_resume_needs_chunked_run(self, tmp_paths):
+        with pytest.raises(ValueError, match="chunked run"):
+            self._run(tmp_paths, epochs=4, resume=True)
+
+    def test_auto_chunked_resume_completes(self, tmp_paths):
+        uninterrupted = self._run(tmp_paths, epochs=120)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, epochs=120, _crash_after_chunk=1)
+        resumed = self._run(tmp_paths, epochs=120, resume=True)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+
+    def test_auto_chunk_size_prefers_divisors(self):
+        from eegnetreplication_tpu.training.protocols import _auto_chunk_size
+
+        assert _auto_chunk_size(500) == 50   # exact divisor at the target
+        assert _auto_chunk_size(120) == 40   # nearest divisor to 50
+        assert _auto_chunk_size(150) == 50
+        assert _auto_chunk_size(104) == 52
+        assert _auto_chunk_size(127) == 50   # prime: fallback + remainder
