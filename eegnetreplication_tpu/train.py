@@ -69,6 +69,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "single file, or an Orbax checkpoint directory "
                              "(async/sharded-capable). The reference-interop "
                              ".pth export is always written.")
+    parser.add_argument("--maxFoldsPerProgram", type=int, default=None,
+                        help="Train at most N folds per compiled program, "
+                             "running groups sequentially (bit-identical). "
+                             "For protocols whose fold count exceeds what "
+                             "the device takes in one program (e.g. the "
+                             "90-fold cross-subject run on a small chip). "
+                             "Ignored under a device mesh.")
     parser.add_argument("--checkpointEvery", type=int, default=None,
                         help="Snapshot the run every N epochs; a crashed "
                              "run restarts from the last snapshot with "
@@ -160,6 +167,7 @@ def main() -> None:
                                              model_name=args.model,
                                              subjects=subjects,
                                              ckpt_format=args.ckptFormat,
+                                             fold_batch=args.maxFoldsPerProgram,
                                              checkpoint_every=args.checkpointEvery,
                                              resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
@@ -177,6 +185,7 @@ def main() -> None:
                                             model_name=args.model,
                                             subjects=subjects,
                                             ckpt_format=args.ckptFormat,
+                                            fold_batch=args.maxFoldsPerProgram,
                                             checkpoint_every=args.checkpointEvery,
                                             resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",

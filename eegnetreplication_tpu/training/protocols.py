@@ -162,6 +162,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                checkpoint_every: int | None = None,
                checkpoint_path=None, resume: bool = False,
                signature: dict | None = None,
+               fold_batch: int | None = None,
+               _states=None, _keys=None, _keep_snapshot: bool = False,
                _crash_after_chunk: int | None = None):
     """Train all folds fused; returns stacked FoldResult.
 
@@ -173,7 +175,17 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     §5); ``None`` (default): auto — runs over :data:`AUTO_CHUNK_THRESHOLD`
     epochs chunk at :func:`_auto_chunk_size` (long fused scans hit an XLA
     compile cliff, BENCH_NOTES.md), shorter runs stay single-program.
-    ``_crash_after_chunk`` is a test-only fault-injection hook.
+
+    ``fold_batch`` — at most this many folds per compiled program: groups
+    run sequentially through the same chunked machinery and results are
+    concatenated, bit-identically to one program (per-fold init states and
+    epoch keys are derived globally, then sliced).  For protocols whose
+    fold axis exceeds what the device can take in one program (observed:
+    the 90-fold cross-subject segment faults a v5e chip that handles 36
+    comfortably).  Ignored under a mesh (shard folds across devices
+    instead).  ``_states``/``_keys``/``_keep_snapshot`` are internal to
+    that grouping; ``_crash_after_chunk`` is a test-only fault-injection
+    hook.
     """
     # The protocol programs use the algebraically fused jnp eval path only;
     # the Pallas kernel stays out of these large scanned programs (it
@@ -185,10 +197,53 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     val_pad = specs[0].val_idx.shape[0]
     test_pad = specs[0].test_idx.shape[0]
 
+    states = (_states if _states is not None else
+              init_fold_states(model, tx, n_folds,
+                               (pool_x.shape[1], pool_x.shape[2]), seed=seed))
+    keys = (_keys if _keys is not None else
+            jax.random.split(jax.random.PRNGKey(seed + 1), n_folds))
+
+    if fold_batch is not None and fold_batch <= 0:
+        raise ValueError(f"fold_batch must be positive, got {fold_batch}")
+    if fold_batch and mesh is not None:
+        logger.warning(
+            "fold_batch is ignored under a device mesh: shard the fold "
+            "axis across devices instead (--meshFold)")
+        fold_batch = None
+    if fold_batch and n_folds > fold_batch:
+        group_results, wall = [], 0.0
+        group_paths = []
+        for gi, lo in enumerate(range(0, n_folds, fold_batch)):
+            hi = min(lo + fold_batch, n_folds)
+            logger.info("Training fold group %d: folds %d-%d of %d",
+                        gi, lo, hi - 1, n_folds)
+            gpath = (None if checkpoint_path is None
+                     else Path(f"{checkpoint_path}.g{gi}"))
+            group_paths.append(gpath)
+            gsig = dict(signature or {}, fold_group=gi,
+                        fold_range=[lo, hi])
+            # A group the crashed run never reached has no snapshot; that
+            # is the expected state of a batched resume, not a user error —
+            # train it fresh without the missing-snapshot warning.
+            gresume = bool(resume and gpath is not None and gpath.exists())
+            r, w = _run_folds(
+                model, specs[lo:hi], pool_x, pool_y, config=config,
+                epochs=epochs, seed=seed, mesh=None,
+                checkpoint_every=checkpoint_every, checkpoint_path=gpath,
+                resume=gresume, signature=gsig,
+                _states=jax.tree_util.tree_map(lambda l: l[lo:hi], states),
+                _keys=keys[lo:hi], _keep_snapshot=True,
+                _crash_after_chunk=_crash_after_chunk)
+            group_results.append(r)
+            wall += w
+        results = jax.tree_util.tree_map(
+            lambda *leaves: jnp.concatenate(leaves, axis=0), *group_results)
+        for gpath in group_paths:  # all groups done: snapshots expendable
+            if gpath is not None and gpath.exists():
+                gpath.unlink()
+        return results, wall
+
     stacked = _stack_specs(specs)
-    states = init_fold_states(model, tx, n_folds,
-                              (pool_x.shape[1], pool_x.shape[2]), seed=seed)
-    keys = jax.random.split(jax.random.PRNGKey(seed + 1), n_folds)
 
     padded = n_folds
     if mesh is not None:
@@ -324,8 +379,14 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     )
     if padded != n_folds:
         results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds], results)
-    if checkpoint_path is not None and Path(checkpoint_path).exists():
-        Path(checkpoint_path).unlink()  # complete: snapshot no longer needed
+    if not _keep_snapshot and checkpoint_path is not None:
+        if Path(checkpoint_path).exists():
+            Path(checkpoint_path).unlink()  # complete: no longer needed
+        # Also clear stale group snapshots from an earlier fold_batch run
+        # of this protocol that crashed and was then completed ungrouped.
+        cp = Path(checkpoint_path)
+        for stale in cp.parent.glob(cp.name + ".g*"):
+            stale.unlink()
     return results, wall
 
 
@@ -374,6 +435,7 @@ def within_subject_training(epochs: int | None = None, *,
                             model_name: str = "eegnet",
                             save_models: bool = True,
                             ckpt_format: str = "npz",
+                            fold_batch: int | None = None,
                             checkpoint_every: int | None = None,
                             resume: bool = False,
                             _crash_after_chunk: int | None = None) -> ProtocolResult:
@@ -414,7 +476,8 @@ def within_subject_training(epochs: int | None = None, *,
                 config.kfold_splits, epochs)
     results, wall = _run_folds(
         model, specs, pool_x, pool_y, config=config, epochs=epochs,
-        seed=seed, mesh=mesh, checkpoint_every=checkpoint_every,
+        seed=seed, mesh=mesh, fold_batch=fold_batch,
+        checkpoint_every=checkpoint_every,
         checkpoint_path=paths.models / f"within_subject_{model_name}.run.npz",
         resume=resume,
         signature={"protocol": "within_subject", "model": model_name,
@@ -453,6 +516,7 @@ def cross_subject_training(epochs: int | None = None, *,
                            model_name: str = "eegnet",
                            save_models: bool = True,
                            ckpt_format: str = "npz",
+                           fold_batch: int | None = None,
                            checkpoint_every: int | None = None,
                            resume: bool = False,
                            _crash_after_chunk: int | None = None) -> ProtocolResult:
@@ -503,7 +567,8 @@ def cross_subject_training(epochs: int | None = None, *,
                 len(specs), epochs)
     results, wall = _run_folds(
         model, specs, pool_x, pool_y, config=config, epochs=epochs,
-        seed=seed, mesh=mesh, checkpoint_every=checkpoint_every,
+        seed=seed, mesh=mesh, fold_batch=fold_batch,
+        checkpoint_every=checkpoint_every,
         checkpoint_path=paths.models / f"cross_subject_{model_name}.run.npz",
         resume=resume,
         signature={"protocol": "cross_subject", "model": model_name,

@@ -276,3 +276,53 @@ class TestAutoChunking:
         assert _auto_chunk_size(150) == 50
         assert _auto_chunk_size(104) == 52
         assert _auto_chunk_size(127) == 50   # prime: fallback + remainder
+
+
+class TestFoldBatching:
+    """fold_batch groups folds into separate compiled programs; results must
+    be bit-identical to the single-program run (global init/key derivation)."""
+
+    def _run(self, tmp_paths, **kw):
+        loader = make_loader(n_trials=32, n_channels=4, n_times=64)
+        return within_subject_training(
+            epochs=4, config=CFG, loader=loader, subjects=(1, 2),
+            paths=tmp_paths, seed=0, save_models=False, **kw)
+
+    def test_batched_matches_single_program(self, tmp_paths):
+        import jax
+
+        whole = self._run(tmp_paths)                 # 8 folds, one program
+        batched = self._run(tmp_paths, fold_batch=3)  # groups of 3+3+2
+        np.testing.assert_array_equal(batched.fold_test_acc,
+                                      whole.fold_test_acc)
+        for a, b in zip(batched.best_states, whole.best_states):
+            for la, lb in zip(jax.tree_util.tree_leaves(a),
+                              jax.tree_util.tree_leaves(b)):
+                np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+    def test_batched_chunked_crash_resume(self, tmp_paths):
+        uninterrupted = self._run(tmp_paths, fold_batch=3, checkpoint_every=2)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                      _crash_after_chunk=1)
+        # group-0 snapshot survives the crash for resume
+        assert (tmp_paths.models
+                / "within_subject_eegnet.run.npz.g0").exists()
+        resumed = self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                            resume=True)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+        # completion cleans up every group snapshot
+        assert not list(tmp_paths.models.glob("*.run.npz.g*"))
+
+    def test_invalid_fold_batch_rejected(self, tmp_paths):
+        with pytest.raises(ValueError, match="fold_batch"):
+            self._run(tmp_paths, fold_batch=0)
+
+    def test_ungrouped_completion_clears_stale_group_snapshots(self, tmp_paths):
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                      _crash_after_chunk=1)
+        assert list(tmp_paths.models.glob("*.run.npz.g*"))
+        self._run(tmp_paths, checkpoint_every=2)  # complete without batching
+        assert not list(tmp_paths.models.glob("*.run.npz.g*"))
