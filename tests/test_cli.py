@@ -19,12 +19,13 @@ import numpy as np
 REPO = Path(__file__).resolve().parents[1]
 
 
-def _run(args, data_root, timeout=420):
+def _run(args, data_root, timeout=420, env_extra=None):
     env = dict(os.environ,
                EEGTPU_DATA_ROOT=str(data_root),
                EEGTPU_PLATFORM="cpu",
                EEGTPU_NO_LOG_FILE="1",
                PYTHONPATH=str(REPO))
+    env.update(env_extra or {})
     return subprocess.run([sys.executable, "-m"] + args, cwd=REPO, env=env,
                           capture_output=True, text=True, timeout=timeout)
 
@@ -128,6 +129,18 @@ class TestCLIBoundary(unittest.TestCase):
 
         _, _, meta = load_checkpoint(ckpt)
         self.assertEqual(meta["model"], "shallow_convnet")
+
+    def test_5b_train_cli_fold_batching(self):
+        # Single-device env: under a multi-device mesh the flag is
+        # (by design) ignored in favour of fold sharding.
+        proc = _run(["eegnetreplication_tpu.train",
+                     "--trainingType", "Within-Subject", "--epochs", "1",
+                     "--subjects", "1", "--maxFoldsPerProgram", "2",
+                     "--generateReport", "False"],
+                    self.tmp, env_extra={"XLA_FLAGS": ""})
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        # 4 folds in groups of 2 -> two group logs
+        self.assertEqual(proc.stderr.count("Training fold group"), 2)
 
     def test_6_predict_cli(self):
         """Inference CLI classifies a session with a trained checkpoint."""
