@@ -18,9 +18,22 @@ import sys
 
 _DEVCOUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# The probe exercises the COMPILER, not just backend init: a degraded
+# tunnel was observed (2026-07-30) initializing fine while hanging every
+# new compilation indefinitely — init-only probing then sends real work
+# into a stall.  A fresh matrix dimension per probe defeats compile caches
+# that would otherwise mask a stalled compiler.
 _PROBE_SRC = (
-    "import jax; ds = jax.devices(); "
+    "import os, jax, jax.numpy as jnp; "
+    # A persistent compile cache could replay the probe executable without
+    # touching the (possibly stalled) compiler; force it off in-process.
+    "jax.config.update('jax_compilation_cache_dir', None); "
+    "ds = jax.devices(); "
     "assert any(d.platform != 'cpu' for d in ds), 'cpu only'; "
+    "dim = 128 + int.from_bytes(os.urandom(4), 'little') % 64; "
+    "x = jnp.ones((dim, dim)); "
+    "v = float(jax.jit(lambda m: (m @ m).sum())(x)); "
+    "assert v == dim * dim * dim, v; "
     "print(jax.default_backend())"
 )
 
@@ -107,6 +120,9 @@ def probe_accelerator(timeout_s: float = 90.0) -> str | None:
         return cached
     env = dict(os.environ)
     env.pop("EEGTPU_PLATFORM", None)
+    # Belt and braces with _PROBE_SRC's in-process disable: an ambient
+    # persistent-cache env var must not let the probe bypass the compiler.
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
     # Own session + process-group kill: a tunneled backend can spawn helper
     # processes that inherit the pipes; killing only the direct child would
     # leave subprocess draining stdout forever (the very hang we guard
