@@ -60,9 +60,18 @@ def _probe_cache_path() -> str:
 
 
 def _probe_env_key() -> str:
-    """Env vars that change the probe's outcome; part of the cache key."""
-    return "|".join(f"{k}={os.environ.get(k, '')}"
-                    for k in ("JAX_PLATFORMS", "XLA_FLAGS"))
+    """Env vars that change the probe's outcome; part of the cache key.
+
+    The probe source's hash is included so entries written by an OLDER
+    probe (e.g. the init-only one that could not detect a stalled
+    compiler) never satisfy a newer, stricter probe.
+    """
+    import hashlib
+
+    src_tag = hashlib.sha256(_PROBE_SRC.encode()).hexdigest()[:12]
+    env = "|".join(f"{k}={os.environ.get(k, '')}"
+                   for k in ("JAX_PLATFORMS", "XLA_FLAGS"))
+    return f"{src_tag}|{env}"
 
 
 def _read_probe_cache() -> str | None | object:
