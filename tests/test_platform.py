@@ -75,3 +75,60 @@ def test_enable_compilation_cache_default_is_per_user():
         assert path is not None and path.startswith("/tmp/eegtpu_xla_cache.")
     finally:
         _set_cache_config(saved)
+
+
+class TestProbe:
+    """The accelerator probe must detect a stalled compiler and version its
+    cache (the init-only probe's cached verdicts must never satisfy it)."""
+
+    def test_hung_probe_times_out_and_caches_none(self, tmp_path):
+        import time
+
+        from eegnetreplication_tpu.utils import platform as plat
+
+        with mock.patch.object(plat, "_PROBE_SRC",
+                               "import time; time.sleep(600)"), \
+             mock.patch.object(plat, "_probe_cache_path",
+                               lambda: str(tmp_path / "probe.json")), \
+             mock.patch.dict(os.environ, {"EEGTPU_PROBE_CACHE": "1"}):
+            t0 = time.perf_counter()
+            assert plat.probe_accelerator(timeout_s=2.0) is None
+            assert time.perf_counter() - t0 < 30  # killed, not waited out
+            assert (tmp_path / "probe.json").exists()
+            # The hung outcome must be served from the cache: a re-probe
+            # spawning another subprocess would mean the cache regressed.
+            with mock.patch.object(
+                    plat.subprocess, "Popen",
+                    side_effect=AssertionError("cache miss re-spawned")):
+                assert plat.probe_accelerator(timeout_s=2.0) is None
+
+    def test_failing_probe_returns_none(self, tmp_path):
+        from eegnetreplication_tpu.utils import platform as plat
+
+        with mock.patch.object(plat, "_PROBE_SRC", "raise SystemExit(3)"), \
+             mock.patch.object(plat, "_probe_cache_path",
+                               lambda: str(tmp_path / "probe.json")):
+            assert plat.probe_accelerator(timeout_s=30.0) is None
+
+    def test_cache_key_versions_probe_source(self, tmp_path):
+        """A cache entry from a different probe program must be a miss."""
+        import json
+        import time
+
+        from eegnetreplication_tpu.utils import platform as plat
+
+        path = tmp_path / "probe.json"
+        with mock.patch.object(plat, "_probe_cache_path", lambda: str(path)), \
+             mock.patch.dict(os.environ, {"EEGTPU_PROBE_CACHE": "1"}):
+            old_key = plat._probe_env_key()
+            with mock.patch.object(plat, "_PROBE_SRC", "pass"):
+                assert plat._probe_env_key() != old_key
+                # entry written under the real probe's key: miss for "pass"
+                path.write_text(json.dumps(
+                    {"ts": time.time(), "result": "tpu", "env": old_key}))
+                assert plat._read_probe_cache() is plat._MISS
+            # and under its own key: hit
+            path.write_text(json.dumps(
+                {"ts": time.time(), "result": "tpu",
+                 "env": plat._probe_env_key()}))
+            assert plat._read_probe_cache() == "tpu"
