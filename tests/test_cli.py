@@ -168,3 +168,24 @@ class TestCLIBoundary(unittest.TestCase):
 
 if __name__ == "__main__":
     unittest.main()
+
+
+class TestFetchHelpers(unittest.TestCase):
+    def test_mirror_into_copies_and_replaces(self):
+        """Files copy over; existing directories are replaced wholesale."""
+        import tempfile
+
+        from eegnetreplication_tpu.fetch import _mirror_into
+
+        with tempfile.TemporaryDirectory() as td:
+            src = Path(td) / "cache"
+            (src / "Train").mkdir(parents=True)
+            (src / "Train" / "A01T.gdf").write_bytes(b"new")
+            (src / "readme.txt").write_text("hello")
+            dst = Path(td) / "raw"
+            (dst / "Train").mkdir(parents=True)
+            (dst / "Train" / "stale.gdf").write_bytes(b"old")
+            _mirror_into(src, dst)
+            self.assertEqual((dst / "Train" / "A01T.gdf").read_bytes(), b"new")
+            self.assertFalse((dst / "Train" / "stale.gdf").exists())
+            self.assertEqual((dst / "readme.txt").read_text(), "hello")
