@@ -69,9 +69,11 @@ N_FOLDS = 4
 RUN_SALT = int.from_bytes(os.urandom(4), "little")
 # The CPU path is the contract-safety fallback, not the measurement of
 # record; run it at smoke scale so the JSON line lands well inside the
-# watchdog deadline (100 epochs of the fused trainer on CPU takes >25 min).
+# watchdog deadline (dress-rehearsed 2026-07-30 on a 1-core host: 10 CPU
+# epochs finished with ~1 min to spare against the 1500 s watchdog — 6
+# restores a real margin).
 EPOCHS = (2 if os.environ.get("BENCH_SMOKE")
-          else 100 if PLATFORM != "cpu" else 10)
+          else 100 if PLATFORM != "cpu" else 6)
 TORCH_EPOCHS = 1 if os.environ.get("BENCH_SMOKE") or PLATFORM == "cpu" else 6
 
 

@@ -166,15 +166,10 @@ class TestCLIBoundary(unittest.TestCase):
         self.assertIn("Unknown source", proc.stderr)
 
 
-if __name__ == "__main__":
-    unittest.main()
-
 
 class TestFetchHelpers(unittest.TestCase):
     def test_mirror_into_copies_and_replaces(self):
         """Files copy over; existing directories are replaced wholesale."""
-        import tempfile
-
         from eegnetreplication_tpu.fetch import _mirror_into
 
         with tempfile.TemporaryDirectory() as td:
@@ -189,3 +184,6 @@ class TestFetchHelpers(unittest.TestCase):
             self.assertEqual((dst / "Train" / "A01T.gdf").read_bytes(), b"new")
             self.assertFalse((dst / "Train" / "stale.gdf").exists())
             self.assertEqual((dst / "readme.txt").read_text(), "hello")
+
+if __name__ == "__main__":
+    unittest.main()
