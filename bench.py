@@ -21,35 +21,64 @@ Env knobs: BENCH_SMOKE=1 shrinks epochs for a quick correctness pass;
 EEGTPU_PLATFORM=cpu|tpu forces the backend and skips the probe (the site
 startup pins ``jax_platforms`` to a tunneled TPU backend, so a plain
 JAX_PLATFORMS env var is ignored); BENCH_TPU_PROBE_S overrides the probe
-timeout (default 90 s).
+timeout (default 90 s); BENCH_PROBE_RETRIES the probe retry count
+(default 2).
 
 Robustness contract (round-1 postmortem): the pinned TPU backend can fail
 *or hang* at init, which previously killed the run before any JSON was
 printed.  We therefore probe the accelerator in a **subprocess** with a
-timeout before this process touches JAX, fall back to CPU when the probe
-fails, and wrap everything so one JSON line is printed on any Python-level
-failure; a watchdog timer (BENCH_DEADLINE_S, default 1500 s) additionally
-covers the probe-to-init race where the backend passes the probe but hangs
+timeout before this process touches JAX, retry a failed probe (round-2
+postmortem: the tunnel's availability is intermittent on the scale of
+minutes and a single bad-minute probe cost the round its TPU artifact),
+fall back to CPU only when all attempts fail — recording ``probe_result``
+/ ``fallback_reason`` diagnostics plus the most recent on-chip headline
+(``last_onchip``) in the JSON line so a CPU line is self-explaining — and
+wrap everything so one JSON line is printed on any Python-level failure;
+a watchdog timer (BENCH_DEADLINE_S, default 1500 s) additionally covers
+the probe-to-init race where the backend passes the probe but hangs
 during this process's own init (best-effort — a hang that never releases
 the GIL can still defeat it).
+
+Compile-cache policy (round-2 verdict): the persistent XLA cache is ON —
+a warm cache is the difference between a ~65-470 s headline compile and a
+~seconds cache read through the degrading tunnel, i.e. between landing a
+TPU number and the watchdog.  Honesty is preserved by *reporting* the
+cache state instead of disabling it: ``compile_cache`` is ``off``/
+``cold``/``warm:<entries>`` and ``compile_s`` is whatever the warmup call
+actually cost under that state.  FLOP/s + MFU fields ground the
+workload-relative ratio in hardware utilization (``utils/flops.py``).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
-from eegnetreplication_tpu.utils.platform import select_platform
+from eegnetreplication_tpu.utils.platform import select_platform_info
 
-# The persistent compile cache would turn the second invocation's "compile"
-# into a cache read, silently corrupting the reported compile_s metric —
-# keep benchmark compiles honest (explicit env overrides still win).
-os.environ.setdefault("EEGTPU_COMPILE_CACHE", "0")
+_ONCHIP_LAST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_ONCHIP_LAST.json")
 
-PLATFORM = select_platform()  # never raises; falls back to CPU
+
+def _probe_retries() -> int:
+    """Probe retry count: 2 by default — ~6 min worst case, converting a
+    bad-minute tunnel outage into a bad-quarter-hour one before the CPU
+    fallback (round-2 postmortem).  BENCH_SMOKE defaults to 0: a quick
+    correctness pass gains nothing from recovering the TPU and must not
+    block ~6 min at import with the tunnel down."""
+    default = "0" if os.environ.get("BENCH_SMOKE") else "2"
+    try:
+        return max(0, int(os.environ.get("BENCH_PROBE_RETRIES", default)))
+    except ValueError:
+        return int(default)
+
+
+PLATFORM, PROBE_INFO = select_platform_info(retries=_probe_retries())
 
 # Exactly-one-JSON-line guard: whichever of main() / the watchdog acquires
 # this first is the sole printer.
@@ -71,9 +100,13 @@ RUN_SALT = int.from_bytes(os.urandom(4), "little")
 # record; run it at smoke scale so the JSON line lands well inside the
 # watchdog deadline (dress-rehearsed 2026-07-30 on a 1-core host: 10 CPU
 # epochs finished with ~1 min to spare against the 1500 s watchdog — 6
-# restores a real margin).
+# restores a real margin).  When probe retries already burned minutes of
+# the budget before falling back, shrink further: the retry time plus the
+# full CPU workload would otherwise flirt with the watchdog.
+_RETRIES_BURNED = PLATFORM == "cpu" and PROBE_INFO.get("seconds", 0) > 60
 EPOCHS = (2 if os.environ.get("BENCH_SMOKE")
-          else 100 if PLATFORM != "cpu" else 6)
+          else 100 if PLATFORM != "cpu"
+          else 2 if _RETRIES_BURNED else 6)
 TORCH_EPOCHS = 1 if os.environ.get("BENCH_SMOKE") or PLATFORM == "cpu" else 6
 
 
@@ -153,6 +186,16 @@ def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs, model_kwargs=None):
     states = init_fold_states(model, tx, n_folds, (C, T))
     pool_x, pool_y = jnp.asarray(pool_x), jnp.asarray(pool_y)
 
+    # Replay-guard digests hash the continuous per-epoch LOSS trajectories,
+    # not (only) val accuracies: accuracies are quantized to multiples of
+    # 1/n_val, so a degenerate constant-prediction model at smoke scale can
+    # legitimately repeat them across distinct keys — losses are f32 sums
+    # over differently-shuffled batches and cannot collide for genuine
+    # executions (ADVICE r2).
+    def _digest(out):
+        return (np.asarray(out.val_losses).tobytes()
+                + np.asarray(out.train_losses).tobytes())
+
     base = jax.random.fold_in(jax.random.PRNGKey(0), RUN_SALT)
     t0 = time.perf_counter()
     warm = trainer(pool_x, pool_y, stacked, states,
@@ -161,17 +204,17 @@ def _time_fused_trainer(pool_x, pool_y, raw_folds, epochs, model_kwargs=None):
     # tunnel was observed acknowledging executions instantly with stale
     # buffers (2026-07-30), and real D2H bytes are the strongest liveness
     # signal available from this side.
-    digests = [np.asarray(warm.val_accuracies).tobytes()]
+    digests = [_digest(warm)]
     compile_s = time.perf_counter() - t0
     rates = []
     for rep in range(1, 4):
         rep_keys = jax.random.split(jax.random.fold_in(base, rep), n_folds)
         t0 = time.perf_counter()
         out = trainer(pool_x, pool_y, stacked, states, rep_keys)
-        digests.append(np.asarray(out.val_accuracies).tobytes())
+        digests.append(_digest(out))
         rates.append(n_folds * epochs / (time.perf_counter() - t0))
     # Distinct PRNG keys produce distinct epoch shuffles, so genuine
-    # executions cannot return identical validation trajectories.
+    # executions cannot return identical loss trajectories.
     _assert_fresh(digests, "distinct-key training reps")
     return float(np.median(rates)), compile_s
 
@@ -348,6 +391,154 @@ def bench_torch_reference_style(x, y, folds) -> float:
     return TORCH_EPOCHS / dt
 
 
+def _flops_accounting(timeout_s: float = 420.0) -> dict:
+    """Per-unit FLOP counts from XLA's HLO cost model (CPU subprocess).
+
+    Shape-only cost analysis needs no device, but lowering in THIS process
+    would target the tunneled backend; a subprocess with the axon startup
+    hook disabled behaves identically in every environment and cannot
+    perturb the measurement of record.  Returns ``{}`` on any failure —
+    the accounting is an add-on, never a gate.
+    """
+    folds = _fold_indices()
+    train_pad = max(len(f[0]) for f in folds)
+    val_pad = max(len(f[1]) for f in folds)
+    src = (
+        "import json\n"
+        "from eegnetreplication_tpu.models import EEGNet\n"
+        "from eegnetreplication_tpu.training import make_optimizer\n"
+        "from eegnetreplication_tpu.utils.flops import (\n"
+        "    eval_forward_flops, fold_epoch_flops)\n"
+        f"m = EEGNet(n_channels={C}, n_times={T})\n"
+        "tx = make_optimizer()\n"
+        f"fe = fold_epoch_flops(m, tx, batch_size={BATCH}, "
+        f"train_pad={train_pad}, val_pad={val_pad}, "
+        f"sample_shape=({C}, {T}))\n"
+        f"ev = eval_forward_flops(m, {N_POOL}, ({C}, {T}))\n"
+        "print(json.dumps({'fold_epoch_flops': fe, "
+        "'eval_forward_flops_pool': ev}))\n"
+    )
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""  # skip the axon startup hook entirely
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("EEGTPU_PLATFORM", None)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", src], capture_output=True, text=True,
+            timeout=timeout_s, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if out.returncode != 0:
+            return {}
+        counts = json.loads(out.stdout.strip().splitlines()[-1])
+        return {k: v for k, v in counts.items() if v}
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        return {}
+
+
+def _add_flops_fields(record: dict, timeout_s: float = 420.0) -> None:
+    """Derive achieved-FLOP/s + MFU fields from already-measured rates.
+
+    MFU denominators: the chip's bf16 MXU peak (``utils/flops.py``).  The
+    headline runs f32-precision matmuls, which spend extra MXU passes —
+    that cost is deliberately visible as lower MFU rather than hidden by a
+    precision-specific peak.  CPU runs get FLOP/s only (no meaningful MFU).
+    """
+    counts = _flops_accounting(timeout_s)
+    if not counts:
+        record["flops_error"] = "cost analysis unavailable"
+        return
+    from eegnetreplication_tpu.utils.flops import assumed_peak_flops
+
+    device_kind = None
+    if PLATFORM != "cpu":
+        try:
+            import jax
+
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001
+            pass
+    peak, peak_label = assumed_peak_flops(device_kind)
+    on_accel = PLATFORM != "cpu"
+    if on_accel:
+        record["mfu_peak"] = peak_label
+
+    fe = counts.get("fold_epoch_flops")
+    if fe:
+        record["fold_epoch_gflops"] = round(fe / 1e9, 3)
+        for rate_key, prefix in (("value", "train"),
+                                 ("fold36_epochs_per_s", "fold36"),
+                                 ("mxu_default_fold_epochs_per_s",
+                                  "mxu_default")):
+            rate = record.get(rate_key)
+            if not rate:
+                continue
+            flops_per_s = rate * fe
+            record[f"{prefix}_gflops_per_s"] = round(flops_per_s / 1e9, 1)
+            if on_accel:
+                record[f"{prefix}_mfu_pct"] = round(
+                    100 * flops_per_s / peak, 4)
+    ev = counts.get("eval_forward_flops_pool")
+    if ev:
+        per_trial = ev / N_POOL
+        for key, prefix in (("eval_fused_trials_per_s", "eval_fused"),
+                            ("eval_pallas_trials_per_s", "eval_pallas")):
+            rate = record.get(key)
+            if not rate:
+                continue
+            flops_per_s = rate * per_trial
+            record[f"{prefix}_gflops_per_s"] = round(flops_per_s / 1e9, 1)
+            if on_accel:
+                record[f"{prefix}_mfu_pct"] = round(
+                    100 * flops_per_s / peak, 4)
+
+
+def _compile_cache_state() -> tuple[str, str | None]:
+    """("off"|"cold"|"warm:<n>", cache_dir) before the headline compile."""
+    cache_dir = PROBE_INFO.get("cache_dir")
+    if not cache_dir:
+        return "off", None
+    try:
+        entries = len(os.listdir(cache_dir))
+    except OSError:
+        return "off", None
+    return (f"warm:{entries}" if entries else "cold"), cache_dir
+
+
+def _read_last_onchip() -> dict | None:
+    try:
+        with open(_ONCHIP_LAST_PATH) as f:
+            entry = json.load(f)
+        return entry if isinstance(entry, dict) else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _write_last_onchip(record: dict) -> None:
+    """Persist the headline of a successful on-chip run (best-effort).
+
+    A later CPU-fallback line embeds this as ``last_onchip`` so the
+    artifact is self-explaining about what the chip measured most
+    recently — informational only, never the headline value.
+    """
+    try:
+        entry = {
+            "value": record.get("value"),
+            "unit": record.get("unit"),
+            "vs_baseline": record.get("vs_baseline"),
+            "platform": record.get("platform"),
+            "compile_s": record.get("compile_s"),
+            "train_mfu_pct": record.get("train_mfu_pct"),
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        }
+        tmp = f"{_ONCHIP_LAST_PATH}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(entry, f)
+        os.replace(tmp, _ONCHIP_LAST_PATH)
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def _arm_watchdog(record: dict, deadline_s: float) -> "threading.Timer":
     """Best-effort guard for hangs the probe can't prevent.
 
@@ -379,11 +570,28 @@ def main() -> None:
         "unit": "fold-epochs/s",
         "vs_baseline": 0.0,
         "platform": PLATFORM,
+        "probe_result": PROBE_INFO.get("result"),
+        "probe_attempts": PROBE_INFO.get("attempts"),
+        "probe_seconds": PROBE_INFO.get("seconds"),
     }
+    if PROBE_INFO.get("fallback_reason"):
+        record["fallback_reason"] = PROBE_INFO["fallback_reason"]
+    if PLATFORM == "cpu":
+        last = _read_last_onchip()
+        if last:
+            record["last_onchip"] = last
+    cache_state, _cache_dir = _compile_cache_state()
+    record["compile_cache"] = cache_state
     try:
         deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
     except ValueError:
         deadline_s = 1500.0
+    # The driver's external envelope starts at process launch, so probe
+    # retry time already spent at import counts against it; arm the
+    # watchdog with the REMAINDER or a hung stage would emit its JSON
+    # only after the driver's own timeout already killed us.
+    deadline_s = max(180.0, deadline_s - float(PROBE_INFO.get("seconds")
+                                               or 0.0))
     watchdog = _arm_watchdog(record, deadline_s)
     t_start = time.perf_counter()
     try:
@@ -391,6 +599,14 @@ def main() -> None:
         folds = _fold_indices()
         ours, compile_s = bench_tpu(x, y, folds)
         record.update(value=round(ours, 2), compile_s=round(compile_s, 2))
+        if _cache_dir:
+            try:  # how many executables the headline compile added
+                record["compile_cache_new_entries"] = (
+                    len(os.listdir(_cache_dir))
+                    - int(cache_state.split(":")[1])
+                    if ":" in cache_state else len(os.listdir(_cache_dir)))
+            except OSError:
+                pass
         baseline = bench_torch_reference_style(x, y, folds)
         record.update(
             vs_baseline=round(ours / baseline, 2),
@@ -434,6 +650,22 @@ def main() -> None:
             else:
                 record["mxu_default_error"] = (
                     "skipped: insufficient time budget")
+        # FLOP/s + MFU accounting (VERDICT r2 item 3).  Budget-guarded
+        # against the REMAINING watchdog budget (probe retries may already
+        # have shrunk deadline_s): the subprocess gets the smaller of its
+        # nominal cap and what the watchdog leaves, minus a margin, and is
+        # skipped outright when that window is too small to be useful —
+        # a cost-analysis add-on must never push an already-valid headline
+        # into the watchdog.
+        remaining_s = deadline_s - (time.perf_counter() - t_start)
+        if os.environ.get("BENCH_SMOKE") or remaining_s > 180.0:
+            _add_flops_fields(record,
+                              timeout_s=min(420.0, max(120.0,
+                                                       remaining_s - 60.0)))
+        else:
+            record["flops_error"] = "skipped: insufficient time budget"
+        if PLATFORM != "cpu" and not record.get("error"):
+            _write_last_onchip(record)
     except Exception as exc:  # noqa: BLE001 — contract: always emit the line
         record["error"] = f"{type(exc).__name__}: {exc}"[:300]
     if _EMIT_ONCE.acquire(blocking=False):

@@ -4,9 +4,11 @@ from eegnetreplication_tpu.training.loop import (  # noqa: F401
     FoldResult,
     FoldSpec,
     evaluate_pool,
+    init_fold_carry,
     init_fold_states,
     make_fold_spec,
     make_fold_trainer,
+    make_multi_fold_segment,
     make_multi_fold_trainer,
 )
 from eegnetreplication_tpu.training.steps import (  # noqa: F401

@@ -61,8 +61,15 @@ def wait_for_async_saves() -> None:
                 # metadata write is being retried (a closed checkpointer
                 # cannot be waited on again)
                 ckptr.wait_until_finished()
-                ckptr.close()
-                ckptr = None
+                # The commit is durable once the wait returns; close() only
+                # releases host resources.  Drop the handle whether or not
+                # close() raises — re-waiting a half-closed checkpointer is
+                # undefined in Orbax, so a retry of this entry must skip
+                # straight to the metadata write (ADVICE r2).
+                try:
+                    ckptr.close()
+                finally:
+                    ckptr = None
             (path / _METADATA_FILE).write_text(json.dumps(metadata))
         except Exception as exc:  # noqa: BLE001 — aggregate, keep going
             failures.append(((ckptr, path, metadata), exc))

@@ -1,0 +1,203 @@
+"""FLOP accounting and MFU for the fused trainers and eval kernels.
+
+The reference measures nothing here — its training loop is a torch CPU
+epoch loop with per-batch dispatch (``/root/reference/src/eegnet_repl/
+model.py:130-168``) and no hardware-utilization reporting.  Achieved
+FLOP/s and MFU are this build's currency for the "matching-or-beating on
+perf" claim: they ground the workload-relative fold-epochs/s ratio in
+hardware terms (BASELINE.json's throughput north star).
+
+Counting strategy: lower the REAL per-batch step functions
+(:func:`~eegnetreplication_tpu.training.steps.train_step` /
+:func:`~eegnetreplication_tpu.training.steps.eval_step`) on shape-only
+avals — no device compute, no backend compile — and read XLA's HLO cost
+model.  The scanned trainers are then costed as steps-per-epoch times the
+per-step number.  Deliberately scan-free: HLO cost analysis counts a
+``while`` body once regardless of trip count, so costing the full scanned
+program would understate by ~the epoch count.  The scan itself adds only
+index bookkeeping (gather + PRNG splits), which is noise next to the conv
+FLOPs.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+
+__all__ = [
+    "train_step_flops",
+    "eval_step_flops",
+    "fold_epoch_flops",
+    "eval_forward_flops",
+    "assumed_peak_flops",
+    "mfu",
+]
+
+
+def _cost_flops(lowered) -> float | None:
+    """HLO-cost-model flop count of a ``Lowered``, or None if unavailable."""
+    try:
+        analysis = lowered.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if not analysis:
+            return None
+        flops = analysis.get("flops")
+        if flops is None or not flops > 0:  # also rejects NaN
+            return None
+        return float(flops)
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        return None
+
+
+def _state_avals(model, tx, sample_shape):
+    """Shape-only pytree of a ``TrainState`` without touching a device."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..training.steps import TrainState
+
+    def build():
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, *sample_shape)), train=False)
+        return TrainState.create(variables, tx)
+
+    return jax.eval_shape(build)
+
+
+def _key_aval():
+    import jax
+
+    return jax.eval_shape(lambda: jax.random.PRNGKey(0))
+
+
+def train_step_flops(model, tx, batch_size: int, sample_shape) -> float | None:
+    """XLA-cost-model FLOPs of ONE optimizer step at ``batch_size``.
+
+    This is the exact ``train_step`` the epoch scanner scans
+    (``training/loop.py::make_epoch_scanner``): forward, backward, Adam
+    update, and the reference-style max-norm clamp.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..training import steps as steps_lib
+
+    state = _state_avals(model, tx, sample_shape)
+    x = jax.ShapeDtypeStruct((batch_size, *sample_shape), jnp.float32)
+    y = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
+    w = jax.ShapeDtypeStruct((batch_size,), jnp.float32)
+
+    def step(st, xx, yy, ww, rng):
+        return steps_lib.train_step(model, tx, st, xx, yy, ww, rng)
+
+    try:
+        lowered = jax.jit(step).lower(state, x, y, w, _key_aval())
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        return None
+    return _cost_flops(lowered)
+
+
+def eval_step_flops(model, tx, batch_size: int, sample_shape) -> float | None:
+    """XLA-cost-model FLOPs of ONE validation batch (eval-mode forward)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..training import steps as steps_lib
+
+    state = _state_avals(model, tx, sample_shape)
+    x = jax.ShapeDtypeStruct((batch_size, *sample_shape), jnp.float32)
+    y = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
+    w = jax.ShapeDtypeStruct((batch_size,), jnp.float32)
+
+    def step(st, xx, yy, ww):
+        return steps_lib.eval_step(model, st, xx, yy, ww)
+
+    try:
+        lowered = jax.jit(step).lower(state, x, y, w)
+    except Exception:  # noqa: BLE001
+        return None
+    return _cost_flops(lowered)
+
+
+def fold_epoch_flops(model, tx, *, batch_size: int, train_pad: int,
+                     val_pad: int, sample_shape) -> float | None:
+    """FLOPs of one (fold x epoch) unit of the fused trainer.
+
+    Mirrors the scanner's slot math (``loop.py::make_epoch_scanner``):
+    ``ceil(train_pad/batch)`` full training batches plus
+    ``max(1, ceil(val_pad/batch))`` validation batches — padding batches
+    run at full cost on the hardware, so they are counted.
+    """
+    train_steps = math.ceil(train_pad / batch_size)
+    val_steps = max(1, math.ceil(val_pad / batch_size))
+    tf = train_step_flops(model, tx, batch_size, sample_shape)
+    ef = eval_step_flops(model, tx, batch_size, sample_shape)
+    if tf is None or ef is None:
+        return None
+    return train_steps * tf + val_steps * ef
+
+
+def eval_forward_flops(model, batch_size: int, sample_shape) -> float | None:
+    """XLA-cost-model FLOPs of one inference forward at ``batch_size``."""
+    import jax
+    import jax.numpy as jnp
+
+    def build_vars():
+        return model.init(jax.random.PRNGKey(0),
+                          jnp.zeros((1, *sample_shape)), train=False)
+
+    variables = jax.eval_shape(build_vars)
+    x = jax.ShapeDtypeStruct((batch_size, *sample_shape), jnp.float32)
+
+    def fwd(vars_, xx):
+        return model.apply(vars_, xx, train=False)
+
+    try:
+        lowered = jax.jit(fwd).lower(variables, x)
+    except Exception:  # noqa: BLE001
+        return None
+    return _cost_flops(lowered)
+
+
+# Dense peak FLOP/s by device kind, matmul-precision-agnostic entries keyed
+# by the substring JAX reports in ``device_kind``.  v5e: 197 TFLOP/s bf16
+# (394 int8); bf16 is the MXU's native operand width, so it is the honest
+# denominator even for f32-precision runs (which spend extra passes to
+# reach f32 accuracy — that cost SHOULD show up as lower MFU).
+_PEAK_BY_KIND = (
+    ("v5 lite", 197e12, "TPU v5e bf16 peak (197 TFLOP/s)"),
+    ("v5litepod", 197e12, "TPU v5e bf16 peak (197 TFLOP/s)"),
+    ("v5e", 197e12, "TPU v5e bf16 peak (197 TFLOP/s)"),
+    ("v5p", 459e12, "TPU v5p bf16 peak (459 TFLOP/s)"),
+    ("v4", 275e12, "TPU v4 bf16 peak (275 TFLOP/s)"),
+    ("v6", 918e12, "TPU v6e bf16 peak (918 TFLOP/s)"),
+)
+_DEFAULT_PEAK = (197e12, "assumed TPU v5e bf16 peak (197 TFLOP/s)")
+
+
+def assumed_peak_flops(device_kind: str | None = None) -> tuple[float, str]:
+    """(peak FLOP/s, label) for the MFU denominator.
+
+    ``EEGTPU_PEAK_FLOPS`` overrides (a float, e.g. ``197e12``); otherwise
+    the peak is looked up from the JAX ``device_kind`` string, defaulting
+    to the v5e figure this project benches on (BENCH_NOTES.md).
+    """
+    env = os.environ.get("EEGTPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env), f"EEGTPU_PEAK_FLOPS={env}"
+        except ValueError:
+            pass
+    if device_kind:
+        kind = device_kind.lower()
+        for needle, peak, label in _PEAK_BY_KIND:
+            if needle in kind:
+                return peak, label
+    return _DEFAULT_PEAK
+
+
+def mfu(flops_per_s: float, device_kind: str | None = None) -> float:
+    """Model FLOP/s utilization against :func:`assumed_peak_flops`."""
+    peak, _ = assumed_peak_flops(device_kind)
+    return flops_per_s / peak

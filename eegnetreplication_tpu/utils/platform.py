@@ -115,18 +115,23 @@ def _write_probe_cache(result: str | None) -> None:
             pass
 
 
-def probe_accelerator(timeout_s: float = 90.0) -> str | None:
-    """Try accelerator backend init in a subprocess; backend name or None.
+def probe_accelerator_info(timeout_s: float = 90.0,
+                           refresh: bool = False) -> dict:
+    """Like :func:`probe_accelerator`, but returns outcome diagnostics.
 
-    Runs out-of-process because a broken tunneled backend can hang inside
-    its C++ init where no in-process timeout can reach it.  The outcome is
-    cached for 10 minutes (``/tmp``): a GUI session launches fetch/dataset/
-    train CLIs serially and each would otherwise pay the full timeout when
-    the tunnel is down.  ``EEGTPU_PROBE_CACHE=0`` disables the cache.
+    Returns ``{"result": str | None, "reason": str, "seconds": float,
+    "cached": bool}``.  ``refresh=True`` skips the cache *read* (the
+    benchmark's retry loop must not be answered by a stale negative entry)
+    while still writing the fresh outcome for later callers.
     """
-    cached = _read_probe_cache()
-    if cached is not _MISS:
-        return cached
+    import time
+
+    if not refresh:
+        cached = _read_probe_cache()
+        if cached is not _MISS:
+            return {"result": cached, "reason": "cached probe outcome",
+                    "seconds": 0.0, "cached": True}
+    t0 = time.monotonic()
     env = dict(os.environ)
     env.pop("EEGTPU_PLATFORM", None)
     # Belt and braces with _PROBE_SRC's in-process disable: an ambient
@@ -139,13 +144,14 @@ def probe_accelerator(timeout_s: float = 90.0) -> str | None:
     try:
         proc = subprocess.Popen(
             [sys.executable, "-c", _PROBE_SRC], stdout=subprocess.PIPE,
-            stderr=subprocess.DEVNULL, text=True, env=env,
+            stderr=subprocess.PIPE, text=True, env=env,
             start_new_session=True,
         )
-    except OSError:
-        return None  # transient spawn failure: don't cache
+    except OSError as exc:  # transient spawn failure: don't cache
+        return {"result": None, "reason": f"probe spawn failed: {exc}",
+                "seconds": time.monotonic() - t0, "cached": False}
     try:
-        stdout, _ = proc.communicate(timeout=timeout_s)
+        stdout, stderr = proc.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
         try:
             os.killpg(proc.pid, signal.SIGKILL)
@@ -156,13 +162,34 @@ def probe_accelerator(timeout_s: float = 90.0) -> str | None:
         except Exception:
             pass
         _write_probe_cache(None)  # a hung tunnel: exactly what to remember
-        return None
+        return {"result": None,
+                "reason": f"probe timed out after {timeout_s:.0f}s "
+                          "(backend init or compile hung)",
+                "seconds": time.monotonic() - t0, "cached": False}
     if proc.returncode != 0:
         _write_probe_cache(None)
-        return None
+        tail = (stderr or "").strip().splitlines()
+        detail = tail[-1][-160:] if tail else "no stderr"
+        return {"result": None,
+                "reason": f"probe exited rc={proc.returncode}: {detail}",
+                "seconds": time.monotonic() - t0, "cached": False}
     name = stdout.strip().splitlines()[-1] if stdout.strip() else ""
     _write_probe_cache(name or None)
-    return name or None
+    return {"result": name or None,
+            "reason": "ok" if name else "probe printed no backend name",
+            "seconds": time.monotonic() - t0, "cached": False}
+
+
+def probe_accelerator(timeout_s: float = 90.0) -> str | None:
+    """Try accelerator backend init in a subprocess; backend name or None.
+
+    Runs out-of-process because a broken tunneled backend can hang inside
+    its C++ init where no in-process timeout can reach it.  The outcome is
+    cached for 10 minutes (``/tmp``): a GUI session launches fetch/dataset/
+    train CLIs serially and each would otherwise pay the full timeout when
+    the tunnel is down.  ``EEGTPU_PROBE_CACHE=0`` disables the cache.
+    """
+    return probe_accelerator_info(timeout_s)["result"]
 
 
 def force_cpu(n_devices: int | None = None) -> bool:
@@ -270,6 +297,67 @@ def enable_compilation_cache() -> str | None:
     return path
 
 
+def select_platform_info(probe_timeout_s: float | None = None,
+                         retries: int = 0,
+                         retry_sleep_s: float = 45.0) -> tuple[str, dict]:
+    """Pick the JAX platform; returns ``(platform, diagnostics)``.
+
+    ``EEGTPU_PLATFORM`` wins when set; otherwise probe the accelerator in
+    a subprocess, retrying up to ``retries`` times with a pause — the
+    tunneled backend's availability is intermittent on the scale of
+    minutes (round-2 postmortem: one bad-minute probe turned the round's
+    bench artifact into a CPU line).  Retry attempts bypass the probe
+    cache *read* so a stale negative entry can't veto them.  Falls back to
+    CPU when every attempt fails.  Never raises.  When an accelerator is
+    selected, also enables the persistent compilation cache.
+
+    The diagnostics dict carries ``result``, ``attempts``, ``seconds``
+    (total selection time), ``fallback_reason`` (None on success),
+    ``cache_dir`` and ``forced`` — enough for a caller's telemetry to be
+    self-explaining about why it ran where it ran.
+    """
+    import time
+
+    info: dict = {"attempts": 0, "seconds": 0.0, "result": None,
+                  "fallback_reason": None, "cache_dir": None,
+                  "forced": False}
+    try:
+        forced = apply_platform_override()
+        if forced:
+            if forced != "cpu":
+                info["cache_dir"] = enable_compilation_cache()
+            info.update(result=forced, forced=True)
+            return forced, info
+        if probe_timeout_s is None:
+            try:
+                probe_timeout_s = float(
+                    os.environ.get("BENCH_TPU_PROBE_S", "90"))
+            except ValueError:
+                probe_timeout_s = 90.0
+        reasons: list[str] = []
+        t0 = time.monotonic()
+        for attempt in range(1 + max(0, retries)):
+            if attempt:
+                time.sleep(min(retry_sleep_s, probe_timeout_s / 2))
+            r = probe_accelerator_info(probe_timeout_s, refresh=attempt > 0)
+            info["attempts"] = attempt + 1
+            reasons.append(r["reason"])
+            if r["result"]:
+                info.update(result=r["result"],
+                            seconds=round(time.monotonic() - t0, 1))
+                info["cache_dir"] = enable_compilation_cache()
+                return r["result"], info  # ambient pin stays in charge
+            if r["reason"].startswith("probe spawn failed"):
+                break  # host-level failure; more attempts can't help
+        info.update(seconds=round(time.monotonic() - t0, 1),
+                    fallback_reason=" | ".join(reasons)[-400:])
+    except Exception as exc:  # noqa: BLE001 — never raise, fall back
+        info["fallback_reason"] = (
+            f"selection error: {type(exc).__name__}: {exc}"[:200])
+    force_cpu()
+    return "cpu", info
+
+
 def select_platform(probe_timeout_s: float | None = None) -> str:
     """Pick the JAX platform before any in-process backend init.
 
@@ -279,23 +367,4 @@ def select_platform(probe_timeout_s: float | None = None) -> str:
     accelerator is selected, also enables the persistent compilation cache
     (see :func:`enable_compilation_cache`).
     """
-    try:
-        forced = apply_platform_override()
-        if forced:
-            if forced != "cpu":
-                enable_compilation_cache()
-            return forced
-        if probe_timeout_s is None:
-            try:
-                probe_timeout_s = float(
-                    os.environ.get("BENCH_TPU_PROBE_S", "90"))
-            except ValueError:
-                probe_timeout_s = 90.0
-        accel = probe_accelerator(probe_timeout_s)
-        if accel is not None:
-            enable_compilation_cache()
-            return accel  # ambient pin works; leave it in charge
-    except Exception:
-        pass
-    force_cpu()
-    return "cpu"
+    return select_platform_info(probe_timeout_s)[0]

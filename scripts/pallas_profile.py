@@ -1,0 +1,128 @@
+"""On-chip Pallas-vs-XLA eval profiling across batch sizes (VERDICT r2
+item 7).
+
+Round 2 left the hand-written Pallas block-1 kernel without a measured
+on-chip win: at the product batch the tunnel round-trip dominates and
+plain ~= fused ~= pallas.  This sweeps the batch until the round-trip
+stops dominating — wall time grows linearly once compute dominates — and
+records trials/s per variant, the pallas/plain ratio and, when the
+backend supports it, a ``jax.profiler`` device trace.  The output table
+(``pallas_profile.json``) is the decide-with-data artifact for keeping
+the kernel on the ``predict`` path or rescoping it.
+
+Run with the ambient chip pin: ``python scripts/pallas_profile.py --out
+/tmp/pallas_prof``.  CPU dress: ``EEGTPU_PLATFORM=cpu ... --batches
+256,1024``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", required=True)
+    parser.add_argument("--batches", default="512,2048,8192,32768")
+    parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--trace", action="store_true",
+                        help="Also attempt a jax.profiler device trace "
+                             "(written under <out>/trace).")
+    args = parser.parse_args(argv)
+    out = Path(args.out)
+    out.mkdir(parents=True, exist_ok=True)
+    batches = [int(b) for b in args.batches.split(",")]
+
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    platform = select_platform()
+
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.ops.fused_eegnet import (
+        fused_eval_forward,
+        probe_pallas,
+    )
+
+    C, T = 22, 257
+    model = EEGNet(n_channels=C, n_times=T)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, C, T)),
+                           train=False)
+    params, bs = variables["params"], variables["batch_stats"]
+    plain = jax.jit(lambda xx: model.apply(
+        {"params": params, "batch_stats": bs}, xx, train=False))
+    variants = {
+        "plain": plain,
+        "fused": lambda xx: fused_eval_forward(model, params, bs, xx,
+                                               use_pallas=False),
+    }
+    has_pallas = probe_pallas(model)
+    if has_pallas:
+        variants["pallas"] = lambda xx: fused_eval_forward(
+            model, params, bs, xx, use_pallas=True)
+
+    salt = int.from_bytes(os.urandom(4), "little")
+    record = {"platform": platform, "pallas_available": bool(has_pallas),
+              "batches": {}, "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                                  time.gmtime())}
+    for batch in batches:
+        rng = np.random.RandomState((salt + batch) % (2 ** 31))
+        pools = [jnp.asarray(rng.randn(batch, C, T), jnp.float32)
+                 for _ in range(args.reps + 1)]
+        row = {}
+        for name, fn in variants.items():
+            try:
+                jax.block_until_ready(fn(pools[0]))  # compile
+                walls, digests = [], set()
+                for i in range(1, args.reps + 1):
+                    t0 = time.perf_counter()
+                    res = np.asarray(fn(pools[i]))  # real D2H bytes
+                    walls.append(time.perf_counter() - t0)
+                    digests.add(res.tobytes()[:4096])
+                if len(digests) < args.reps:
+                    row[name] = {"error": "replayed results (stale tunnel)"}
+                    continue
+                wall = float(np.median(walls))
+                row[name] = {"wall_s": round(wall, 5),
+                             "trials_per_s": round(batch / wall)}
+            except Exception as exc:  # noqa: BLE001 — record and continue
+                row[name] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+        if "trials_per_s" in row.get("plain", {}):
+            for name in ("fused", "pallas"):
+                if "trials_per_s" in row.get(name, {}):
+                    row[name]["vs_plain"] = round(
+                        row[name]["trials_per_s"]
+                        / row["plain"]["trials_per_s"], 3)
+        record["batches"][str(batch)] = row
+        print(json.dumps({batch: row}), flush=True)
+
+    if args.trace:
+        try:
+            with jax.profiler.trace(str(out / "trace")):
+                for name, fn in variants.items():
+                    jax.block_until_ready(fn(jnp.asarray(
+                        np.random.RandomState(salt % 1000)
+                        .randn(batches[-1], C, T), jnp.float32)))
+            record["trace"] = str(out / "trace")
+        except Exception as exc:  # noqa: BLE001
+            record["trace_error"] = f"{type(exc).__name__}: {exc}"[:200]
+
+    (out / "pallas_profile.json").write_text(json.dumps(record, indent=1))
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,136 @@
+"""One uninterrupted product-path rehearsal: files -> CLIs -> artifacts.
+
+VERDICT r2 item 6: the round-2 protocol-scale hardware datapoint injected
+synthetic arrays at the loader; this drives the REAL file/CLI boundary
+end-to-end instead, timing every stage and leaving the artifacts on disk:
+
+  1. ``scripts/make_full_dataset.py``     full-size raw GDF tree + .mat
+  2. ``python -m eegnetreplication_tpu.dataset --src kaggle``
+  3. ``python -m eegnetreplication_tpu.data.verify``
+  4. ``python -m eegnetreplication_tpu.train --trainingType Within-Subject
+     --epochs 500``  (all flags at reference defaults)
+  5. ``python -m eegnetreplication_tpu.predict`` on subject 1's Eval set
+  6. viz figures (temporal/spatial/PSD) saved from the trained checkpoint
+
+Stage walls and exit codes land in ``<root>/rehearsal.json``.  Run on the
+chip (ambient axon pin, no EEGTPU_PLATFORM override) or force
+``--platform cpu`` for a CI-sized dress rehearsal via ``--subjects 2
+--epochs 8 --trials 24``.
+
+Matches reference entry points ``dataset.py:334-363``, ``train.py:491-512``,
+``ui.py:597-620``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def run_stage(name: str, cmd: list[str], root: Path, record: dict,
+              platform: str | None, timeout: float = 7200.0) -> bool:
+    env = dict(os.environ, EEGTPU_DATA_ROOT=str(root),
+               PYTHONPATH=f"{REPO}:{os.environ.get('PYTHONPATH', '')}")
+    if platform:
+        env["EEGTPU_PLATFORM"] = platform
+    print(f"--- {name}: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    try:
+        proc = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout,
+                              capture_output=True, text=True)
+        rc, tail = proc.returncode, (proc.stdout + proc.stderr)[-1500:]
+    except subprocess.TimeoutExpired:
+        rc, tail = -1, f"timeout after {timeout}s"
+    wall = time.time() - t0
+    record["stages"].append({"name": name, "wall_s": round(wall, 1),
+                             "rc": rc})
+    print(f"--- {name}: rc={rc} in {wall:.1f}s", flush=True)
+    if rc != 0:
+        print(tail, flush=True)
+    return rc == 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--root", required=True,
+                        help="Working root for data/models/reports.")
+    parser.add_argument("--subjects", type=int, default=9)
+    parser.add_argument("--trials", type=int, default=288)
+    parser.add_argument("--epochs", type=int, default=500)
+    parser.add_argument("--platform", default=None,
+                        help="EEGTPU_PLATFORM override for the stages "
+                             "(default: ambient, i.e. the chip).")
+    args = parser.parse_args(argv)
+
+    root = Path(args.root)
+    root.mkdir(parents=True, exist_ok=True)
+    record: dict = {"stages": [], "subjects": args.subjects,
+                    "trials": args.trials, "epochs": args.epochs,
+                    "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())}
+    subj_list = ",".join(str(s) for s in range(1, args.subjects + 1))
+    py = sys.executable
+    ok = run_stage(
+        "make-data",
+        [py, str(REPO / "scripts" / "make_full_dataset.py"),
+         "--root", str(root), "--subjects", str(args.subjects),
+         "--trials", str(args.trials)],
+        root, record, platform="cpu")  # pure numpy: never needs the chip
+    ok = ok and run_stage(
+        "dataset", [py, "-m", "eegnetreplication_tpu.dataset",
+                    "--src", "kaggle"],
+        root, record, platform="cpu")
+    ok = ok and run_stage(
+        "verify", [py, "-m", "eegnetreplication_tpu.data.verify",
+                   "--subjects", subj_list],
+        root, record, platform="cpu")
+    ok = ok and run_stage(
+        "train-ws", [py, "-m", "eegnetreplication_tpu.train",
+                     "--trainingType", "Within-Subject",
+                     "--epochs", str(args.epochs),
+                     "--subjects", subj_list],
+        root, record, platform=args.platform)
+    ok = ok and run_stage(
+        "predict", [py, "-m", "eegnetreplication_tpu.predict",
+                    "--checkpoint",
+                    str(root / "models" / "subject_01_best_model.npz"),
+                    "--subject", "1", "--mode", "Eval"],
+        root, record, platform=args.platform)
+    if ok:
+        viz_src = (
+            "import sys; sys.path.insert(0, {repo!r})\n"
+            "from pathlib import Path\n"
+            "import matplotlib; matplotlib.use('Agg')\n"
+            "from eegnetreplication_tpu.viz import (load_model_filters, "
+            "plot_temporal_filters, plot_spatial_filters, "
+            "plot_power_spectra_of_temporal_filters)\n"
+            "root = Path({root!r})\n"
+            "f = load_model_filters(root / 'models' / "
+            "'subject_01_best_model.pth')\n"
+            "out = root / 'figures'; out.mkdir(exist_ok=True)\n"
+            "plot_temporal_filters(f, show=False, "
+            "save_path=out / 'temporal.png')\n"
+            "plot_spatial_filters(f, show=False, "
+            "save_path=out / 'spatial.png')\n"
+            "plot_power_spectra_of_temporal_filters(f, show=False, "
+            "save_path=out / 'psd.png')\n"
+            "print('figures:', sorted(p.name for p in out.iterdir()))\n"
+        ).format(repo=str(REPO), root=str(root))
+        ok = run_stage("viz", [py, "-c", viz_src], root, record,
+                       platform="cpu")
+    record["ok"] = ok
+    out = root / "rehearsal.json"
+    out.write_text(json.dumps(record, indent=1))
+    print(json.dumps(record))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

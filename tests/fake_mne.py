@@ -1,0 +1,130 @@
+"""Minimal MNE test double (VERDICT r2 item 9).
+
+MNE is not installed in this image, so the ``.fif`` ingest branches
+(``data/epoching.py::build_dataset_from_fif_dir``,
+``data/moabb.py::load_moabb_run``) would be import-gated dead code in CI.
+This double implements exactly the API slice those branches touch —
+``mne.io.read_raw_fif``, ``mne.events_from_annotations``, ``mne.Epochs``
+— backed by ``.npz`` payloads wearing ``.fif`` names
+(:func:`write_fake_fif`), with MNE's semantics where they matter:
+
+- ``Epochs`` windows are inclusive of ``tmax`` (``tmin=0.5, tmax=2.5`` at
+  128 Hz -> samples 64..320 -> 257);
+- epochs whose window falls off the recording are DROPPED and
+  ``.selection`` records the surviving indices within the event-id-matched
+  list (the property ``build_dataset_from_fif_dir`` relies on for
+  TrueLabels alignment);
+- ``Raw.pick("eeg")`` filters by channel type (the moabb loader's EOG
+  drop).
+
+Install via :func:`install` (registers ``mne`` in ``sys.modules``); tests
+skip the double automatically when the real MNE is importable.
+"""
+
+from __future__ import annotations
+
+import sys
+import types
+
+import numpy as np
+
+
+class _Annotations:
+    def __init__(self, onset, description):
+        self.onset = np.asarray(onset, float)
+        self.description = np.asarray([str(d) for d in description],
+                                      dtype=object)
+
+
+class _RawFif:
+    def __init__(self, data, sfreq, ch_names, ch_types, onsets, descs):
+        self._data = np.asarray(data, float)
+        self._ch_types = [str(t) for t in ch_types]
+        self.ch_names = [str(c) for c in ch_names]
+        self.info = {"sfreq": float(sfreq)}
+        self.annotations = _Annotations(onsets, descs)
+
+    def pick(self, picks):
+        keep = [i for i, t in enumerate(self._ch_types) if t == picks]
+        self._data = self._data[keep]
+        self.ch_names = [self.ch_names[i] for i in keep]
+        self._ch_types = [self._ch_types[i] for i in keep]
+        return self
+
+    def get_data(self):
+        return self._data
+
+
+def write_fake_fif(path, data, sfreq, ch_names, onsets_s, descriptions,
+                   ch_types=None) -> None:
+    """Write an ``.npz`` payload under a ``.fif`` name for read_raw_fif."""
+    ch_types = ch_types or ["eeg"] * len(ch_names)
+    with open(path, "wb") as f:  # np.savez(path) would append ".npz"
+        np.savez(f, data=np.asarray(data, float), sfreq=float(sfreq),
+                 ch_names=np.asarray(ch_names, object),
+                 ch_types=np.asarray(ch_types, object),
+                 onsets=np.asarray(onsets_s, float),
+                 descs=np.asarray([str(d) for d in descriptions], object))
+
+
+def read_raw_fif(path, preload=True, verbose=None) -> _RawFif:
+    z = np.load(path, allow_pickle=True)
+    return _RawFif(z["data"], float(z["sfreq"]), list(z["ch_names"]),
+                   list(z["ch_types"]), z["onsets"], list(z["descs"]))
+
+
+def events_from_annotations(raw, verbose=None):
+    descs = sorted({str(d) for d in raw.annotations.description})
+    event_id = {d: i + 1 for i, d in enumerate(descs)}
+    sf = raw.info["sfreq"]
+    events = np.asarray(
+        [[int(round(o * sf)), 0, event_id[str(d)]]
+         for o, d in zip(raw.annotations.onset,
+                         raw.annotations.description)],
+        int).reshape(-1, 3)
+    return events, event_id
+
+
+class Epochs:
+    def __init__(self, raw, events, event_id=None, tmin=0.0, tmax=1.0,
+                 baseline=None, preload=True, verbose=None):
+        sf = raw.info["sfreq"]
+        lo, hi = int(round(tmin * sf)), int(round(tmax * sf))
+        codes = set((event_id or {}).values())
+        data = raw.get_data()
+        matched = [e for e in np.asarray(events).reshape(-1, 3)
+                   if int(e[2]) in codes]
+        sel, wins, evs = [], [], []
+        for j, e in enumerate(matched):
+            a = int(e[0]) + lo
+            b = int(e[0]) + hi + 1  # inclusive tmax, like MNE
+            if a < 0 or b > data.shape[1]:
+                continue  # off-recording window: dropped, like MNE
+            sel.append(j)
+            wins.append(data[:, a:b])
+            evs.append(e)
+        self.selection = np.asarray(sel, int)
+        self.events = np.asarray(evs, int).reshape(-1, 3)
+        self._wins = (np.asarray(wins, float) if wins
+                      else np.zeros((0, data.shape[0], hi - lo + 1)))
+
+    def get_data(self):
+        return self._wins
+
+
+def install() -> types.ModuleType:
+    """Register the double as ``mne`` / ``mne.io`` in ``sys.modules``."""
+    mne = types.ModuleType("mne")
+    io_mod = types.ModuleType("mne.io")
+    io_mod.read_raw_fif = read_raw_fif
+    mne.io = io_mod
+    mne.events_from_annotations = events_from_annotations
+    mne.Epochs = Epochs
+    sys.modules["mne"] = mne
+    sys.modules["mne.io"] = io_mod
+    return mne
+
+
+def uninstall() -> None:
+    sys.modules.pop("mne", None)
+    sys.modules.pop("mne.io", None)

@@ -185,5 +185,23 @@ class TestFetchHelpers(unittest.TestCase):
             self.assertFalse((dst / "Train" / "stale.gdf").exists())
             self.assertEqual((dst / "readme.txt").read_text(), "hello")
 
+    def test_mirror_into_replaces_shape_mismatches(self):
+        """A file where the cache has a dir (and vice versa) is replaced."""
+        from eegnetreplication_tpu.fetch import _mirror_into
+
+        with tempfile.TemporaryDirectory() as td:
+            src = Path(td) / "cache"
+            (src / "Train").mkdir(parents=True)
+            (src / "Train" / "A01T.gdf").write_bytes(b"new")
+            (src / "notes").write_text("now a file")
+            dst = Path(td) / "raw"
+            dst.mkdir()
+            (dst / "Train").write_text("file where a dir belongs")
+            (dst / "notes").mkdir()
+            (dst / "notes" / "stale").write_text("dir where a file belongs")
+            _mirror_into(src, dst)
+            self.assertEqual((dst / "Train" / "A01T.gdf").read_bytes(), b"new")
+            self.assertEqual((dst / "notes").read_text(), "now a file")
+
 if __name__ == "__main__":
     unittest.main()

@@ -1,0 +1,115 @@
+"""The MNE-gated ``.fif`` ingest branches, executed in CI (VERDICT r2
+item 9).
+
+Without MNE these paths (``epoching.py::build_dataset_from_fif_dir``,
+``moabb.py::load_moabb_run``) were import-gated dead code here; the
+``fake_mne`` double supplies the API slice they touch, so the branch
+logic — annotation-id selection, TrueLabels alignment via
+``Epochs.selection``, the V->uV conversion and EOG drop — now runs in CI.
+(With a real MNE installed these double-backed fixtures skip; the payload
+format is the double's.)
+"""
+
+import importlib.util
+
+import numpy as np
+import pytest
+from scipy import io as scipy_io
+
+from eegnetreplication_tpu.config import Paths
+
+SFREQ = 128.0  # -> 0.5..2.5 s inclusive = samples 64..320 = 257
+
+
+@pytest.fixture(autouse=True)
+def mne_double():
+    """Install the MNE double (these fixtures write its .npz-backed
+    payloads, which a real MNE could not parse)."""
+    if importlib.util.find_spec("mne") is not None:
+        pytest.skip("real MNE installed; the .fif branches are exercised "
+                    "directly against it elsewhere — these tests drive the "
+                    "fake_mne double")
+    import fake_mne
+
+    fake_mne.install()
+    yield
+    fake_mne.uninstall()
+
+
+def _write_session(path, descs, onsets_s, n_ch=3, n_samples=3000,
+                   seed=0, scale=1.0):
+    import fake_mne
+
+    rng = np.random.RandomState(seed)
+    data = rng.randn(n_ch, n_samples) * scale
+    fake_mne.write_fake_fif(
+        path, data, SFREQ, [f"EEG{i}" for i in range(n_ch)],
+        onsets_s, descs)
+    return data
+
+
+class TestBuildDatasetFromFifDir:
+    def test_train_session_selects_cue_descriptions(self, tmp_path):
+        from eegnetreplication_tpu.data.epoching import (
+            build_dataset_from_fif_dir,
+        )
+
+        # four cues plus a non-cue annotation that must be ignored
+        _write_session(tmp_path / "A01T-preprocessed.fif",
+                       ["769", "770", "771", "772", "768"],
+                       [2.0, 5.0, 8.0, 11.0, 1.9])
+        ds = build_dataset_from_fif_dir(
+            tmp_path, subject="1", mode="Train",
+            paths=Paths.from_root(tmp_path))
+        assert ds.X.shape == (4, 3, 257)
+        assert list(ds.y) == [0, 1, 2, 3]
+
+    def test_eval_session_aligns_true_labels_via_selection(self, tmp_path):
+        from eegnetreplication_tpu.data.epoching import (
+            build_dataset_from_fif_dir,
+        )
+
+        paths = Paths.from_root(tmp_path)
+        # five unknown-cue trials; the last one's window falls off the
+        # recording end and must drop WITH its label (selection semantics)
+        _write_session(tmp_path / "A01E-preprocessed.fif",
+                       ["783"] * 5, [2.0, 5.0, 8.0, 11.0, 22.0])
+        labels_dir = paths.data_raw / "TrueLabels"
+        labels_dir.mkdir(parents=True)
+        scipy_io.savemat(labels_dir / "A01E.mat",
+                         {"classlabel": np.array([1, 2, 3, 4, 1])})
+        ds = build_dataset_from_fif_dir(tmp_path, subject="1", mode="Eval",
+                                        paths=paths)
+        assert ds.X.shape == (4, 3, 257)
+        assert list(ds.y) == [0, 1, 2, 3]  # 5th label dropped with trial
+
+    def test_missing_files_raise(self, tmp_path):
+        from eegnetreplication_tpu.data.epoching import (
+            build_dataset_from_fif_dir,
+        )
+
+        with pytest.raises(ValueError, match="No .fif files"):
+            build_dataset_from_fif_dir(tmp_path, subject="1", mode="Train",
+                                       paths=Paths.from_root(tmp_path))
+
+
+class TestLoadMoabbRun:
+    def test_run_loads_with_uv_scaling_and_eog_drop(self, tmp_path):
+        import fake_mne
+
+        from eegnetreplication_tpu.data.moabb import load_moabb_run
+
+        rng = np.random.RandomState(1)
+        data_v = rng.randn(3, 2000) * 1e-5  # volts, MNE-style
+        path = tmp_path / "run_0.fif"
+        fake_mne.write_fake_fif(
+            path, data_v, 250.0, ["C3", "C4", "EOG1"],
+            [1.0, 3.0, 5.0], ["left_hand", "tongue", "garbage"],
+            ch_types=["eeg", "eeg", "eog"])
+        rec = load_moabb_run(path)
+        assert rec.signals.shape == (2, 2000)  # EOG dropped
+        np.testing.assert_allclose(rec.signals,
+                                   (data_v[:2] * 1e6).astype(np.float32))
+        assert list(rec.event_typ) == [769, 772]  # garbage desc ignored
+        assert list(rec.event_pos) == [250, 750]
+        assert rec.labels == ["C3", "C4"]

@@ -132,3 +132,72 @@ class TestProbe:
                 {"ts": time.time(), "result": "tpu",
                  "env": plat._probe_env_key()}))
             assert plat._read_probe_cache() == "tpu"
+
+
+class TestSelectPlatformInfo:
+    """Retry + diagnostics semantics of the shared selection helper."""
+
+    def _patch_probe(self, outcomes):
+        from eegnetreplication_tpu.utils import platform as plat
+
+        calls = []
+
+        def fake(timeout_s=90.0, refresh=False):
+            calls.append({"refresh": refresh})
+            result, reason = outcomes[min(len(calls) - 1,
+                                          len(outcomes) - 1)]
+            return {"result": result, "reason": reason, "seconds": 0.1,
+                    "cached": False}
+
+        return mock.patch.object(plat, "probe_accelerator_info", fake), calls
+
+    def test_retry_recovers_and_bypasses_cache_read(self):
+        from eegnetreplication_tpu.utils import platform as plat
+
+        patcher, calls = self._patch_probe(
+            [(None, "probe timed out after 90s"), ("axon", "ok")])
+        with patcher, \
+             mock.patch.object(plat, "enable_compilation_cache",
+                               lambda: "/tmp/cache"):
+            name, info = plat.select_platform_info(retries=2,
+                                                   retry_sleep_s=0.0)
+        assert name == "axon"
+        assert info["attempts"] == 2
+        assert info["fallback_reason"] is None
+        assert info["cache_dir"] == "/tmp/cache"
+        # attempt 0 may use the cache; retries must refresh
+        assert [c["refresh"] for c in calls] == [False, True]
+
+    def test_exhausted_retries_fall_back_with_reasons(self):
+        from eegnetreplication_tpu.utils import platform as plat
+
+        patcher, calls = self._patch_probe(
+            [(None, "probe timed out after 90s")])
+        with patcher, \
+             mock.patch.object(plat, "force_cpu", lambda: True):
+            name, info = plat.select_platform_info(retries=1,
+                                                   retry_sleep_s=0.0)
+        assert name == "cpu"
+        assert info["attempts"] == 2
+        assert "probe timed out" in info["fallback_reason"]
+
+    def test_spawn_failure_short_circuits_retries(self):
+        from eegnetreplication_tpu.utils import platform as plat
+
+        patcher, calls = self._patch_probe(
+            [(None, "probe spawn failed: boom")])
+        with patcher, \
+             mock.patch.object(plat, "force_cpu", lambda: True):
+            name, info = plat.select_platform_info(retries=3,
+                                                   retry_sleep_s=0.0)
+        assert name == "cpu"
+        assert info["attempts"] == 1  # no pointless retries
+
+    def test_forced_platform_skips_probe(self):
+        from eegnetreplication_tpu.utils import platform as plat
+
+        with mock.patch.dict(os.environ, {"EEGTPU_PLATFORM": "cpu"}), \
+             mock.patch.object(plat, "probe_accelerator_info",
+                               side_effect=AssertionError("probed anyway")):
+            name, info = plat.select_platform_info()
+        assert name == "cpu" and info["forced"] is True

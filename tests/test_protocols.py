@@ -136,6 +136,32 @@ class TestChunkedResume:
         # completed run cleans up its snapshot
         assert not (tmp_paths.models / "within_subject_eegnet.run.npz").exists()
 
+    def test_epoch_cadence_lines_logged(self, tmp_paths, caplog):
+        """Reference-style epoch lines (model.py:185-187) appear while
+        training: epoch 1 and the last epoch, live after each chunk."""
+        import logging
+
+        with caplog.at_level(logging.INFO):
+            self._run(tmp_paths, checkpoint_every=2)
+        lines = [r.getMessage() for r in caplog.records
+                 if r.getMessage().startswith("Epoch: ")]
+        assert any(line.startswith("Epoch: 1/6.. Train Loss: ")
+                   for line in lines), lines
+        assert any(line.startswith("Epoch: 6/6.. ") for line in lines), lines
+        assert all("Val Loss: " in line and "Val Acc: " in line
+                   for line in lines)
+
+    def test_epoch_cadence_lines_logged_fused(self, tmp_paths, caplog):
+        """The single-program path logs the same cadence post-hoc."""
+        import logging
+
+        with caplog.at_level(logging.INFO):
+            self._run(tmp_paths, checkpoint_every=0)
+        lines = [r.getMessage() for r in caplog.records
+                 if r.getMessage().startswith("Epoch: ")]
+        assert any(line.startswith("Epoch: 1/6.. ") for line in lines), lines
+        assert any(line.startswith("Epoch: 6/6.. ") for line in lines), lines
+
     def test_crash_and_resume_bit_identical(self, tmp_paths):
         """Kill after the first chunk; --resume completes to the same result."""
         uninterrupted = self._run(tmp_paths, checkpoint_every=2)
