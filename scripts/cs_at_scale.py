@@ -85,7 +85,7 @@ def main(argv=None) -> int:
             avg_test_acc=round(float(result.avg_test_acc), 2),
             distinct_fold_accs=int(len(set(accs.tolist()))),
             fold_acc_sha1=hashlib.sha1(accs.tobytes()).hexdigest()[:16],
-            best_state_leaf_count=n_params,
+            n_params=n_params,
             protocol_wall_s=round(result.wall_seconds, 1),
             protocol_fold_epochs_per_s=round(result.epoch_throughput, 2))
     except Exception as exc:  # noqa: BLE001 — the fault log IS the datum

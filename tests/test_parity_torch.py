@@ -228,18 +228,13 @@ def test_training_trajectory_parity(mode):
 
 
 def _drift_pool(n_train, n_val, C, T, class_sep=1.2, seed=5):
-    """Separable sinusoid-class pool (cf. tests/synthetic.py), split
-    train/val."""
-    rng = np.random.RandomState(seed)
-    n = n_train + n_val
-    t = np.arange(T) / float(T)
-    y = rng.randint(0, 4, size=n)
-    X = rng.randn(n, C, T).astype(np.float32) * 0.5
-    for k in range(4):
-        sig = class_sep * np.sin(2 * np.pi * (4.0 + 4.0 * k) * t)
-        X[y == k] += sig[None, None, :].astype(np.float32)
-    idx = rng.permutation(n)
-    return (X, y.astype(np.int32),
+    """Separable pool from the shared synthetic generator, split train/val."""
+    from synthetic import synthetic_subject
+
+    ds = synthetic_subject(seed, "Train", n_trials=n_train + n_val,
+                           n_channels=C, n_times=T, class_sep=class_sep)
+    idx = np.random.RandomState(seed).permutation(len(ds.X))
+    return (np.asarray(ds.X, np.float32), np.asarray(ds.y, np.int32),
             idx[:n_train].astype(np.int32), idx[n_train:].astype(np.int32))
 
 

@@ -137,6 +137,15 @@ class TestProbe:
 class TestSelectPlatformInfo:
     """Retry + diagnostics semantics of the shared selection helper."""
 
+    @staticmethod
+    def _clear_forced():
+        """Drop any ambient EEGTPU_PLATFORM: the forced-override path would
+        short-circuit before the mocked probe (this project's CPU dress
+        runs export it routinely)."""
+        env = {k: v for k, v in os.environ.items()
+               if k != "EEGTPU_PLATFORM"}
+        return mock.patch.dict(os.environ, env, clear=True)
+
     def _patch_probe(self, outcomes):
         from eegnetreplication_tpu.utils import platform as plat
 
@@ -156,7 +165,7 @@ class TestSelectPlatformInfo:
 
         patcher, calls = self._patch_probe(
             [(None, "probe timed out after 90s"), ("axon", "ok")])
-        with patcher, \
+        with patcher, self._clear_forced(), \
              mock.patch.object(plat, "enable_compilation_cache",
                                lambda: "/tmp/cache"):
             name, info = plat.select_platform_info(retries=2,
@@ -173,7 +182,7 @@ class TestSelectPlatformInfo:
 
         patcher, calls = self._patch_probe(
             [(None, "probe timed out after 90s")])
-        with patcher, \
+        with patcher, self._clear_forced(), \
              mock.patch.object(plat, "force_cpu", lambda: True):
             name, info = plat.select_platform_info(retries=1,
                                                    retry_sleep_s=0.0)
@@ -186,7 +195,7 @@ class TestSelectPlatformInfo:
 
         patcher, calls = self._patch_probe(
             [(None, "probe spawn failed: boom")])
-        with patcher, \
+        with patcher, self._clear_forced(), \
              mock.patch.object(plat, "force_cpu", lambda: True):
             name, info = plat.select_platform_info(retries=3,
                                                    retry_sleep_s=0.0)
