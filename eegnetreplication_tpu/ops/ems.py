@@ -64,12 +64,19 @@ def exponential_moving_standardize(
         init_block_size: seed the EMAs with the mean/var of the first this
             many samples (biased variance, like ``np.var``).
         eps: normalizer epsilon (reference uses 1e-10, ``dataset.py:65``).
-        method: ``"associative"`` (parallel prefix) or ``"scan"`` (sequential
-            ``lax.scan``); both are numerically equivalent formulations.
+        method: ``"associative"`` (parallel prefix), ``"scan"`` (sequential
+            ``lax.scan``) or ``"pallas"`` (single-HBM-pass TPU kernel,
+            :mod:`~eegnetreplication_tpu.ops.ems_pallas` — 2-D ``(C, T)``
+            inputs only); all numerically equivalent formulations.
 
     Returns:
         Standardized array with the same shape and dtype as ``x``.
     """
+    if method == "pallas":
+        from eegnetreplication_tpu.ops.ems_pallas import ems_pallas
+
+        return ems_pallas(x, factor_new=factor_new,
+                          init_block_size=init_block_size, eps=eps)
     x = jnp.asarray(x)
     t_total = x.shape[-1]
     block = min(init_block_size, t_total)

@@ -301,6 +301,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
         _log_epoch_cadence(
             (results.train_losses, results.val_losses,
              results.val_accuracies), 0, epochs, epochs, n_folds)
+        _log_throughput(model, config, n_folds, epochs, wall, train_pad,
+                        val_pad)
         return results, wall
 
     # --- chunked, resumable path ---
@@ -386,6 +388,11 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     )
     if padded != n_folds:
         results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds], results)
+    # Rate over the epochs THIS process trained: a resumed run's wall covers
+    # only the post-resume chunks, so the full epoch count would overstate
+    # throughput (and MFU) by the resumed fraction.
+    _log_throughput(model, config, n_folds, epochs - start_epoch, wall,
+                    train_pad, val_pad)
     if not _keep_snapshot and checkpoint_path is not None:
         if Path(checkpoint_path).exists():
             Path(checkpoint_path).unlink()  # complete: no longer needed
@@ -558,8 +565,6 @@ def within_subject_training(epochs: int | None = None, *,
                    "subjects": list(subjects)},
         _crash_after_chunk=_crash_after_chunk)
 
-    _log_throughput(model, config, len(specs), epochs, wall, train_pad,
-                    val_pad)
     fold_test = np.asarray(results.test_accuracy)  # (n_subjects*4,)
     fold_best_val = np.asarray(results.best_val_acc)
     k = config.kfold_splits
@@ -651,8 +656,6 @@ def cross_subject_training(epochs: int | None = None, *,
                    "subjects": list(subjects)},
         _crash_after_chunk=_crash_after_chunk)
 
-    _log_throughput(model, config, len(specs), epochs, wall, train_pad,
-                    val_pad)
     fold_test = np.asarray(results.test_accuracy)
     min_val_loss = np.asarray(results.min_val_loss)
     r = config.cs_repeats_per_subject

@@ -18,6 +18,7 @@ Run with the ambient chip pin: ``python scripts/pallas_profile.py --out
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -34,6 +35,9 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", required=True)
     parser.add_argument("--batches", default="512,2048,8192,32768")
+    parser.add_argument("--emsLens", default="100000,1000000",
+                        help="EMS recording lengths; shrink for CPU dress "
+                             "runs (the Pallas interpreter is slow).")
     parser.add_argument("--reps", type=int, default=3)
     parser.add_argument("--trace", action="store_true",
                         help="Also attempt a jax.profiler device trace "
@@ -90,7 +94,7 @@ def main(argv=None) -> int:
                     t0 = time.perf_counter()
                     res = np.asarray(fn(pools[i]))  # real D2H bytes
                     walls.append(time.perf_counter() - t0)
-                    digests.add(res.tobytes()[:4096])
+                    digests.add(np.ascontiguousarray(res.ravel()[:1024]).tobytes())
                 if len(digests) < args.reps:
                     row[name] = {"error": "replayed results (stale tunnel)"}
                     continue
@@ -107,6 +111,46 @@ def main(argv=None) -> int:
                         / row["plain"]["trials_per_s"], 3)
         record["batches"][str(batch)] = row
         print(json.dumps({batch: row}), flush=True)
+
+    # --- EMS: the redirected Pallas target (VERDICT r2 item 7) ---
+    # associative (XLA prefix scans, several HBM round-trips) vs the
+    # single-pass Pallas kernel, at the real recording length (~1e5
+    # samples at 250 Hz) and a 10x one.
+    from eegnetreplication_tpu.ops.ems import exponential_moving_standardize
+
+    record["ems"] = {}
+    for t_len in (int(t) for t in args.emsLens.split(",")):
+        rng = np.random.RandomState((salt + t_len) % (2 ** 31))
+        rows = {}
+        for method in ("associative", "scan", "pallas"):
+            try:
+                fn = jax.jit(functools.partial(
+                    exponential_moving_standardize, method=method))
+                jax.block_until_ready(fn(jnp.asarray(
+                    rng.randn(22, t_len), jnp.float32)))  # compile
+                walls, digests = [], set()
+                for _ in range(args.reps):
+                    xr = jnp.asarray(rng.randn(22, t_len), jnp.float32)
+                    t0 = time.perf_counter()
+                    res = np.asarray(fn(xr))
+                    walls.append(time.perf_counter() - t0)
+                    digests.add(np.ascontiguousarray(res.ravel()[:1024]).tobytes())
+                if len(digests) < args.reps:
+                    rows[method] = {"error": "replayed results"}
+                    continue
+                wall = float(np.median(walls))
+                rows[method] = {"wall_s": round(wall, 5),
+                                "msamples_per_s": round(
+                                    22 * t_len / wall / 1e6, 1)}
+            except Exception as exc:  # noqa: BLE001
+                rows[method] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+        if "wall_s" in rows.get("associative", {}):
+            for m in ("scan", "pallas"):
+                if "wall_s" in rows.get(m, {}):
+                    rows[m]["vs_associative"] = round(
+                        rows["associative"]["wall_s"] / rows[m]["wall_s"], 3)
+        record["ems"][str(t_len)] = rows
+        print(json.dumps({f"ems_{t_len}": rows}), flush=True)
 
     if args.trace:
         try:

@@ -100,3 +100,51 @@ class TestEMSProperties:
         out = np.asarray(exponential_moving_standardize(x, init_block_size=1000))
         want = numpy_ems_reference(x, init_block_size=50)
         np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
+
+
+class TestPallasEMS:
+    """The single-HBM-pass Pallas kernel (ops/ems_pallas.py) must be a
+    drop-in numeric twin of the scan formulations (interpreter mode off-TPU,
+    the real Mosaic kernel on chip)."""
+
+    def test_matches_float64_loop(self, signal):
+        got = np.asarray(exponential_moving_standardize(
+            signal, init_block_size=1000, method="pallas"))
+        want = numpy_ems_reference(signal, init_block_size=1000)
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_matches_scan_tightly(self, signal):
+        a = np.asarray(exponential_moving_standardize(signal,
+                                                      method="pallas"))
+        b = np.asarray(exponential_moving_standardize(signal, method="scan"))
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    def test_ragged_tail_and_custom_block(self):
+        """T not a multiple of the time block: the pad must not leak."""
+        from eegnetreplication_tpu.ops.ems_pallas import ems_pallas
+
+        x = np.random.RandomState(7).randn(3, 700).astype(np.float32)
+        got = np.asarray(ems_pallas(x, block_t=256))
+        want = numpy_ems_reference(x, init_block_size=700)
+        assert got.shape == x.shape
+        np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    def test_carry_crosses_blocks(self):
+        """A block boundary must be invisible: one block vs many."""
+        from eegnetreplication_tpu.ops.ems_pallas import ems_pallas
+
+        x = np.random.RandomState(9).randn(2, 1024).astype(np.float32)
+        one = np.asarray(ems_pallas(x, block_t=1024))
+        many = np.asarray(ems_pallas(x, block_t=128))
+        np.testing.assert_allclose(one, many, rtol=1e-4, atol=1e-4)
+
+    def test_rejects_non_2d(self):
+        from eegnetreplication_tpu.ops.ems_pallas import ems_pallas
+
+        with pytest.raises(ValueError, match=r"\(C, T\)"):
+            ems_pallas(np.zeros((2, 3, 4), np.float32))
+
+    def test_probe(self):
+        from eegnetreplication_tpu.ops.ems_pallas import probe_ems_pallas
+
+        assert probe_ems_pallas() is True
