@@ -20,6 +20,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from pathlib import Path
 
+import os
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -98,8 +100,14 @@ def preprocess_recording(rec: GDFRecording,
 
     xj = resample_fft(jnp.asarray(x, jnp.float32), num)
     xj = fir_bandpass(xj, target_sfreq, l_freq, h_freq, kernel=kernel)
+    # EEGTPU_EMS_METHOD switches the formulation (associative | scan |
+    # pallas) without a code change; all three are numerically equivalent
+    # (tests/test_ems.py) — "pallas" is the single-HBM-pass kernel, worth
+    # selecting on-chip per scripts/pallas_profile.py's measurements.
+    ems_method = os.environ.get("EEGTPU_EMS_METHOD", "associative")
     xj = exponential_moving_standardize(
-        xj, factor_new=ems_factor_new, init_block_size=ems_init_block_size)
+        xj, factor_new=ems_factor_new, init_block_size=ems_init_block_size,
+        method=ems_method)
     out = np.asarray(xj, dtype=np.float32)
 
     scale = target_sfreq / rec.sfreq

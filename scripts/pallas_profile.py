@@ -31,6 +31,30 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
+
+def _time_variant(fn, input_fn, reps):
+    """Compile, then time ``reps`` distinct-input calls with the shared
+    freshness guard (identical result digests = the tunnel replayed).
+
+    Returns {"wall_s", ...} or {"error": ...}; used by both sweeps so the
+    staleness guarantees cannot diverge."""
+    import jax
+
+    try:
+        jax.block_until_ready(fn(input_fn(0)))  # compile
+        walls, digests = [], set()
+        for i in range(1, reps + 1):
+            t0 = time.perf_counter()
+            res = np.asarray(fn(input_fn(i)))  # real D2H bytes
+            walls.append(time.perf_counter() - t0)
+            digests.add(np.ascontiguousarray(res.ravel()[:1024]).tobytes())
+        if len(digests) < reps:
+            return {"error": "replayed results (stale tunnel)"}
+        return {"wall_s": round(float(np.median(walls)), 5)}
+    except Exception as exc:  # noqa: BLE001 — record and continue
+        return {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", required=True)
@@ -87,22 +111,9 @@ def main(argv=None) -> int:
                  for _ in range(args.reps + 1)]
         row = {}
         for name, fn in variants.items():
-            try:
-                jax.block_until_ready(fn(pools[0]))  # compile
-                walls, digests = [], set()
-                for i in range(1, args.reps + 1):
-                    t0 = time.perf_counter()
-                    res = np.asarray(fn(pools[i]))  # real D2H bytes
-                    walls.append(time.perf_counter() - t0)
-                    digests.add(np.ascontiguousarray(res.ravel()[:1024]).tobytes())
-                if len(digests) < args.reps:
-                    row[name] = {"error": "replayed results (stale tunnel)"}
-                    continue
-                wall = float(np.median(walls))
-                row[name] = {"wall_s": round(wall, 5),
-                             "trials_per_s": round(batch / wall)}
-            except Exception as exc:  # noqa: BLE001 — record and continue
-                row[name] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+            row[name] = _time_variant(fn, lambda i: pools[i], args.reps)
+            if "wall_s" in row[name]:
+                row[name]["trials_per_s"] = round(batch / row[name]["wall_s"])
         if "trials_per_s" in row.get("plain", {}):
             for name in ("fused", "pallas"):
                 if "trials_per_s" in row.get(name, {}):
@@ -123,27 +134,14 @@ def main(argv=None) -> int:
         rng = np.random.RandomState((salt + t_len) % (2 ** 31))
         rows = {}
         for method in ("associative", "scan", "pallas"):
-            try:
-                fn = jax.jit(functools.partial(
-                    exponential_moving_standardize, method=method))
-                jax.block_until_ready(fn(jnp.asarray(
-                    rng.randn(22, t_len), jnp.float32)))  # compile
-                walls, digests = [], set()
-                for _ in range(args.reps):
-                    xr = jnp.asarray(rng.randn(22, t_len), jnp.float32)
-                    t0 = time.perf_counter()
-                    res = np.asarray(fn(xr))
-                    walls.append(time.perf_counter() - t0)
-                    digests.add(np.ascontiguousarray(res.ravel()[:1024]).tobytes())
-                if len(digests) < args.reps:
-                    rows[method] = {"error": "replayed results"}
-                    continue
-                wall = float(np.median(walls))
-                rows[method] = {"wall_s": round(wall, 5),
-                                "msamples_per_s": round(
-                                    22 * t_len / wall / 1e6, 1)}
-            except Exception as exc:  # noqa: BLE001
-                rows[method] = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+            fn = jax.jit(functools.partial(
+                exponential_moving_standardize, method=method))
+            rows[method] = _time_variant(
+                fn, lambda i: jnp.asarray(rng.randn(22, t_len),
+                                          jnp.float32), args.reps)
+            if "wall_s" in rows[method]:
+                rows[method]["msamples_per_s"] = round(
+                    22 * t_len / rows[method]["wall_s"] / 1e6, 1)
         if "wall_s" in rows.get("associative", {}):
             for m in ("scan", "pallas"):
                 if "wall_s" in rows.get(m, {}):

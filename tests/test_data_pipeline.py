@@ -131,6 +131,27 @@ class TestPreprocessRecording(unittest.TestCase):
         tail = out.data[:, -500:]
         self.assertLess(np.abs(tail.mean()), 0.5)
 
+    def test_ems_method_env_knob(self):
+        """EEGTPU_EMS_METHOD routes the EMS formulation: the pallas kernel
+        must agree with the default, and an unknown name must surface."""
+        import os
+        from unittest import mock
+
+        rng = np.random.RandomState(4)
+        rec = GDFRecording(signals=rng.randn(25, 3000).astype(np.float32),
+                           sfreq=250.0,
+                           labels=[f"c{i}" for i in range(25)],
+                           event_pos=np.array([500]),
+                           event_typ=np.array([769]))
+        default = preprocess_recording(rec)
+        with mock.patch.dict(os.environ, {"EEGTPU_EMS_METHOD": "pallas"}):
+            pallas = preprocess_recording(rec)
+        np.testing.assert_allclose(pallas.data, default.data,
+                                   rtol=1e-3, atol=1e-3)
+        with mock.patch.dict(os.environ, {"EEGTPU_EMS_METHOD": "bogus"}), \
+             self.assertRaisesRegex(ValueError, "Unknown EMS method"):
+            preprocess_recording(rec)
+
     def test_save_load_roundtrip(self):
         pr = ProcessedRecording(
             data=np.ones((22, 100), np.float32), sfreq=128.0,
