@@ -463,6 +463,14 @@ def _add_flops_fields(record: dict, timeout_s: float = 420.0) -> None:
     if on_accel:
         record["mfu_peak"] = peak_label
 
+    def emit(prefix: str, rate, flops_per_unit: float) -> None:
+        if not rate:
+            return
+        flops_per_s = rate * flops_per_unit
+        record[f"{prefix}_gflops_per_s"] = round(flops_per_s / 1e9, 1)
+        if on_accel:
+            record[f"{prefix}_mfu_pct"] = round(100 * flops_per_s / peak, 4)
+
     fe = counts.get("fold_epoch_flops")
     if fe:
         record["fold_epoch_gflops"] = round(fe / 1e9, 3)
@@ -470,39 +478,25 @@ def _add_flops_fields(record: dict, timeout_s: float = 420.0) -> None:
                                  ("fold36_epochs_per_s", "fold36"),
                                  ("mxu_default_fold_epochs_per_s",
                                   "mxu_default")):
-            rate = record.get(rate_key)
-            if not rate:
-                continue
-            flops_per_s = rate * fe
-            record[f"{prefix}_gflops_per_s"] = round(flops_per_s / 1e9, 1)
-            if on_accel:
-                record[f"{prefix}_mfu_pct"] = round(
-                    100 * flops_per_s / peak, 4)
+            emit(prefix, record.get(rate_key), fe)
     ev = counts.get("eval_forward_flops_pool")
     if ev:
-        per_trial = ev / N_POOL
-        for key, prefix in (("eval_fused_trials_per_s", "eval_fused"),
-                            ("eval_pallas_trials_per_s", "eval_pallas")):
-            rate = record.get(key)
-            if not rate:
-                continue
-            flops_per_s = rate * per_trial
-            record[f"{prefix}_gflops_per_s"] = round(flops_per_s / 1e9, 1)
-            if on_accel:
-                record[f"{prefix}_mfu_pct"] = round(
-                    100 * flops_per_s / peak, 4)
+        for rate_key, prefix in (("eval_fused_trials_per_s", "eval_fused"),
+                                 ("eval_pallas_trials_per_s",
+                                  "eval_pallas")):
+            emit(prefix, record.get(rate_key), ev / N_POOL)
 
 
-def _compile_cache_state() -> tuple[str, str | None]:
-    """("off"|"cold"|"warm:<n>", cache_dir) before the headline compile."""
+def _compile_cache_state() -> tuple[str, str | None, int]:
+    """("off"|"cold"|"warm:<n>", cache_dir, entry count) pre-compile."""
     cache_dir = PROBE_INFO.get("cache_dir")
     if not cache_dir:
-        return "off", None
+        return "off", None, 0
     try:
         entries = len(os.listdir(cache_dir))
     except OSError:
-        return "off", None
-    return (f"warm:{entries}" if entries else "cold"), cache_dir
+        return "off", None, 0
+    return (f"warm:{entries}" if entries else "cold"), cache_dir, entries
 
 
 def _read_last_onchip() -> dict | None:
@@ -580,7 +574,7 @@ def main() -> None:
         last = _read_last_onchip()
         if last:
             record["last_onchip"] = last
-    cache_state, _cache_dir = _compile_cache_state()
+    cache_state, _cache_dir, _cache_entries = _compile_cache_state()
     record["compile_cache"] = cache_state
     try:
         deadline_s = float(os.environ.get("BENCH_DEADLINE_S", "1500"))
@@ -602,9 +596,7 @@ def main() -> None:
         if _cache_dir:
             try:  # how many executables the headline compile added
                 record["compile_cache_new_entries"] = (
-                    len(os.listdir(_cache_dir))
-                    - int(cache_state.split(":")[1])
-                    if ":" in cache_state else len(os.listdir(_cache_dir)))
+                    len(os.listdir(_cache_dir)) - _cache_entries)
             except OSError:
                 pass
         baseline = bench_torch_reference_style(x, y, folds)

@@ -432,10 +432,8 @@ class App(tk.Tk):
         stats = ttk.LabelFrame(inner, text="Overall Results", padding=10)
         stats.pack(fill=tk.X, padx=10, pady=5)
         for i, line in enumerate(report_overview_lines(report)):
-            font = ("Arial", 12, "bold") if i == 0 else None
-            label = (ttk.Label(stats, text=line, font=font) if font
-                     else ttk.Label(stats, text=line))
-            label.pack(anchor=tk.W)
+            kw = {"font": ("Arial", 12, "bold")} if i == 0 else {}
+            ttk.Label(stats, text=line, **kw).pack(anchor=tk.W)
 
         table = ttk.LabelFrame(inner, text="Per-Subject Results", padding=10)
         table.pack(fill=tk.BOTH, expand=True, padx=10, pady=5)

@@ -314,13 +314,21 @@ class TestFoldBatching:
             epochs=4, config=CFG, loader=loader, subjects=(1, 2),
             paths=tmp_paths, seed=0, save_models=False, **kw)
 
-    def test_batched_matches_single_program(self, tmp_paths):
+    def test_batched_matches_single_program(self, tmp_paths, caplog):
+        import logging
+
         import jax
 
         whole = self._run(tmp_paths)                 # 8 folds, one program
-        batched = self._run(tmp_paths, fold_batch=3)  # groups of 3+3+2
+        with caplog.at_level(logging.INFO):
+            batched = self._run(tmp_paths, fold_batch=3)  # groups of 3+3+2
         np.testing.assert_array_equal(batched.fold_test_acc,
                                       whole.fold_test_acc)
+        # grouped runs log per-group lines AND a protocol-level aggregate
+        lines = [r.getMessage() for r in caplog.records
+                 if r.getMessage().startswith("Throughput: ")]
+        assert any("groups" in line for line in lines), lines
+        assert batched.fold_epochs_trained == len(batched.fold_test_acc) * 4
         for a, b in zip(batched.best_states, whole.best_states):
             for la, lb in zip(jax.tree_util.tree_leaves(a),
                               jax.tree_util.tree_leaves(b)):
