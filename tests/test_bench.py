@@ -1,0 +1,157 @@
+"""Unit tests for the driver-gate benchmark's plumbing (bench.py).
+
+The heavy stages (trainer compiles, torch baseline) are exercised by the
+BENCH_SMOKE dress runs; these pin the cheap-but-load-bearing pieces that
+decide whether a round's artifact is valid: the replay guard, the probe
+retry knob, the compile-cache state string, and the last-on-chip
+persistence a CPU-fallback line embeds.
+"""
+
+import json
+import os
+import sys
+from pathlib import Path
+from unittest import mock
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+# bench selects its platform at import; force CPU unconditionally so the
+# import can never probe (or hang on) the tunneled accelerator in CI —
+# setdefault would be a no-op under an exported EEGTPU_PLATFORM=tpu.
+os.environ["EEGTPU_PLATFORM"] = "cpu"
+import bench  # noqa: E402
+
+
+class TestAssertFresh:
+    def test_distinct_digests_pass(self):
+        bench._assert_fresh([b"a", b"b", b"c"], "reps")
+
+    def test_replayed_digests_raise(self):
+        with pytest.raises(RuntimeError, match="replayed identical"):
+            bench._assert_fresh([b"a", b"b", b"a"], "reps")
+
+
+class TestProbeRetries:
+    def test_default_is_two(self):
+        with mock.patch.dict(os.environ, {}, clear=False) as env:
+            env.pop("BENCH_PROBE_RETRIES", None)
+            env.pop("BENCH_SMOKE", None)
+            assert bench._probe_retries() == 2
+
+    def test_smoke_defaults_to_zero(self):
+        with mock.patch.dict(os.environ, {"BENCH_SMOKE": "1"}):
+            assert bench._probe_retries() == 0
+
+    def test_env_override_and_garbage(self):
+        with mock.patch.dict(os.environ, {"BENCH_PROBE_RETRIES": "5"}):
+            assert bench._probe_retries() == 5
+        with mock.patch.dict(os.environ, {"BENCH_PROBE_RETRIES": "-3"}):
+            assert bench._probe_retries() == 0
+        # garbage falls back to the non-smoke default; BENCH_SMOKE must be
+        # cleared or the fallback legitimately becomes 0
+        with mock.patch.dict(os.environ,
+                             {"BENCH_PROBE_RETRIES": "nope"}) as env:
+            env.pop("BENCH_SMOKE", None)
+            assert bench._probe_retries() == 2
+
+
+class TestCompileCacheState:
+    def test_off_without_cache_dir(self):
+        with mock.patch.dict(bench.PROBE_INFO, {"cache_dir": None}):
+            assert bench._compile_cache_state() == ("off", None, 0)
+
+    def test_cold_and_warm(self, tmp_path):
+        with mock.patch.dict(bench.PROBE_INFO, {"cache_dir": str(tmp_path)}):
+            assert bench._compile_cache_state() == ("cold", str(tmp_path), 0)
+            (tmp_path / "exe1").write_bytes(b"x")
+            (tmp_path / "exe2").write_bytes(b"y")
+            state, path, entries = bench._compile_cache_state()
+        assert state == "warm:2" and entries == 2
+
+    def test_unreadable_dir_is_off(self, tmp_path):
+        gone = tmp_path / "missing"
+        with mock.patch.dict(bench.PROBE_INFO, {"cache_dir": str(gone)}):
+            assert bench._compile_cache_state() == ("off", None, 0)
+
+
+class TestLastOnchip:
+    def test_roundtrip(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "_ONCHIP_LAST_PATH",
+                            str(tmp_path / "last.json"))
+        record = {"value": 49.9, "unit": "fold-epochs/s",
+                  "vs_baseline": 22.4, "platform": "axon",
+                  "compile_s": 65.0, "train_mfu_pct": 0.07}
+        bench._write_last_onchip(record)
+        read = bench._read_last_onchip()
+        assert read["value"] == 49.9 and read["vs_baseline"] == 22.4
+        assert "utc" in read
+
+    def test_missing_file_is_none(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "_ONCHIP_LAST_PATH",
+                            str(tmp_path / "absent.json"))
+        assert bench._read_last_onchip() is None
+
+    def test_corrupt_file_is_none(self, tmp_path, monkeypatch):
+        p = tmp_path / "bad.json"
+        p.write_text("not json{")
+        monkeypatch.setattr(bench, "_ONCHIP_LAST_PATH", str(p))
+        assert bench._read_last_onchip() is None
+
+
+class TestFlopsFields:
+    def test_fields_derive_from_rates(self):
+        counts = {"fold_epoch_flops": 2.864e9,
+                  "eval_forward_flops_pool": 1.86e9}
+        record = {"value": 100.0, "fold36_epochs_per_s": 50.0,
+                  "eval_fused_trials_per_s": 8000}
+        with mock.patch.object(bench, "_flops_accounting",
+                               lambda timeout_s=0: counts):
+            bench._add_flops_fields(record)
+        assert record["fold_epoch_gflops"] == 2.864
+        assert record["train_gflops_per_s"] == pytest.approx(286.4)
+        assert record["fold36_gflops_per_s"] == pytest.approx(143.2)
+        # eval rate is per trial: 8000 * (1.86e9 / 576 trials)
+        assert record["eval_fused_gflops_per_s"] == pytest.approx(
+            8000 * 1.86e9 / bench.N_POOL / 1e9, abs=0.1)
+        # CPU platform: FLOP/s only, no MFU fields
+        assert not any(k.endswith("_mfu_pct") for k in record)
+
+    def test_unavailable_counts_marked(self):
+        record = {"value": 1.0}
+        with mock.patch.object(bench, "_flops_accounting",
+                               lambda timeout_s=0: {}):
+            bench._add_flops_fields(record)
+        assert record["flops_error"] == "cost analysis unavailable"
+
+
+class TestJsonLineContract:
+    def test_main_emits_exactly_one_valid_line(self, capsys):
+        """Drive the REAL main() with the heavy stages mocked: exactly one
+        JSON line on stdout carrying the driver-contract keys plus the
+        round-3 diagnostics, and no error field."""
+        with mock.patch.object(bench, "bench_tpu",
+                               lambda x, y, f: (12.5, 3.0)), \
+             mock.patch.object(bench, "bench_torch_reference_style",
+                               lambda x, y, f: 2.5), \
+             mock.patch.object(bench, "bench_eval_kernels",
+                               lambda: {"eval_fused_trials_per_s": 7000}), \
+             mock.patch.object(bench, "bench_fold_scale",
+                               lambda **k: {"fold36_epochs_per_s": 9.0}), \
+             mock.patch.object(bench, "bench_precision_modes",
+                               lambda x, y, f: {}), \
+             mock.patch.object(bench, "_add_flops_fields",
+                               lambda record, **k: None):
+            bench.main()
+        lines = capsys.readouterr().out.strip().splitlines()
+        assert len(lines) == 1, lines
+        rec = json.loads(lines[0])
+        assert rec["value"] == 12.5
+        assert rec["vs_baseline"] == pytest.approx(5.0)
+        assert rec["compile_s"] == 3.0
+        assert {"metric", "value", "unit", "vs_baseline", "platform",
+                "probe_result", "probe_attempts",
+                "compile_cache"} <= set(rec)
+        assert "error" not in rec
