@@ -96,6 +96,35 @@ def predict_trials(model, params, batch_stats, X: np.ndarray,
     return np.concatenate(out)[:n]
 
 
+def _log_inference_throughput(model, n_trials: int, wall: float,
+                              batch_size: int) -> None:
+    """Trials/s plus achieved GFLOP/s for the inference pass (cf. the
+    training-side line in ``training/protocols.py::_log_throughput``;
+    best-effort — the XLA cost model may be unavailable).  The wall
+    includes any first-batch compile; repeated CLI runs amortize it via
+    the persistent cache."""
+    rate = n_trials / max(wall, 1e-9)
+    extra = ""
+    try:
+        import math
+
+        from eegnetreplication_tpu.utils.flops import eval_forward_flops
+
+        batch = max(1, min(batch_size, n_trials))
+        batch_flops = eval_forward_flops(
+            model, batch, (model.n_channels, model.n_times))
+        if batch_flops:
+            # Hardware rate: the padded final batch runs at full cost on
+            # the device (same convention as fold_epoch_flops), so count
+            # executed batches, not useful trials.
+            executed = math.ceil(max(n_trials, 1) / batch) * batch_flops
+            extra = f", {executed / max(wall, 1e-9) / 1e9:.2f} GFLOP/s"
+    except Exception:  # noqa: BLE001 — accounting must never fail a run
+        pass
+    logger.info("Inference: %.0f trials/s (%d trials in %.2fs)%s",
+                rate, n_trials, wall, extra)
+
+
 def main(argv=None) -> int:
     from eegnetreplication_tpu.utils.platform import select_platform
 
@@ -125,8 +154,13 @@ def main(argv=None) -> int:
 
         ds = load_subject_dataset(subject=args.subject, mode=args.mode)
 
+    import time
+
+    t0 = time.perf_counter()
     pred = predict_trials(model, params, batch_stats,
                           ds.X.astype(np.float32), args.batchSize)
+    wall = time.perf_counter() - t0
+    _log_inference_throughput(model, len(pred), wall, args.batchSize)
     counts = np.bincount(pred, minlength=len(CLASS_NAMES))
     for k, name in enumerate(CLASS_NAMES):
         logger.info("class %d (%s): %d trials", k, name, counts[k])

@@ -120,3 +120,19 @@ class TestOrbaxCheckpointLoading:
         np.testing.assert_array_equal(
             predict_trials(model, params, bs, x),
             predict_trials(loaded_model, lp, lbs, x))
+
+
+class TestInferenceThroughputLine:
+    def test_logged_with_gflops(self, small_model, caplog):
+        import logging
+
+        from eegnetreplication_tpu.predict import _log_inference_throughput
+
+        model, _, _ = small_model
+        with caplog.at_level(logging.INFO):
+            _log_inference_throughput(model, n_trials=100, wall=0.5,
+                                      batch_size=16)
+        lines = [r.getMessage() for r in caplog.records
+                 if r.getMessage().startswith("Inference: ")]
+        assert lines and "trials/s" in lines[0]
+        assert "GFLOP/s" in lines[0]  # cost model available on CPU
