@@ -271,6 +271,51 @@ class TestHeadlessUILogic(unittest.TestCase):
         self.assertEqual(avg_lines[0].get_ydata()[0], 70.0)
 
 
+class TestHeadlessUIOnRealReport(unittest.TestCase):
+    """The headless formatters against a REAL protocol-generated report
+    (not a hand-built sample): the report schema and the GUI's rendering
+    layer must agree about keys end to end."""
+
+    def test_generated_ws_report_renders(self):
+        from synthetic import make_loader
+
+        from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
+        from eegnetreplication_tpu.training.protocols import (
+            within_subject_training,
+        )
+        from eegnetreplication_tpu.training.report import generate_ws_report
+        from eegnetreplication_tpu.ui import (
+            accuracy_chart_figure,
+            get_report,
+            report_overview_lines,
+            report_table_rows,
+        )
+
+        with tempfile.TemporaryDirectory() as td:
+            paths = Paths.from_root(Path(td))
+            loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+            result = within_subject_training(
+                epochs=2, config=DEFAULT_TRAINING.replace(batch_size=16),
+                loader=loader, subjects=(1, 2), paths=paths, seed=0,
+                save_models=False)
+            generate_ws_report(result.per_subject_test_acc,
+                               result.avg_test_acc, result.best_states,
+                               epochs=2,
+                               config=DEFAULT_TRAINING.replace(batch_size=16),
+                               paths=paths)
+            report = get_report(paths)["within_subject"]
+            lines = report_overview_lines(report)
+            self.assertTrue(lines[0].startswith("Average Test Accuracy: "))
+            rows = report_table_rows(report, "subject_id")
+            self.assertEqual(len(rows), 2)
+            for row in rows:  # accuracies render as parseable percentages
+                acc = float(row[1].rstrip("%"))
+                self.assertTrue(0.0 <= acc <= 100.0, row)
+            fig = accuracy_chart_figure(report["per_subject_results"],
+                                        "Within-Subject", "subject_id")
+            self.assertEqual(len(fig.axes[0].patches), 2)
+
+
 class TestModelNameSync(unittest.TestCase):
     def test_ui_model_names_match_registry(self):
         """ui.MODEL_NAMES is a names-only copy (the GUI must not import
