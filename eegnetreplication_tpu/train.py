@@ -73,9 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Train at most N folds per compiled program, "
                              "running groups sequentially (bit-identical). "
                              "For protocols whose fold count exceeds what "
-                             "the device takes in one program (e.g. the "
-                             "90-fold cross-subject run on a small chip). "
-                             "Ignored under a device mesh.")
+                             "the device takes in one program. Default: "
+                             "auto — Cross-Subject runs on an accelerator "
+                             "use 15-fold groups (larger CS programs fault "
+                             "a v5e chip; measured limit). 0 forces one "
+                             "fused program. Ignored under a device mesh.")
     parser.add_argument("--checkpointEvery", type=int, default=None,
                         help="Snapshot the run every N epochs; a crashed "
                              "run restarts from the last snapshot with "

@@ -117,6 +117,21 @@ def save_run_snapshot(path: str | Path, carry: Any,
     return path
 
 
+def read_snapshot_signature(path: str | Path) -> dict | None:
+    """Read ONLY the stored run signature from a snapshot, or ``None`` if
+    the file is unreadable / carries none (legacy).  Lets callers decide
+    how to treat a mismatched snapshot (e.g. a fold-group snapshot from a
+    different batching is retrained fresh, not a hard error) without
+    paying a full carry load."""
+    try:
+        with np.load(Path(path), allow_pickle=False) as data:
+            if "__signature__" not in data.files:
+                return None
+            return json.loads(bytes(data["__signature__"]).decode())
+    except Exception:  # noqa: BLE001 — corrupt/foreign file = no signature
+        return None
+
+
 def load_run_snapshot(path: str | Path, carry_template: Any,
                       signature: dict) -> tuple[Any, dict, int]:
     """Restore a run snapshot; returns ``(carry, metrics, epochs_done)``.

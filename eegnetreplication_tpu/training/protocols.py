@@ -68,6 +68,18 @@ LoadFn = Callable[[int, str], BCICI2ADataset]
 AUTO_CHUNK_THRESHOLD = 100
 AUTO_CHUNK_EPOCHS = 50
 
+# Auto fold-batching for the cross-subject protocol on accelerator
+# backends.  Measured on the tunneled TPU v5e (2026-07-31): 90-, 45- and
+# 30-fold CS programs all fault the device (``UNAVAILABLE: TPU device
+# error`` ~200-260 s in, during/after the group's first compile) while
+# 15-fold groups run the full 90x500 protocol to completion.  The CS
+# per-fold program is ~6x the within-subject one (45 train batches per
+# epoch vs 7), which is why WS runs 36 folds comfortably in one program
+# and CS cannot.  ``fold_batch=None`` therefore defaults to this group
+# size for CS runs on a non-CPU backend; pass ``fold_batch=0``
+# (``--maxFoldsPerProgram 0``) to force one fused program.
+CS_ACCEL_FOLD_BATCH = 15
+
 
 def _auto_chunk_size(epochs: int) -> int:
     """Segment length for auto-chunked runs: a divisor of ``epochs`` near
@@ -100,6 +112,10 @@ class ProtocolResult:
     # when a --resume run only executed the post-crash remainder.  None
     # (untracked) falls back to the full product.
     fold_epochs_trained: float | None = None
+    # Folds per compiled program this run ACTUALLY used (None = one fused
+    # program): the CS auto resolution means the caller's argument is not
+    # necessarily what ran — measurement artifacts should record this.
+    fold_batch: int | None = None
 
     @property
     def epoch_throughput(self) -> float:
@@ -211,8 +227,10 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     keys = (_keys if _keys is not None else
             jax.random.split(jax.random.PRNGKey(seed + 1), n_folds))
 
-    if fold_batch is not None and fold_batch <= 0:
-        raise ValueError(f"fold_batch must be positive, got {fold_batch}")
+    if fold_batch is not None and fold_batch < 0:
+        raise ValueError(f"fold_batch must be >= 0, got {fold_batch}")
+    if fold_batch == 0:  # explicit opt-out: one fused program (mirrors
+        fold_batch = None  # checkpoint_every=0)
     if fold_batch and mesh is not None:
         logger.warning(
             "fold_batch is ignored under a device mesh: shard the fold "
@@ -220,20 +238,57 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
         fold_batch = None
     if fold_batch and n_folds > fold_batch:
         group_results, wall, fold_epochs = [], 0.0, 0.0
-        group_paths = []
+        n_groups = -(-n_folds // fold_batch)
+        if (resume and checkpoint_path is not None
+                and Path(checkpoint_path).exists()
+                and not any(Path(f"{checkpoint_path}.g{g}").exists()
+                            for g in range(n_groups))):
+            # e.g. a run crashed unbatched, then the retry resolves to
+            # grouped training (auto fold-batching): the ungrouped snapshot
+            # cannot seed group programs — say so instead of silently
+            # restarting from epoch 0.
+            logger.warning(
+                "Resume: found an ungrouped run snapshot at %s but this run "
+                "trains in %d-fold groups and no group snapshots exist — "
+                "training restarts from epoch 0. (fold_batch=0 / "
+                "--maxFoldsPerProgram 0 would resume that snapshot as one "
+                "fused program, but only on a backend that can run it — "
+                "large cross-subject programs fault the v5e, which is why "
+                "grouping engaged.)", checkpoint_path, fold_batch)
         for gi, lo in enumerate(range(0, n_folds, fold_batch)):
             hi = min(lo + fold_batch, n_folds)
             logger.info("Training fold group %d: folds %d-%d of %d",
                         gi, lo, hi - 1, n_folds)
             gpath = (None if checkpoint_path is None
                      else Path(f"{checkpoint_path}.g{gi}"))
-            group_paths.append(gpath)
             gsig = dict(signature or {}, fold_group=gi,
                         fold_range=[lo, hi])
             # A group the crashed run never reached has no snapshot; that
             # is the expected state of a batched resume, not a user error —
             # train it fresh without the missing-snapshot warning.
             gresume = bool(resume and gpath is not None and gpath.exists())
+            if gresume:
+                stored = ckpt_lib.read_snapshot_signature(gpath)
+                if stored is None:
+                    # Exists but unreadable/signature-less (truncated copy,
+                    # disk error, legacy format): not resumable — retrain
+                    # fresh rather than crash in the loader.
+                    logger.warning(
+                        "Resume: snapshot %s is unreadable — training "
+                        "group %d fresh", gpath, gi)
+                    gresume = False
+                elif (stored.get("fold_range") != [lo, hi]
+                      or stored.get("fold_group") != gi):
+                    # Same filename, different batching (e.g. the run that
+                    # crashed used another fold_batch): the carry cannot
+                    # seed this group — retrain it rather than hard-fail
+                    # on the signature check.
+                    logger.warning(
+                        "Resume: snapshot %s is from a different fold "
+                        "grouping (folds %s, this group trains %s) — "
+                        "training group %d fresh",
+                        gpath, stored.get("fold_range"), [lo, hi], gi)
+                    gresume = False
             r, w, fe = _run_folds(
                 model, specs[lo:hi], pool_x, pool_y, config=config,
                 epochs=epochs, seed=seed, mesh=None,
@@ -247,9 +302,12 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             fold_epochs += fe
         results = jax.tree_util.tree_map(
             lambda *leaves: jnp.concatenate(leaves, axis=0), *group_results)
-        for gpath in group_paths:  # all groups done: snapshots expendable
-            if gpath is not None and gpath.exists():
-                gpath.unlink()
+        # All groups done: every snapshot at this path — this run's group
+        # files, stale .g* from an earlier batching with MORE groups, and
+        # any ungrouped snapshot from a crashed unbatched run — is
+        # expendable.
+        if not _keep_snapshot:
+            _clear_run_snapshots(checkpoint_path)
         # Aggregate line over all groups (each inner call logged its own).
         _log_throughput(model, config, fold_epochs, wall, train_pad,
                         val_pad,
@@ -408,15 +466,25 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     trained = n_folds * (epochs - start_epoch)
     _log_throughput(model, config, trained, wall, train_pad, val_pad,
                     f"{n_folds} folds x {epochs - start_epoch} epochs")
-    if not _keep_snapshot and checkpoint_path is not None:
-        if Path(checkpoint_path).exists():
-            Path(checkpoint_path).unlink()  # complete: no longer needed
-        # Also clear stale group snapshots from an earlier fold_batch run
-        # of this protocol that crashed and was then completed ungrouped.
-        cp = Path(checkpoint_path)
-        for stale in cp.parent.glob(cp.name + ".g*"):
-            stale.unlink()
+    if not _keep_snapshot:
+        # Complete: the run snapshot AND stale group snapshots from an
+        # earlier fold_batch run of this protocol are no longer needed.
+        _clear_run_snapshots(checkpoint_path)
     return results, wall, float(trained)
+
+
+def _clear_run_snapshots(checkpoint_path) -> None:
+    """Delete a completed protocol's run snapshot and any ``.g*`` group
+    snapshots sharing its path (stale leftovers from a differently-batched
+    crashed run included).  Shared by the grouped and ungrouped completion
+    paths so their cleanup policy cannot diverge."""
+    if checkpoint_path is None:
+        return
+    cp = Path(checkpoint_path)
+    if cp.exists():
+        cp.unlink()
+    for stale in cp.parent.glob(cp.name + ".g*"):
+        stale.unlink()
 
 
 def _log_epoch_cadence(per_epoch, lo: int, hi: int, total_epochs: int,
@@ -614,7 +682,33 @@ def within_subject_training(epochs: int | None = None, *,
     logger.info("Overall Average Test Accuracy across all subjects: %.2f%%", avg)
     return ProtocolResult(per_subject_test_acc, avg, best_states, fold_test,
                           wall, epochs, tuple(subjects),
-                          fold_epochs_trained=fold_epochs_trained)
+                          fold_epochs_trained=fold_epochs_trained,
+                          fold_batch=(None if mesh is not None
+                                      else (fold_batch or None)))
+
+
+def _cs_auto_fold_batch(n_folds: int, mesh, fold_batch: int | None):
+    """Resolve the cross-subject ``fold_batch`` default.
+
+    ``0`` is the explicit opt-out (one fused program, mirroring
+    ``checkpoint_every=0``); an explicit positive value passes through; and
+    ``None`` on a non-CPU backend defaults to :data:`CS_ACCEL_FOLD_BATCH`-
+    fold groups when the protocol exceeds it (the measured device limit —
+    see the constant's comment).  Meshes shard the fold axis instead.
+    """
+    if fold_batch == 0:
+        return None
+    if fold_batch is not None:
+        return fold_batch
+    if mesh is None and n_folds > CS_ACCEL_FOLD_BATCH:
+        if jax.default_backend() != "cpu":
+            logger.info(
+                "Auto fold batching: %d folds per compiled program on %s "
+                "(larger CS programs fault the device; --maxFoldsPerProgram "
+                "overrides, 0 forces one program)",
+                CS_ACCEL_FOLD_BATCH, jax.default_backend())
+            return CS_ACCEL_FOLD_BATCH
+    return None
 
 
 def cross_subject_training(epochs: int | None = None, *,
@@ -673,6 +767,7 @@ def cross_subject_training(epochs: int | None = None, *,
     specs = [make_fold_spec(tr, va, te, train_pad=train_pad, val_pad=val_pad,
                             test_pad=test_pad) for tr, va, te in raw_folds]
 
+    fold_batch = _cs_auto_fold_batch(len(specs), mesh, fold_batch)
     logger.info("Training %d cross-subject folds for %d epochs, fused+vmapped",
                 len(specs), epochs)
     results, wall, fold_epochs_trained = _run_folds(
@@ -709,4 +804,6 @@ def cross_subject_training(epochs: int | None = None, *,
 
     return ProtocolResult(per_subject_test_acc, avg_all, [best_state],
                           fold_test, wall, epochs, tuple(subjects),
-                          fold_epochs_trained=fold_epochs_trained)
+                          fold_epochs_trained=fold_epochs_trained,
+                          fold_batch=(None if mesh is not None
+                                      else (fold_batch or None)))

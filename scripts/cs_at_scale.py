@@ -2,9 +2,11 @@
 
 The reference's CS protocol is 9 subjects x 10 folds x ... = 90 training
 runs of 500 epochs (``train.py:151-291``); round 2 never completed it on
-the tunneled chip — a single 90-fold fused program faulted the device,
-and the ``fold_batch=45`` mitigation shipped unmeasured.  This drives
-``cross_subject_training(fold_batch=45, checkpoint_every=50)`` end to end
+the tunneled chip — a single 90-fold fused program faulted the device.
+Measured 2026-07-31: 45- and 30-fold groups fault it too; 15-fold groups
+(now the protocol's accelerator auto default, CS_ACCEL_FOLD_BATCH)
+complete.  This drives
+``cross_subject_training(fold_batch=<auto>, checkpoint_every=50)`` end to end
 on synthetic full-shape data, with freshness evidence (the per-fold val
 trajectories are materialized and digest-checked to be non-identical
 across folds — a replayed/stale buffer run cannot produce 90 distinct
@@ -35,7 +37,11 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--out", required=True)
     parser.add_argument("--epochs", type=int, default=500)
-    parser.add_argument("--foldBatch", type=int, default=45)
+    parser.add_argument("--foldBatch", type=int, default=None,
+                        help="Folds per compiled program (default: the "
+                             "protocol's auto resolution — 15-fold groups "
+                             "on an accelerator, the measured v5e limit; "
+                             "45 and 30 fault the device).")
     parser.add_argument("--checkpointEvery", type=int, default=50)
     parser.add_argument("--trials", type=int, default=288,
                         help="Trials per session (competition: 288).")
@@ -57,7 +63,7 @@ def main(argv=None) -> int:
     loader = make_loader(n_trials=args.trials, n_channels=22, n_times=257,
                          class_sep=1.0)
     record = {"platform": platform, "epochs": args.epochs,
-              "fold_batch": args.foldBatch,
+              "fold_batch_arg": args.foldBatch,
               "checkpoint_every": args.checkpointEvery,
               "trials_per_session": args.trials,
               "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
@@ -81,6 +87,9 @@ def main(argv=None) -> int:
                        jax.tree_util.tree_leaves(result.best_states[0]))
         record.update(
             ok=True, wall_s=round(wall, 1), n_folds=n_folds,
+            # What batching ACTUALLY ran (the protocol records its own
+            # resolution; None = one fused program).
+            fold_batch=result.fold_batch if result.fold_batch else 0,
             fold_epochs_per_s=round(n_folds * args.epochs / wall, 2),
             avg_test_acc=round(float(result.avg_test_acc), 2),
             distinct_fold_accs=int(len(set(accs.tolist()))),

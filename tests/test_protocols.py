@@ -351,7 +351,100 @@ class TestFoldBatching:
 
     def test_invalid_fold_batch_rejected(self, tmp_paths):
         with pytest.raises(ValueError, match="fold_batch"):
-            self._run(tmp_paths, fold_batch=0)
+            self._run(tmp_paths, fold_batch=-1)
+
+    def test_resume_across_group_size_change(self, tmp_paths, caplog):
+        """A group snapshot from a DIFFERENT fold_batch (e.g. the old
+        45-fold default crashed, the retry auto-resolves to 15) must retrain
+        that group fresh with a warning — not hard-fail the signature
+        check — and completion must clear the foreign .g* files."""
+        import logging
+
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, fold_batch=4, checkpoint_every=2,
+                      _crash_after_chunk=1)
+        assert (tmp_paths.models
+                / "within_subject_eegnet.run.npz.g0").exists()
+        with caplog.at_level(logging.WARNING):
+            resumed = self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                                resume=True)
+        assert any("different fold grouping" in r.getMessage()
+                   for r in caplog.records)
+        assert not list(tmp_paths.models.glob("*.run.npz.g*"))
+        uninterrupted = self._run(tmp_paths, fold_batch=3, checkpoint_every=2)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+
+    def test_resume_with_corrupt_group_snapshot(self, tmp_paths, caplog):
+        """An existing-but-unreadable group snapshot degrades to a fresh
+        retrain with a warning, not a loader crash."""
+        import logging
+
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                      _crash_after_chunk=1)
+        g0 = tmp_paths.models / "within_subject_eegnet.run.npz.g0"
+        assert g0.exists()
+        g0.write_bytes(b"not a zip archive")
+        with caplog.at_level(logging.WARNING):
+            resumed = self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                                resume=True)
+        assert any("unreadable" in r.getMessage() for r in caplog.records)
+        whole = self._run(tmp_paths, fold_batch=3, checkpoint_every=2)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      whole.fold_test_acc)
+
+    def test_resume_across_batching_warns_and_cleans(self, tmp_paths, caplog):
+        """A crashed UNBATCHED run's snapshot cannot seed a grouped retry
+        (e.g. auto fold-batching kicked in on the rerun): the run must say
+        it is restarting, and completion must clear the stale snapshot."""
+        import logging
+
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        snap = tmp_paths.models / "within_subject_eegnet.run.npz"
+        assert snap.exists()
+        with caplog.at_level(logging.WARNING):
+            resumed = self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                                resume=True)
+        assert any("ungrouped run snapshot" in r.getMessage()
+                   for r in caplog.records)
+        assert not snap.exists()  # grouped completion clears the stale file
+        uninterrupted = self._run(tmp_paths, fold_batch=3, checkpoint_every=2)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+
+    def test_zero_opts_out_of_batching(self, tmp_paths):
+        # 0 = "one fused program" (mirrors checkpoint_every=0); identical
+        # to the unbatched run.
+        whole = self._run(tmp_paths)
+        explicit = self._run(tmp_paths, fold_batch=0)
+        np.testing.assert_array_equal(explicit.fold_test_acc,
+                                      whole.fold_test_acc)
+
+    def test_cs_auto_fold_batch_on_accelerator(self, monkeypatch, caplog):
+        """CS runs on a non-CPU backend default to CS_ACCEL_FOLD_BATCH-fold
+        groups (measured v5e limit: 30+-fold CS programs fault the device);
+        CPU, meshes, explicit values and 0 leave the choice alone."""
+        import logging
+
+        import jax
+
+        from eegnetreplication_tpu.training import protocols as P
+
+        monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+        assert P._cs_auto_fold_batch(90, None, None) is None  # cpu backend
+        assert P._cs_auto_fold_batch(90, None, 45) == 45
+        assert P._cs_auto_fold_batch(90, None, 0) is None
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        with caplog.at_level(logging.INFO):
+            assert (P._cs_auto_fold_batch(90, None, None)
+                    == P.CS_ACCEL_FOLD_BATCH)
+        assert any("Auto fold batching" in r.getMessage()
+                   for r in caplog.records)
+        assert P._cs_auto_fold_batch(P.CS_ACCEL_FOLD_BATCH, None, None) is None
+        assert P._cs_auto_fold_batch(90, object(), None) is None  # mesh
+        assert P._cs_auto_fold_batch(90, None, 45) == 45
 
     def test_ungrouped_completion_clears_stale_group_snapshots(self, tmp_paths):
         with pytest.raises(RuntimeError, match="injected crash"):
