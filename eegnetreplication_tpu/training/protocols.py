@@ -683,8 +683,19 @@ def within_subject_training(epochs: int | None = None, *,
     return ProtocolResult(per_subject_test_acc, avg, best_states, fold_test,
                           wall, epochs, tuple(subjects),
                           fold_epochs_trained=fold_epochs_trained,
-                          fold_batch=(None if mesh is not None
-                                      else (fold_batch or None)))
+                          fold_batch=_effective_fold_batch(fold_batch, mesh,
+                                                           len(specs)))
+
+
+def _effective_fold_batch(fold_batch, mesh, n_folds: int) -> int | None:
+    """The grouping :func:`_run_folds` ACTUALLY uses: ``None`` (one fused
+    program) under a mesh, for the 0 opt-out, and when the fold count fits
+    in one group anyway — mirrors the grouping condition exactly so
+    :class:`ProtocolResult.fold_batch` never claims a grouping that did
+    not run."""
+    if mesh is not None or not fold_batch or n_folds <= fold_batch:
+        return None
+    return fold_batch
 
 
 def _cs_auto_fold_batch(n_folds: int, mesh, fold_batch: int | None):
@@ -805,5 +816,5 @@ def cross_subject_training(epochs: int | None = None, *,
     return ProtocolResult(per_subject_test_acc, avg_all, [best_state],
                           fold_test, wall, epochs, tuple(subjects),
                           fold_epochs_trained=fold_epochs_trained,
-                          fold_batch=(None if mesh is not None
-                                      else (fold_batch or None)))
+                          fold_batch=_effective_fold_batch(fold_batch, mesh,
+                                                           len(specs)))

@@ -91,12 +91,35 @@ def main(argv=None) -> int:
         "verify", [py, "-m", "eegnetreplication_tpu.data.verify",
                    "--subjects", subj_list],
         root, record, platform="cpu")
-    ok = ok and run_stage(
-        "train-ws", [py, "-m", "eegnetreplication_tpu.train",
-                     "--trainingType", "Within-Subject",
-                     "--epochs", str(args.epochs),
-                     "--subjects", subj_list],
-        root, record, platform=args.platform)
+    train_cmd = [py, "-m", "eegnetreplication_tpu.train",
+                 "--trainingType", "Within-Subject",
+                 "--epochs", str(args.epochs),
+                 "--subjects", subj_list]
+    # A previous attempt that died mid-run (the tunnel's observed
+    # remote_compile drop) leaves run snapshots; auto-chunked runs
+    # (epochs over the chunking threshold) resume from the last chunk
+    # boundary instead of repeating completed epochs.  Only when a
+    # snapshot's signature matches THIS invocation — a leftover from
+    # different epochs/subjects would make --resume a hard error.
+    sys.path.insert(0, str(REPO))
+    from eegnetreplication_tpu.training.checkpoint import (
+        read_snapshot_signature,
+    )
+    from eegnetreplication_tpu.training.protocols import AUTO_CHUNK_THRESHOLD
+
+    models = root / "models"
+    snaps = ([models / "within_subject_eegnet.run.npz"] +
+             sorted(models.glob("within_subject_eegnet.run.npz.g*"))
+             if models.exists() else [])
+    for snap in snaps:
+        sig = read_snapshot_signature(snap) if snap.exists() else None
+        if (sig and args.epochs > AUTO_CHUNK_THRESHOLD
+                and sig.get("epochs") == args.epochs
+                and sig.get("subjects") == list(range(1, args.subjects + 1))):
+            train_cmd.append("--resume")
+            break
+    ok = ok and run_stage("train-ws", train_cmd, root, record,
+                          platform=args.platform)
     ok = ok and run_stage(
         "predict", [py, "-m", "eegnetreplication_tpu.predict",
                     "--checkpoint",
