@@ -511,9 +511,11 @@ def _read_last_onchip() -> dict | None:
 def _attach_last_onchip(record: dict) -> None:
     """On a failed accelerator run, embed the most recent successful
     on-chip headline so the artifact still reports a real measurement.
-    No-op for CPU lines (they attach it in main's fallback block) or when
-    already present."""
-    if record.get("platform") != "cpu" and "last_onchip" not in record:
+    No-op for CPU lines (they attach it in main's fallback block), when a
+    headline value WAS measured before the failure (attaching an older
+    record beside a fresh value would mislead), or when already present."""
+    if (record.get("platform") != "cpu" and not record.get("value")
+            and "last_onchip" not in record):
         last = _read_last_onchip()
         if last:
             record["last_onchip"] = last

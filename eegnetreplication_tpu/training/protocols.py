@@ -382,10 +382,17 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     # topology (different fold padding) must not pour into this template.
     # maxnorm_mode/precision too: resuming a carry under different update
     # rules or matmul numerics would silently change the science.
+    # n_pool/train_pad/val_pad fingerprint the dataset geometry: the carry
+    # shapes are trial-count-independent, so without them a snapshot from a
+    # run over a DIFFERENT dataset (e.g. a rehearsal regenerated with more
+    # trials) would silently pour into this run and splice two datasets'
+    # training histories together.
     signature = dict(signature or {}, epochs=epochs, n_folds=n_folds,
                      padded_folds=padded, seed=seed,
                      maxnorm_mode=config.maxnorm_mode,
-                     precision=config.precision)
+                     precision=config.precision,
+                     n_pool=int(pool_x.shape[0]),
+                     train_pad=train_pad, val_pad=val_pad)
     if epochs % checkpoint_every:
         # Blame the flag only when the user actually set one; the auto
         # fallback (no divisor of epochs near the target) is deliberate.

@@ -107,17 +107,16 @@ def main(argv=None) -> int:
     )
     from eegnetreplication_tpu.training.protocols import AUTO_CHUNK_THRESHOLD
 
-    models = root / "models"
-    snaps = ([models / "within_subject_eegnet.run.npz"] +
-             sorted(models.glob("within_subject_eegnet.run.npz.g*"))
-             if models.exists() else [])
-    for snap in snaps:
-        sig = read_snapshot_signature(snap) if snap.exists() else None
-        if (sig and args.epochs > AUTO_CHUNK_THRESHOLD
-                and sig.get("epochs") == args.epochs
-                and sig.get("subjects") == list(range(1, args.subjects + 1))):
-            train_cmd.append("--resume")
-            break
+    snap = root / "models" / "within_subject_eegnet.run.npz"
+    sig = read_snapshot_signature(snap) if snap.exists() else None
+    if (sig and args.epochs > AUTO_CHUNK_THRESHOLD
+            and sig.get("epochs") == args.epochs
+            and sig.get("subjects") == list(range(1, args.subjects + 1))
+            # Dataset geometry: the WS pool is every subject's two
+            # sessions; a snapshot from a different --trials must not
+            # resume into the regenerated dataset.
+            and sig.get("n_pool") == args.subjects * 2 * args.trials):
+        train_cmd.append("--resume")
     ok = ok and run_stage("train-ws", train_cmd, root, record,
                           platform=args.platform)
     ok = ok and run_stage(

@@ -422,6 +422,33 @@ class TestFoldBatching:
         np.testing.assert_array_equal(explicit.fold_test_acc,
                                       whole.fold_test_acc)
 
+    def test_effective_fold_batch_mirrors_grouping(self):
+        """ProtocolResult.fold_batch must record what _run_folds actually
+        did, so the resolver mirrors its grouping condition exactly."""
+        from eegnetreplication_tpu.training.protocols import (
+            _effective_fold_batch,
+        )
+
+        assert _effective_fold_batch(15, None, 90) == 15
+        assert _effective_fold_batch(None, None, 90) is None
+        assert _effective_fold_batch(0, None, 90) is None
+        assert _effective_fold_batch(100, None, 90) is None  # one program
+        assert _effective_fold_batch(90, None, 90) is None   # one program
+        assert _effective_fold_batch(15, object(), 90) is None  # mesh
+
+    def test_read_snapshot_signature_robust(self, tmp_path):
+        from eegnetreplication_tpu.training.checkpoint import (
+            read_snapshot_signature,
+        )
+
+        assert read_snapshot_signature(tmp_path / "missing.npz") is None
+        bad = tmp_path / "bad.npz"
+        bad.write_bytes(b"not a zip")
+        assert read_snapshot_signature(bad) is None
+        unsigned = tmp_path / "unsigned.npz"
+        np.savez(unsigned, x=np.zeros(3))
+        assert read_snapshot_signature(unsigned) is None
+
     def test_cs_auto_fold_batch_on_accelerator(self, monkeypatch, caplog):
         """CS runs on a non-CPU backend default to CS_ACCEL_FOLD_BATCH-fold
         groups (measured v5e limit: 30+-fold CS programs fault the device);
