@@ -100,6 +100,46 @@ class TestLastOnchip:
         monkeypatch.setattr(bench, "_ONCHIP_LAST_PATH", str(p))
         assert bench._read_last_onchip() is None
 
+    def test_attach_skips_cpu_and_measured_headlines(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setattr(bench, "_ONCHIP_LAST_PATH",
+                            str(tmp_path / "last.json"))
+        bench._write_last_onchip({"value": 49.4, "unit": "fold-epochs/s",
+                                  "vs_baseline": 17.1, "platform": "tpu",
+                                  "compile_s": 310.0,
+                                  "train_mfu_pct": 0.07})
+        rec = {"platform": "cpu", "value": 0.0}
+        bench._attach_last_onchip(rec)
+        assert "last_onchip" not in rec  # cpu lines attach elsewhere
+        rec = {"platform": "tpu", "value": 49.4}
+        bench._attach_last_onchip(rec)
+        assert "last_onchip" not in rec  # headline measured: don't shadow
+        rec = {"platform": "tpu", "value": 0.0, "error": "mid-run death"}
+        bench._attach_last_onchip(rec)
+        assert rec["last_onchip"]["value"] == 49.4
+
+
+class TestCsScaleSummary:
+    def test_reads_committed_record(self):
+        # The repo commits BENCH_CS_SCALE.json (the 90x500 on-chip run);
+        # the bench line embeds its compact summary.
+        summary = bench._read_cs_scale_summary()
+        assert summary is not None
+        assert summary["n_folds"] == 90 and summary["epochs"] == 500
+        assert summary["platform"] == "tpu"
+        assert summary["protocol_fold_epochs_per_s"] > 0
+
+    def test_not_ok_record_is_none(self, tmp_path, monkeypatch):
+        bad = tmp_path / "BENCH_CS_SCALE.json"
+        bad.write_text(json.dumps({"ok": False, "error": "device fault"}))
+        monkeypatch.setattr(bench, "_CS_SCALE_PATH", str(bad))
+        assert bench._read_cs_scale_summary() is None
+
+    def test_missing_record_is_none(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(bench, "_CS_SCALE_PATH",
+                            str(tmp_path / "absent.json"))
+        assert bench._read_cs_scale_summary() is None
+
 
 class TestFlopsFields:
     def test_fields_derive_from_rates(self):
