@@ -116,6 +116,11 @@ class ProtocolResult:
     # program): the CS auto resolution means the caller's argument is not
     # necessarily what ran — measurement artifacts should record this.
     fold_batch: int | None = None
+    # Per-fold min validation loss: continuous (unlike the coarsely
+    # quantized accuracies), so measurement scripts can use it as
+    # replay-freshness evidence — N independently-initialized folds
+    # cannot produce identical loss trajectories.
+    fold_min_val_loss: np.ndarray | None = None
 
     @property
     def epoch_throughput(self) -> float:
@@ -691,7 +696,8 @@ def within_subject_training(epochs: int | None = None, *,
                           wall, epochs, tuple(subjects),
                           fold_epochs_trained=fold_epochs_trained,
                           fold_batch=_effective_fold_batch(fold_batch, mesh,
-                                                           len(specs)))
+                                                           len(specs)),
+                          fold_min_val_loss=np.asarray(results.min_val_loss))
 
 
 def _effective_fold_batch(fold_batch, mesh, n_folds: int) -> int | None:
@@ -824,4 +830,5 @@ def cross_subject_training(epochs: int | None = None, *,
                           fold_test, wall, epochs, tuple(subjects),
                           fold_epochs_trained=fold_epochs_trained,
                           fold_batch=_effective_fold_batch(fold_batch, mesh,
-                                                           len(specs)))
+                                                           len(specs)),
+                          fold_min_val_loss=min_val_loss)

@@ -81,6 +81,11 @@ def main(argv=None) -> int:
         # them), plus a digest of the materialized accuracy bytes for the
         # record and a physical floor on the wall time.
         accs = np.ascontiguousarray(result.fold_test_acc)
+        # The continuous per-fold min val losses are the stronger
+        # freshness signal: accuracies quantize to multiples of 1/n_test
+        # (an easy synthetic task can collapse them to one value), but 90
+        # independently-initialized folds cannot share loss trajectories.
+        losses = np.ascontiguousarray(result.fold_min_val_loss)
         import jax
 
         n_params = sum(int(np.prod(p.shape)) for p in
@@ -94,6 +99,9 @@ def main(argv=None) -> int:
             avg_test_acc=round(float(result.avg_test_acc), 2),
             distinct_fold_accs=int(len(set(accs.tolist()))),
             fold_acc_sha1=hashlib.sha1(accs.tobytes()).hexdigest()[:16],
+            distinct_fold_val_losses=int(len(set(losses.tolist()))),
+            fold_val_loss_sha1=hashlib.sha1(
+                losses.tobytes()).hexdigest()[:16],
             n_params=n_params,
             protocol_wall_s=round(result.wall_seconds, 1),
             protocol_fold_epochs_per_s=round(result.epoch_throughput, 2))
