@@ -38,6 +38,8 @@ class TestWithinSubject:
             paths=tmp_paths, seed=0)
         assert len(result.per_subject_test_acc) == 3
         assert result.fold_test_acc.shape == (12,)
+        assert result.fold_min_val_loss.shape == (12,)
+        assert np.all(np.isfinite(result.fold_min_val_loss))
         assert np.isclose(result.avg_test_acc,
                           np.mean(result.per_subject_test_acc))
         # separable synthetic task: better than the 25% chance level
