@@ -521,9 +521,20 @@ def _read_cs_scale_summary() -> dict | None:
             rec = json.load(f)
         if not (isinstance(rec, dict) and rec.get("ok")):
             return None
-        return {k: rec.get(k) for k in
-                ("platform", "n_folds", "epochs", "wall_s",
-                 "protocol_fold_epochs_per_s", "utc")}
+        summary = {k: rec.get(k) for k in
+                   ("platform", "n_folds", "epochs", "wall_s",
+                    "protocol_fold_epochs_per_s", "utc")}
+        # Freshness: a live record carries the per-fold min-val-loss vector
+        # signal (distinct_fold_val_losses, protocols.py); a record written
+        # before that signal existed can only defend itself with the
+        # accuracy vector — say so instead of looking silently complete
+        # (ADVICE r3).
+        if "distinct_fold_val_losses" in rec:
+            summary["distinct_fold_val_losses"] = (
+                rec["distinct_fold_val_losses"])
+        else:
+            summary["freshness"] = "record predates val-loss signal"
+        return summary
     except Exception:  # noqa: BLE001 — informational add-on only
         return None
 

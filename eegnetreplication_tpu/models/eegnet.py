@@ -27,9 +27,21 @@ import/export permutes the classifier input features accordingly
 
 from __future__ import annotations
 
+import os
+from typing import Any, Callable
+
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 from jax.nn import initializers
+
+from eegnetreplication_tpu.ops.banded import (
+    avg_pool_width,
+    depthwise_conv_banded,
+    pointwise_conv_banded,
+    spatial_conv_banded,
+    temporal_conv_banded,
+)
 
 # torch's default Conv2d/Linear weight init: kaiming_uniform(a=sqrt(5))
 # == U(-1/sqrt(fan_in), 1/sqrt(fan_in)) == variance_scaling(1/3, fan_in, uniform).
@@ -46,6 +58,30 @@ def _torch_bias_init(fan_in: int):
         return random.uniform(key, shape, dtype, -bound, bound)
 
     return init
+
+
+class _MatmulConv(nn.Module):
+    """Parameter-compatible stand-in for one of EEGNet's ``nn.Conv`` layers
+    that computes via the banded-matmul formulation (``ops/banded.py``).
+
+    Registers a ``kernel`` param with the exact nn.Conv shape and init, so
+    checkpoints, the eval-fusion parameter folding, and max-norm treatment
+    are impl-agnostic; only the op schedule changes (convs become
+    ``dot_general``s the MXU can tile, including under the protocols'
+    fold-``vmap`` and through the VJP).
+    """
+
+    kernel_shape: tuple[int, ...]
+    apply_fn: Callable[..., jnp.ndarray]
+    dtype: Any = jnp.float32
+    precision: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kernel = self.param("kernel", torch_kernel_init, self.kernel_shape,
+                            jnp.float32)
+        return self.apply_fn(x.astype(self.dtype), kernel.astype(self.dtype),
+                             precision=self.precision)
 
 
 class EEGNet(nn.Module):
@@ -77,10 +113,34 @@ class EEGNet(nn.Module):
     # Named mesh axis for cross-device BatchNorm stat sync under data
     # parallelism (None = local-batch stats, the single-device semantics).
     bn_axis_name: str | None = None
+    # Conv op schedule: "banded" computes every conv as banded/batched
+    # matmuls (``ops/banded.py`` — the MXU path; essential under the
+    # protocols' fold-vmap, where lax grouped convs with per-fold kernels
+    # lower to <0.1% MFU), "lax" uses ``lax.conv_general_dilated`` (the
+    # minimal-FLOP path — faster on CPU, where the banded form's deliberate
+    # FLOP inflation is paid by a scalar core, not an idle MXU).  "auto"
+    # resolves per backend at trace time; ``EEGTPU_CONV_IMPL`` overrides
+    # for A/B measurement.  Both impls share parameter shapes, names, and
+    # init — checkpoints and the eval fusion are impl-agnostic.
+    conv_impl: str = "auto"
 
     @property
     def F2(self) -> int:
         return self.F1 * self.D
+
+    def _banded(self) -> bool:
+        impl = self.conv_impl
+        if impl == "auto":
+            # The env override applies to "auto" models only: an explicitly
+            # constructed conv_impl (e.g. the parity tests' lax-vs-banded
+            # pairs) must not be silently redirected by ambient shell state.
+            impl = os.environ.get("EEGTPU_CONV_IMPL") or "auto"
+        if impl == "auto":
+            return jax.default_backend() == "tpu"
+        if impl not in ("banded", "lax"):
+            raise ValueError(
+                f"conv_impl must be 'auto', 'banded', or 'lax'; got {impl!r}")
+        return impl == "banded"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -89,46 +149,53 @@ class EEGNet(nn.Module):
                 f"Expected input (..., {self.n_channels}, {self.n_times}); got {x.shape}"
             )
         use_ra = not train
+        banded = self._banded()
         x = x.astype(self.dtype)[..., None]  # (B, C, T, 1) NHWC
 
+        def conv(name, shape, banded_fn, **lax_kw):
+            if banded:
+                return _MatmulConv(kernel_shape=shape, apply_fn=banded_fn,
+                                   dtype=self.dtype,
+                                   precision=self.precision, name=name)
+            return nn.Conv(shape[-1], shape[:2], use_bias=False,
+                           kernel_init=torch_kernel_init, dtype=self.dtype,
+                           precision=self.precision, name=name, **lax_kw)
+
+        def pool(h, window):
+            if banded:
+                return avg_pool_width(h, window)
+            return nn.avg_pool(h, (1, window), strides=(1, window))
+
         # --- Block 1: temporal filter bank + depthwise spatial filters ---
-        x = nn.Conv(self.F1, (1, 32), padding="SAME", use_bias=False,
-                    kernel_init=torch_kernel_init, dtype=self.dtype,
-                    precision=self.precision,
-                    name="temporal_conv")(x)
+        x = conv("temporal_conv", (1, 32, 1, self.F1),
+                 temporal_conv_banded, padding="SAME")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
                          epsilon=self.bn_epsilon, dtype=self.dtype,
                          name="temporal_bn")(x)
-        x = nn.Conv(self.D * self.F1, (self.n_channels, 1), padding="VALID",
-                    feature_group_count=self.F1, use_bias=False,
-                    kernel_init=torch_kernel_init, dtype=self.dtype,
-                    precision=self.precision,
-                    name="spatial_conv")(x)
+        x = conv("spatial_conv", (self.n_channels, 1, 1, self.D * self.F1),
+                 spatial_conv_banded, padding="VALID",
+                 feature_group_count=self.F1)(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
                          epsilon=self.bn_epsilon, dtype=self.dtype,
                          name="spatial_bn")(x)
         x = nn.elu(x)
-        x = nn.avg_pool(x, (1, 4), strides=(1, 4))
+        x = pool(x, 4)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
         # --- Block 2: separable conv ---
-        x = nn.Conv(self.D * self.F1, (1, 16), padding="SAME",
-                    feature_group_count=self.D * self.F1, use_bias=False,
-                    kernel_init=torch_kernel_init, dtype=self.dtype,
-                    precision=self.precision,
-                    name="separable_depthwise")(x)
-        x = nn.Conv(self.F2, (1, 1), padding="SAME", use_bias=False,
-                    kernel_init=torch_kernel_init, dtype=self.dtype,
-                    precision=self.precision,
-                    name="separable_pointwise")(x)
+        x = conv("separable_depthwise", (1, 16, 1, self.D * self.F1),
+                 depthwise_conv_banded, padding="SAME",
+                 feature_group_count=self.D * self.F1)(x)
+        x = conv("separable_pointwise", (1, 1, self.F2, self.F2),
+                 pointwise_conv_banded, padding="SAME")(x)
         x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
                          axis_name=self.bn_axis_name,
                          epsilon=self.bn_epsilon, dtype=self.dtype,
                          name="block2_bn")(x)
         x = nn.elu(x)
-        x = nn.avg_pool(x, (1, 8), strides=(1, 8))
+        x = pool(x, 8)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
 
         # --- Classifier ---

@@ -1,0 +1,153 @@
+"""Convolutions as banded matmuls: the MXU formulation of EEGNet's convs.
+
+Why this exists: the training protocols vmap the whole train step over the
+fold axis (36 within-subject folds, 15-fold cross-subject groups), so every
+conv in the model becomes a *batched grouped convolution with per-fold
+kernels* — a primitive XLA lowers onto the TPU poorly (measured round 3:
+0.07% train MFU, i.e. the MXU idle >99.9% while the protocol "wins" on
+dispatch fusion alone).  The eval path already escapes this via the
+algebraic block-1 fusion (``ops/fused_eegnet.py``); this module is the
+training-side counterpart, and it must also cover the *backward* pass,
+where most of the protocol's FLOPs are.
+
+The trick: a length-``K`` 1-D convolution along time is a matmul with a
+banded ``(P, T)`` matrix (``P = T + K - 1`` padded input length).  Building
+that matrix by indexing would give the backward pass a scatter; instead it
+is built by contracting the kernel with a *static one-hot expansion tensor*
+
+    E[k, p, t] = 1  iff  p == t + k
+
+so both the forward and every transpose/VJP are plain ``dot_general``s:
+
+    M    = einsum('kpt,kf->ptf',  E, w)        # banded matrix from taps
+    out  = einsum('bcp,ptf->bctf', x_pad, M)   # the conv, on the MXU
+    dw   = einsum('kpt,ptf->kf',  E, dM)       # VJP: matmul, not scatter
+
+Under the protocols' fold-``vmap`` these become batched matmuls with the
+fold axis as a ``dot_general`` batch dimension — exactly what the MXU
+wants.  The cost is deliberate FLOP inflation (the band matrix multiplies
+``T/K`` ≈ 8x more MACs than the minimal conv): trading idle-MXU cycles for
+a short schedule is the right TPU trade for this model size.
+
+Reference ops being reformulated: the torch convs of
+``src/eegnet_repl/model.py:22-76`` (temporal ``(1,32)`` SAME, depthwise
+spatial ``(C,1)`` VALID grouped, separable depthwise ``(1,16)`` SAME +
+pointwise ``(1,1)``).  Numerics match ``lax.conv_general_dilated`` up to
+f32 summation order; parity is pinned by ``tests/test_banded.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.lru_cache(maxsize=32)
+def _expansion_host(k: int, t: int) -> np.ndarray:
+    """Static one-hot E[k, p, t] = (p == t + k) for a SAME conv of width k.
+
+    Built on host once per (k, t) and closed over as a jit constant; XLA
+    hoists the ``E @ w`` band-matrix build out of inner loops where the
+    kernel is loop-invariant.
+    """
+    p = t + k - 1
+    kk, pp, tt = np.ogrid[:k, :p, :t]
+    return (pp == tt + kk).astype(np.float32)
+
+
+def conv1d_same_banded(x_pad: jnp.ndarray, taps: jnp.ndarray, t_out: int,
+                       precision=None) -> jnp.ndarray:
+    """Banded-matmul 1-D SAME conv along the last axis of ``x_pad``.
+
+    Args:
+        x_pad: ``(..., P)`` input already zero-padded to ``P = t_out + K - 1``
+            (SAME padding for even K is ``(K//2 - 1, K//2)`` on the left /
+            right, matching torch ``padding='same'`` and XLA ``SAME``).
+        taps: ``(K, F)`` filter taps.
+        t_out: output length T.
+    Returns:
+        ``(..., T, F)``.
+    """
+    k = taps.shape[0]
+    e = jnp.asarray(_expansion_host(k, t_out), dtype=taps.dtype)
+    band = jnp.einsum("kpt,kf->ptf", e, taps, precision=precision)
+    return jnp.einsum("...p,ptf->...tf", x_pad, band, precision=precision)
+
+
+def same_pad_1d(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """Zero-pad the last axis with XLA/torch SAME padding for width ``k``."""
+    left = (k - 1) // 2
+    right = k // 2
+    pad = [(0, 0)] * (x.ndim - 1) + [(left, right)]
+    return jnp.pad(x, pad)
+
+
+def temporal_conv_banded(x: jnp.ndarray, kernel: jnp.ndarray,
+                         precision=None) -> jnp.ndarray:
+    """EEGNet temporal conv: ``(B, C, T, 1) -> (B, C, T, F1)``.
+
+    ``kernel``: nn.Conv layout ``(1, K, 1, F1)`` (SAME, no bias).  One
+    ``(B*C, P) @ (P, T*F1)`` matmul per model instead of a batched conv
+    over ``C`` channel planes.
+    """
+    taps = kernel[0, :, 0, :]                      # (K, F1)
+    xp = same_pad_1d(x[..., 0], taps.shape[0])     # (B, C, P)
+    return conv1d_same_banded(xp, taps, x.shape[2], precision=precision)
+
+
+def spatial_conv_banded(x: jnp.ndarray, kernel: jnp.ndarray,
+                        precision=None) -> jnp.ndarray:
+    """EEGNet depthwise spatial conv: ``(B, C, T, F1) -> (B, 1, T, F2)``.
+
+    ``kernel``: nn.Conv layout ``(C, 1, 1, F2)`` with
+    ``feature_group_count=F1`` (VALID).  Grouped-conv output ordering is
+    group-major (``f2 = f1 * D + d``), so the kernel reshapes to
+    ``(C, F1, D)`` and the channel reduction is one einsum over ``C``.
+    """
+    c, f2 = kernel.shape[0], kernel.shape[3]
+    f1 = x.shape[3]
+    d = f2 // f1
+    s = kernel[:, 0, 0, :].reshape(c, f1, d)
+    h = jnp.einsum("bctf,cfd->btfd", x, s, precision=precision)
+    return h.reshape(x.shape[0], 1, x.shape[2], f2)
+
+
+def depthwise_conv_banded(x: jnp.ndarray, kernel: jnp.ndarray,
+                          precision=None) -> jnp.ndarray:
+    """Separable-depthwise conv: ``(B, 1, T, F2) -> (B, 1, T, F2)``.
+
+    ``kernel``: nn.Conv layout ``(1, K, 1, F2)`` with
+    ``feature_group_count=F2`` (SAME): an independent temporal filter per
+    feature.  Banded matmul batched over the feature axis.
+    """
+    taps = kernel[0, :, 0, :]                      # (K, F2)
+    k = taps.shape[0]
+    t = x.shape[2]
+    xp = same_pad_1d(jnp.swapaxes(x[:, 0], 1, 2), k)   # (B, F2, P)
+    e = jnp.asarray(_expansion_host(k, t), dtype=taps.dtype)
+    band = jnp.einsum("kpt,kf->fpt", e, taps, precision=precision)
+    h = jnp.einsum("bfp,fpt->btf", xp, band, precision=precision)
+    return h[:, None]
+
+
+def pointwise_conv_banded(x: jnp.ndarray, kernel: jnp.ndarray,
+                          precision=None) -> jnp.ndarray:
+    """Pointwise ``(1,1)`` conv as the matmul it is: ``(B, 1, T, F) ->
+    ``(B, 1, T, O)``.  ``kernel``: ``(1, 1, F, O)``."""
+    return jnp.einsum("bhtf,fo->bhto", x, kernel[0, 0],
+                      precision=precision)
+
+
+def avg_pool_width(x: jnp.ndarray, window: int) -> jnp.ndarray:
+    """VALID non-overlapping width pooling as a reshape-mean.
+
+    Equals ``nn.avg_pool(x, (1, window), strides=(1, window))`` (the tail
+    ``T % window`` samples are dropped, as VALID pooling does) without the
+    batched ``reduce_window`` primitive.
+    """
+    b, h, t, f = x.shape
+    t_out = t // window
+    return x[:, :, : t_out * window, :].reshape(
+        b, h, t_out, window, f).mean(axis=3)

@@ -25,6 +25,7 @@ Protocol-defining details reproduced exactly:
 from __future__ import annotations
 
 import functools
+import hashlib
 import math
 from dataclasses import dataclass
 from pathlib import Path
@@ -232,6 +233,13 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     keys = (_keys if _keys is not None else
             jax.random.split(jax.random.PRNGKey(seed + 1), n_folds))
 
+    if checkpoint_path is not None and "pool_sha1" not in (signature or {}):
+        # Content fingerprint for the run snapshot (ADVICE r3): hash the
+        # pool ONCE here — the grouped path below recurses with the full
+        # pool per group, and a snapshot-less run never consumes it.
+        signature = dict(signature or {},
+                         pool_sha1=_pool_digest(pool_x, pool_y))
+
     if fold_batch is not None and fold_batch < 0:
         raise ValueError(f"fold_batch must be >= 0, got {fold_batch}")
     if fold_batch == 0:  # explicit opt-out: one fused program (mirrors
@@ -391,7 +399,9 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     # shapes are trial-count-independent, so without them a snapshot from a
     # run over a DIFFERENT dataset (e.g. a rehearsal regenerated with more
     # trials) would silently pour into this run and splice two datasets'
-    # training histories together.
+    # training histories together.  (Content is fingerprinted too:
+    # pool_sha1, computed once at the top of this function, rides in via
+    # ``signature`` — ADVICE r3.)
     signature = dict(signature or {}, epochs=epochs, n_folds=n_folds,
                      padded_folds=padded, seed=seed,
                      maxnorm_mode=config.maxnorm_mode,
@@ -416,12 +426,34 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
 
     if resume and checkpoint_path is not None:
         if Path(checkpoint_path).exists():
-            carry, stored, start_epoch = ckpt_lib.load_run_snapshot(
-                checkpoint_path, carry, signature)
-            for name in metrics:
-                metrics[name] = [stored[name]]
-            logger.info("Resuming from %s at epoch %d", checkpoint_path,
-                        start_epoch)
+            stored_sig = ckpt_lib.read_snapshot_signature(checkpoint_path)
+
+            def _sans_digest(sig):
+                return {k: v for k, v in (sig or {}).items()
+                        if k != "pool_sha1"}
+
+            if (stored_sig is not None
+                    and stored_sig.get("pool_sha1")
+                    != signature.get("pool_sha1")
+                    and _sans_digest(stored_sig) == _sans_digest(signature)):
+                # Same run geometry, different (or pre-digest legacy) data
+                # content: resuming would splice two datasets' training
+                # histories — the graceful outcome is a fresh start, not a
+                # hard error (the rehearsal's auto --resume gate checks
+                # geometry only and relies on this downgrade).  Any OTHER
+                # signature mismatch still hard-fails in the loader below.
+                logger.warning(
+                    "Resume: snapshot %s matches this run's geometry but "
+                    "not its data content (pool digest %s vs %s) — "
+                    "training from scratch", checkpoint_path,
+                    stored_sig.get("pool_sha1"), signature.get("pool_sha1"))
+            else:
+                carry, stored, start_epoch = ckpt_lib.load_run_snapshot(
+                    checkpoint_path, carry, signature)
+                for name in metrics:
+                    metrics[name] = [stored[name]]
+                logger.info("Resuming from %s at epoch %d", checkpoint_path,
+                            start_epoch)
         else:
             logger.warning(
                 "--resume requested but no snapshot at %s; training from "
@@ -485,6 +517,19 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     return results, wall, float(trained)
 
 
+def _pool_digest(pool_x, pool_y) -> str:
+    """Short content digest of the trial pool for run-snapshot signatures.
+
+    Hashes the raw bytes of both arrays (a few tens of MB at full protocol
+    scale — milliseconds in C) so a resumed carry is guaranteed to continue
+    over the SAME data, not merely same-shaped data.
+    """
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(np.asarray(pool_x)).tobytes())
+    h.update(np.ascontiguousarray(np.asarray(pool_y)).tobytes())
+    return h.hexdigest()[:12]
+
+
 def _clear_run_snapshots(checkpoint_path) -> None:
     """Delete a completed protocol's run snapshot and any ``.g*`` group
     snapshots sharing its path (stale leftovers from a differently-batched
@@ -493,10 +538,12 @@ def _clear_run_snapshots(checkpoint_path) -> None:
     if checkpoint_path is None:
         return
     cp = Path(checkpoint_path)
-    if cp.exists():
-        cp.unlink()
+    # missing_ok: a concurrent retry/cleanup may have unlinked between the
+    # exists()/glob() check and here; a completed hours-long run must not
+    # die on its very last filesystem call (ADVICE r3).
+    cp.unlink(missing_ok=True)
     for stale in cp.parent.glob(cp.name + ".g*"):
-        stale.unlink()
+        stale.unlink(missing_ok=True)
 
 
 def _log_epoch_cadence(per_epoch, lo: int, hi: int, total_epochs: int,

@@ -1,0 +1,153 @@
+"""Non-saturating synthetic BCI task for protocol-level accuracy equivalence.
+
+VERDICT r3 item 2: the saturating CI task (100% accuracy everywhere) cannot
+distinguish two implementations, so this generator builds a
+BCI-IV-2a-shaped dataset whose difficulty is set by the DATA, not by
+training stochasticity: each trial carries one of four class templates
+(subject-tilted spatial pattern x band-limited oscillation) at a
+continuous random amplitude inside correlated noise.  Two near-Bayes
+classifiers then make *the same* errors — the hard trials are hard for
+both — so per-subject accuracy differences between implementations
+measure implementation divergence, not seed noise.  Amplitude/noise are
+tuned so EEGNet lands mid-range (~60-80%), with per-subject noise scaling
+spreading subjects like the reference's committed accuracies
+(``/root/reference/spatialFilters/acc.txt:1-9``: 35.7%-85.7%).
+
+Shapes mirror the real pipeline output (``dataset.py:223-226`` in the
+reference): 9 subjects x 2 sessions x 288 trials of (22, 257) @ 128 Hz.
+
+Class structure is deliberately INSIDE EEGNet's hypothesis class (temporal
+filter -> spatial filter -> envelope pooling) and partially shared across
+subjects (70% global / 30% subject tilt) so the cross-subject protocol
+transfers at a lower-but-above-chance level, as the real task does.
+
+Usage:
+    python scripts/equiv_task.py --out data-equiv/pool.npz        # generate
+    python scripts/equiv_task.py --probe                          # oracle acc
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+import numpy as np
+
+N_SUBJECTS = 9
+TRIALS = 288
+C, T = 22, 257
+FS = 128.0
+CLASS_FREQS = (9.0, 13.0, 19.0, 25.0)   # Hz, inside the 4-38 Hz band
+GLOBAL_SEED = 7
+AMP_MEAN, AMP_STD = 1.0, 0.55           # per-trial template amplitude
+NOISE_BASE = 0.5                        # tuned via --probe (oracle ~60-85%)
+# Per-subject noise scale: spreads subject accuracy like acc.txt:1-9.
+SUBJECT_NOISE = (0.80, 1.05, 0.70, 0.95, 1.25, 1.55, 0.85, 0.95, 0.75)
+
+
+def _templates(subject: int):
+    """Class templates for one subject: (4, C) spatial x (4, T, 2) quadrature
+    temporal (random per-trial phase = cos/sin mixture)."""
+    g = np.random.RandomState(GLOBAL_SEED)
+    p_global = np.linalg.qr(g.randn(C, 4))[0].T          # (4, C) orthonormal
+    r = np.random.RandomState(1000 + subject)
+    tilt = np.linalg.qr(r.randn(C, 4))[0].T
+    p = 0.7 * p_global + 0.3 * tilt
+    p /= np.linalg.norm(p, axis=1, keepdims=True)
+
+    t = np.arange(T) / FS
+    win = np.hanning(T)
+    s = np.stack([
+        np.stack([np.cos(2 * np.pi * f * t) * win,
+                  np.sin(2 * np.pi * f * t) * win], axis=-1)
+        for f in CLASS_FREQS
+    ])                                                    # (4, T, 2)
+    s /= np.linalg.norm(s, axis=1, keepdims=True)
+    return p.astype(np.float64), s.astype(np.float64)
+
+
+def _noise(rng: np.random.RandomState, n: int, mix: np.ndarray) -> np.ndarray:
+    """Spatially mixed AR(1) noise: (n, C, T)."""
+    z = rng.randn(n, C, T)
+    for i in range(1, T):
+        z[:, :, i] = 0.9 * z[:, :, i - 1] + np.sqrt(1 - 0.81) * z[:, :, i]
+    return np.einsum("dc,nct->ndt", mix, z)
+
+
+def make_session(subject: int, session: str, trials: int = TRIALS):
+    """One session of labeled trials: (X (n, C, T) f32, y (n,) i64)."""
+    p, s = _templates(subject)
+    sess_id = {"Train": 0, "Eval": 1}[session]
+    rng = np.random.RandomState(5000 + subject * 10 + sess_id)
+    mix = np.eye(C) + 0.3 * np.random.RandomState(2000 + subject).randn(C, C) / np.sqrt(C)
+
+    y = rng.randint(0, 4, size=trials)
+    phase = rng.uniform(0, 2 * np.pi, size=trials)
+    amp = np.abs(rng.randn(trials) * AMP_STD + AMP_MEAN)
+    sigma = NOISE_BASE * SUBJECT_NOISE[(subject - 1) % len(SUBJECT_NOISE)]
+
+    x = sigma * _noise(rng, trials, mix)
+    for i in range(trials):
+        k = y[i]
+        temporal = (np.cos(phase[i]) * s[k, :, 0]
+                    + np.sin(phase[i]) * s[k, :, 1])      # (T,)
+        x[i] += amp[i] * np.outer(p[k], temporal)
+    return x.astype(np.float32), y.astype(np.int64)
+
+
+def oracle_accuracy(x: np.ndarray, y: np.ndarray, subject: int) -> float:
+    """Matched-filter (quadrature energy) oracle: a near-Bayes ceiling for
+    EEGNet to approach; used to tune NOISE_BASE without training."""
+    p, s = _templates(subject)
+    # score[k] = || [ <x, p_k s_k_cos>, <x, p_k s_k_sin> ] ||
+    proj = np.einsum("nct,kc,ktq->nkq", x.astype(np.float64), p, s)
+    score = np.linalg.norm(proj, axis=-1)
+    return float(np.mean(np.argmax(score, axis=1) == y) * 100.0)
+
+
+def write_pool(out: Path, trials: int = TRIALS) -> None:
+    arrays = {}
+    for subj in range(1, N_SUBJECTS + 1):
+        for sess in ("Train", "Eval"):
+            x, y = make_session(subj, sess, trials)
+            arrays[f"X_{subj}_{sess}"] = x
+            arrays[f"y_{subj}_{sess}"] = y
+    out.parent.mkdir(parents=True, exist_ok=True)
+    np.savez_compressed(out, **arrays)
+    print(f"wrote {out} ({out.stat().st_size / 1e6:.1f} MB)")
+
+
+def load_pool(path: Path):
+    """Returns ``loader(subject, mode) -> (X, y)`` over the saved pool."""
+    data = np.load(path)
+
+    def loader(subject: int, mode: str):
+        return data[f"X_{subject}_{mode}"], data[f"y_{subject}_{mode}"]
+
+    return loader
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="data-equiv/pool.npz")
+    ap.add_argument("--trials", type=int, default=TRIALS)
+    ap.add_argument("--probe", action="store_true",
+                    help="print per-subject oracle accuracy, don't write")
+    args = ap.parse_args(argv)
+    if args.probe:
+        accs = []
+        for subj in range(1, N_SUBJECTS + 1):
+            x1, y1 = make_session(subj, "Train", args.trials)
+            x2, y2 = make_session(subj, "Eval", args.trials)
+            acc = oracle_accuracy(np.concatenate([x1, x2]),
+                                  np.concatenate([y1, y2]), subj)
+            accs.append(acc)
+            print(f"subject {subj}: oracle {acc:.1f}%")
+        print(f"mean oracle: {np.mean(accs):.1f}%")
+        return 0
+    write_pool(Path(args.out), args.trials)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -114,7 +114,11 @@ def main(argv=None) -> int:
             and sig.get("subjects") == list(range(1, args.subjects + 1))
             # Dataset geometry: the WS pool is every subject's two
             # sessions; a snapshot from a different --trials must not
-            # resume into the regenerated dataset.
+            # resume into the regenerated dataset.  Content is enforced
+            # downstream: the run-snapshot signature carries a pool
+            # digest (protocols._pool_digest), so same-geometry data from
+            # a different generation seed fails the resume loudly instead
+            # of splicing (ADVICE r3).
             and sig.get("n_pool") == args.subjects * 2 * args.trials):
         train_cmd.append("--resume")
     ok = ok and run_stage("train-ws", train_cmd, root, record,

@@ -1,0 +1,213 @@
+"""Parity of the banded-matmul conv path against the lax conv path.
+
+The model exposes two op schedules for the same math (``EEGNet.conv_impl``):
+``lax`` convs (minimal FLOPs) and ``banded`` matmuls (the MXU schedule the
+fold-vmapped training protocols use on TPU — ``ops/banded.py``).  Science
+must not depend on the schedule: these tests pin init equality (bit-exact),
+forward/backward/BN-update parity (f32-rounding tolerance), and short
+training-trajectory agreement between the two.
+
+Reference ops under test: the torch convs of
+``src/eegnet_repl/model.py:22-76``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.models.eegnet import EEGNet
+from eegnetreplication_tpu.ops import banded
+from eegnetreplication_tpu.training.steps import (
+    TrainState,
+    make_optimizer,
+    train_step,
+)
+
+C, T = 10, 65  # small but structure-complete (T//32 >= 1)
+
+
+def models():
+    kw = dict(n_channels=C, n_times=T, F1=4, D=2, dropout_rate=0.5)
+    return (EEGNet(conv_impl="lax", **kw), EEGNet(conv_impl="banded", **kw))
+
+
+@pytest.fixture(scope="module")
+def batch():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(12, C, T).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 4, size=12))
+    return x, y
+
+
+class TestOpParity:
+    """Each banded op against its lax twin, standalone."""
+
+    def test_temporal_conv(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(3, C, T, 1).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 32, 1, 4).astype(np.float32))
+        ref = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=jax.lax.Precision.HIGHEST)
+        got = banded.temporal_conv_banded(x, k, precision="highest")
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+    def test_spatial_grouped_conv(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(3, C, T, 4).astype(np.float32))
+        k = jnp.asarray(rng.randn(C, 1, 1, 8).astype(np.float32))
+        ref = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=4, precision=jax.lax.Precision.HIGHEST)
+        got = banded.spatial_conv_banded(x, k, precision="highest")
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+    def test_depthwise_conv(self):
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(3, 1, 16, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 16, 1, 8).astype(np.float32))
+        ref = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=8, precision=jax.lax.Precision.HIGHEST)
+        got = banded.depthwise_conv_banded(x, k, precision="highest")
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+    def test_pointwise_conv(self):
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randn(3, 1, 16, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 1, 8, 8).astype(np.float32))
+        ref = jax.lax.conv_general_dilated(
+            x, k, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=jax.lax.Precision.HIGHEST)
+        got = banded.pointwise_conv_banded(x, k, precision="highest")
+        np.testing.assert_allclose(got, ref, atol=2e-5, rtol=1e-5)
+
+    def test_avg_pool(self):
+        import flax.linen as nn
+
+        rng = np.random.RandomState(5)
+        x = jnp.asarray(rng.randn(3, 1, 65, 8).astype(np.float32))
+        ref = nn.avg_pool(x, (1, 4), strides=(1, 4))
+        got = banded.avg_pool_width(x, 4)
+        np.testing.assert_allclose(got, ref, atol=1e-6)
+
+
+class TestModelParity:
+    def test_init_bit_identical(self, batch):
+        lax_m, band_m = models()
+        x, _ = batch
+        key = jax.random.PRNGKey(7)
+        v1 = lax_m.init(key, x[:2])
+        v2 = band_m.init(key, x[:2])
+        assert jax.tree_util.tree_structure(v1) == \
+            jax.tree_util.tree_structure(v2)
+        for a, b in zip(jax.tree_util.tree_leaves(v1),
+                        jax.tree_util.tree_leaves(v2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_eval_forward(self, batch):
+        lax_m, band_m = models()
+        x, _ = batch
+        v = lax_m.init(jax.random.PRNGKey(7), x[:2])
+        ref = lax_m.apply(v, x, train=False)
+        got = band_m.apply(v, x, train=False)
+        np.testing.assert_allclose(got, ref, atol=3e-5, rtol=1e-4)
+
+    def test_train_forward_and_bn_updates(self, batch):
+        lax_m, band_m = models()
+        x, _ = batch
+        v = lax_m.init(jax.random.PRNGKey(7), x[:2])
+        drng = jax.random.PRNGKey(11)
+        ref, ref_upd = lax_m.apply(v, x, train=True,
+                                   mutable=["batch_stats"],
+                                   rngs={"dropout": drng})
+        got, got_upd = band_m.apply(v, x, train=True,
+                                    mutable=["batch_stats"],
+                                    rngs={"dropout": drng})
+        np.testing.assert_allclose(got, ref, atol=5e-5, rtol=1e-4)
+        for a, b in zip(jax.tree_util.tree_leaves(ref_upd),
+                        jax.tree_util.tree_leaves(got_upd)):
+            np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                       atol=5e-5, rtol=1e-4)
+
+    def test_gradients(self, batch):
+        lax_m, band_m = models()
+        x, y = batch
+        v = lax_m.init(jax.random.PRNGKey(7), x[:2])
+        drng = jax.random.PRNGKey(13)
+
+        def loss(model, params):
+            import optax
+
+            logits, _ = model.apply(
+                {"params": params, "batch_stats": v["batch_stats"]}, x,
+                train=True, mutable=["batch_stats"],
+                rngs={"dropout": drng})
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+
+        g_ref = jax.grad(lambda p: loss(lax_m, p))(v["params"])
+        g_got = jax.grad(lambda p: loss(band_m, p))(v["params"])
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(g_ref),
+                jax.tree_util.tree_leaves_with_path(g_got)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=1e-4, rtol=2e-3,
+                err_msg=jax.tree_util.keystr(pa))
+
+    def test_short_training_trajectory(self, batch):
+        """30 train steps under each schedule: endpoint params agree to
+        f32-accumulation tolerance (the schedules reorder summations, so
+        bit-equality is not the contract — trajectory closeness is)."""
+        lax_m, band_m = models()
+        x, y = batch
+        w = jnp.ones(x.shape[0])
+        tx = make_optimizer()
+
+        def run(model):
+            v = model.init(jax.random.PRNGKey(7), x[:2])
+            state = TrainState.create(v, tx)
+            losses = []
+            for i in range(30):
+                state, loss = jax.jit(
+                    train_step, static_argnames=("model", "tx",
+                                                 "maxnorm_mode"))(
+                    model, tx, state, x, y, w, jax.random.PRNGKey(100 + i))
+                losses.append(float(loss))
+            return state, losses
+
+        s_ref, l_ref = run(lax_m)
+        s_got, l_got = run(band_m)
+        np.testing.assert_allclose(l_got, l_ref, atol=5e-4, rtol=5e-3)
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(s_ref.params),
+                jax.tree_util.tree_leaves_with_path(s_got.params)):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), atol=5e-3, rtol=5e-2,
+                err_msg=jax.tree_util.keystr(pa))
+
+    def test_fold_vmapped_step_runs(self, batch):
+        """The protocols' shape: vmap the train step over a fold axis of
+        per-fold params — the banded einsums must batch into dot_generals
+        (correctness; the perf claim is measured on chip)."""
+        _, band_m = models()
+        x, y = batch
+        n_folds = 3
+        tx = make_optimizer()
+        keys = jax.random.split(jax.random.PRNGKey(0), n_folds)
+        states = jax.vmap(
+            lambda k: TrainState.create(band_m.init(k, x[:2]), tx))(keys)
+        w = jnp.ones(x.shape[0])
+
+        def step(state, key):
+            return train_step(band_m, tx, state, x, y, w, key)
+
+        new_states, losses = jax.jit(jax.vmap(step))(states, keys)
+        assert losses.shape == (n_folds,)
+        assert np.all(np.isfinite(np.asarray(losses)))
+        # Distinct per-fold inits must stay distinct after the step.
+        k0 = np.asarray(new_states.params["temporal_conv"]["kernel"])
+        assert not np.allclose(k0[0], k0[1])

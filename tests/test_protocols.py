@@ -187,6 +187,31 @@ class TestChunkedResume:
                 paths=tmp_paths, seed=0, save_models=False,
                 checkpoint_every=2, resume=True)
 
+    def test_content_mismatch_resumes_fresh(self, tmp_paths, caplog):
+        """Same geometry, different data content (pool digest mismatch):
+        --resume downgrades to a fresh run with a warning — the graceful
+        outcome the rehearsal's geometry-only gate relies on — instead of
+        splicing datasets or hard-failing (ADVICE r3 / review r4)."""
+        import logging
+
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        snap = tmp_paths.models / "within_subject_eegnet.run.npz"
+        assert snap.exists()
+        # Identical geometry, different trial values.
+        loader2 = make_loader(n_trials=24, n_channels=4, n_times=64,
+                              class_sep=1.7)
+        with caplog.at_level(logging.WARNING):
+            result = within_subject_training(
+                epochs=6, config=CFG, loader=loader2, subjects=(1,),
+                paths=tmp_paths, seed=0, save_models=False,
+                checkpoint_every=2, resume=True)
+        assert any("not its data content" in r.getMessage()
+                   for r in caplog.records)
+        # Fresh run to completion over the new data; snapshot cleaned up.
+        assert len(result.per_subject_test_acc) == 1
+        assert not snap.exists()
+
     def test_numerics_change_rejected_on_resume(self, tmp_paths):
         """Resuming a carry under different numerics or update rules would
         silently change the science — the signature must refuse."""
