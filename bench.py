@@ -601,6 +601,77 @@ def _arm_watchdog(record: dict, deadline_s: float) -> "threading.Timer":
     return timer
 
 
+def _attempt_late_tpu_promotion(record: dict, deadline_s: float,
+                                t_start: float) -> None:
+    """Re-probe the accelerator after a CPU fallback; promote on success.
+
+    Runs only when (a) this process measured on CPU as a *fallback* (a
+    forced EEGTPU_PLATFORM=cpu run means the caller wanted CPU), (b) the
+    remaining watchdog budget leaves room for a probe plus a warm-cache
+    accelerator run, and (c) BENCH_LATE_REPROBE isn't 0 (the child runs
+    with it set to 0 — no recursion).  The child is this same script with
+    the platform forced to the probe's answer; forcing skips the child's
+    probe and enables the persistent compile cache, so a builder-warmed
+    cache finally applies to a driver-invoked run (VERDICT r3 weak #1).
+    On success the child's JSON line becomes the headline and the CPU
+    measurement is preserved under ``first_attempt_cpu``.
+    """
+    from eegnetreplication_tpu.utils.platform import probe_accelerator_info
+
+    if (record.get("platform") != "cpu" or PROBE_INFO.get("forced")
+            or os.environ.get("BENCH_LATE_REPROBE", "1") == "0"):
+        return
+    min_child_s = 300.0
+    remaining = deadline_s - (time.perf_counter() - t_start)
+    probe_s = min(90.0, remaining - min_child_s)
+    if probe_s < 30.0:
+        record["late_reprobe"] = (
+            f"skipped: {remaining:.0f}s of watchdog budget left")
+        return
+    r = probe_accelerator_info(probe_s, refresh=True)  # bypass cache READ
+    diag = {"probe_result": r.get("result"),
+            "probe_reason": str(r.get("reason"))[:120]}
+    if not r.get("result"):
+        record["late_reprobe"] = diag
+        return
+    # Budget nesting, strictly inside the parent watchdog: the watchdog
+    # fires at deadline_s; the subprocess wait must expire BEFORE that so
+    # a child hung at backend init (the same flakiness that caused the
+    # fallback) is reaped by the keep-CPU-line except path below, not by
+    # the watchdog stamping an error onto an already-valid CPU record.
+    remaining = deadline_s - (time.perf_counter() - t_start) - 30.0
+    env = dict(os.environ, EEGTPU_PLATFORM=str(r["result"]),
+               BENCH_LATE_REPROBE="0",
+               BENCH_DEADLINE_S=str(int(max(120.0, remaining - 60.0))))
+    try:
+        child = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+            capture_output=True, text=True, timeout=max(150.0, remaining))
+        line = next((ln for ln in reversed(child.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        parsed = json.loads(line) if line else None
+    except Exception as exc:  # noqa: BLE001 — keep the CPU line
+        record["late_reprobe"] = dict(diag, promoted=False,
+                                      child_error=f"{type(exc).__name__}: "
+                                                  f"{exc}"[:160])
+        return
+    if (parsed and parsed.get("platform") not in (None, "cpu")
+            and parsed.get("value", 0) > 0 and not parsed.get("error")):
+        cpu_summary = {k: record.get(k) for k in
+                       ("value", "vs_baseline", "compile_s",
+                        "fallback_reason", "probe_attempts",
+                        "probe_seconds")}
+        record.clear()
+        record.update(parsed)
+        record["late_reprobe"] = dict(diag, promoted=True)
+        record["first_attempt_cpu"] = cpu_summary
+    else:
+        tail = (child.stderr or child.stdout or "")[-160:]
+        record["late_reprobe"] = dict(
+            diag, promoted=False,
+            child_error=(parsed or {}).get("error") or tail)
+
+
 def main() -> None:
     """Run the bench; ALWAYS print exactly one JSON line on stdout."""
     record = {
@@ -659,6 +730,19 @@ def main() -> None:
             vs_baseline=round(ours / baseline, 2),
             baseline=round(baseline, 2),
         )
+        # Late re-probe BEFORE the CPU add-ons (VERDICT r3 item 1): a
+        # driver-captured platform:tpu line outranks every CPU-side add-on,
+        # and the promoted child record carries its own add-ons.  Runs here,
+        # with the headline + baseline safely in hand, so contended CPU
+        # add-ons can't starve it of watchdog budget.
+        try:
+            _attempt_late_tpu_promotion(record, deadline_s, t_start)
+        except Exception as exc:  # noqa: BLE001 — promotion is best-effort
+            record["late_reprobe"] = (
+                f"error: {type(exc).__name__}: {exc}"[:200])
+        if (isinstance(record.get("late_reprobe"), dict)
+                and record["late_reprobe"].get("promoted")):
+            return _emit(record, watchdog)
         try:
             record.update(bench_eval_kernels())
         except Exception as exc:  # noqa: BLE001 — optional add-on: a
@@ -720,6 +804,10 @@ def main() -> None:
         # line with value 0.0; attach the most recent successful on-chip
         # headline so the artifact still reports a real measurement.
         _attach_last_onchip(record)
+    _emit(record, watchdog)
+
+
+def _emit(record: dict, watchdog) -> None:
     if _EMIT_ONCE.acquire(blocking=False):
         watchdog.cancel()
         print(json.dumps(record))

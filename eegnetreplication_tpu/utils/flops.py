@@ -71,6 +71,23 @@ def _key_aval():
     return jax.eval_shape(lambda: jax.random.PRNGKey(0))
 
 
+def _canonical_schedule(model):
+    """Pin the minimal conv schedule before counting.
+
+    Useful-FLOP accounting measures the ALGORITHM's cost, not the op
+    schedule's: the banded-matmul formulation (``ops/banded.py``)
+    deliberately inflates conv MACs ~8x to buy MXU-friendly shapes, and
+    counting that inflation as "useful work" would flatter MFU.  EEGNet's
+    ``conv_impl`` is therefore forced to ``lax`` (same math, minimal MACs)
+    for every count; non-EEGNet models pass through unchanged.
+    """
+    if getattr(model, "conv_impl", "lax") != "lax":
+        import dataclasses
+
+        return dataclasses.replace(model, conv_impl="lax")
+    return model
+
+
 def train_step_flops(model, tx, batch_size: int, sample_shape) -> float | None:
     """XLA-cost-model FLOPs of ONE optimizer step at ``batch_size``.
 
@@ -83,6 +100,7 @@ def train_step_flops(model, tx, batch_size: int, sample_shape) -> float | None:
 
     from ..training import steps as steps_lib
 
+    model = _canonical_schedule(model)
     state = _state_avals(model, tx, sample_shape)
     x = jax.ShapeDtypeStruct((batch_size, *sample_shape), jnp.float32)
     y = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
@@ -105,6 +123,7 @@ def eval_step_flops(model, tx, batch_size: int, sample_shape) -> float | None:
 
     from ..training import steps as steps_lib
 
+    model = _canonical_schedule(model)
     state = _state_avals(model, tx, sample_shape)
     x = jax.ShapeDtypeStruct((batch_size, *sample_shape), jnp.float32)
     y = jax.ShapeDtypeStruct((batch_size,), jnp.int32)
@@ -142,6 +161,8 @@ def eval_forward_flops(model, batch_size: int, sample_shape) -> float | None:
     """XLA-cost-model FLOPs of one inference forward at ``batch_size``."""
     import jax
     import jax.numpy as jnp
+
+    model = _canonical_schedule(model)
 
     def build_vars():
         return model.init(jax.random.PRNGKey(0),

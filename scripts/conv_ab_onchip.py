@@ -1,0 +1,104 @@
+"""On-chip A/B: lax convs vs the banded-matmul schedule (VERDICT r3 item 3).
+
+Measures the REAL protocol-scale program (36 within-subject folds fused,
+``bench.bench_fold_scale`` workload) under both conv schedules on the
+ambient backend, and reports fold-epochs/s, the honest (lax-counted)
+GFLOP/s, and MFU for each.  This is the before/after evidence for the
+training-side MXU reformulation: ``ops/banded.py`` exists to lift the
+measured 0.07% train MFU; this script records whether it did.
+
+Run on the chip:  python scripts/conv_ab_onchip.py
+Smoke (CPU):      EEGTPU_PLATFORM=cpu python scripts/conv_ab_onchip.py \
+                      --subjects 2 --epochs 2
+Writes ``BENCH_CONV_AB.json`` at the repo root.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subjects", type=int, default=9)
+    ap.add_argument("--epochs", type=int, default=20)
+    ap.add_argument("--out", default=str(REPO / "BENCH_CONV_AB.json"))
+    args = ap.parse_args(argv)
+
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+
+    import jax
+
+    import bench
+
+    record: dict = {
+        "experiment": "conv-schedule-ab",
+        "workload": f"{args.subjects * 4} folds fused x {args.epochs} "
+                    f"epochs (within-subject shapes)",
+        "platform": jax.devices()[0].platform,
+        "device": getattr(jax.devices()[0], "device_kind", "?"),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "ok": False,
+    }
+
+    rng = np.random.RandomState(1)
+    pool_x = rng.randn(args.subjects * bench.N_POOL, bench.C,
+                       bench.T).astype(np.float32)
+    pool_y = rng.randint(0, 4, args.subjects * bench.N_POOL).astype(np.int32)
+    base = bench._fold_indices()
+    folds = [(tr + s * bench.N_POOL, va + s * bench.N_POOL,
+              te + s * bench.N_POOL)
+             for s in range(args.subjects) for tr, va, te in base]
+
+    for impl in ("lax", "banded"):
+        t0 = time.time()
+        try:
+            rate, compile_s = bench._time_fused_trainer(
+                pool_x, pool_y, folds, args.epochs,
+                model_kwargs={"conv_impl": impl})
+            record[impl] = {"fold_epochs_per_s": round(rate, 2),
+                            "compile_s": round(compile_s, 2),
+                            "wall_s": round(time.time() - t0, 1)}
+        except Exception as exc:  # noqa: BLE001 — record, keep the other arm
+            record[impl] = {"error": f"{type(exc).__name__}: {exc}"[:300],
+                            "wall_s": round(time.time() - t0, 1)}
+        Path(args.out).write_text(json.dumps(record, indent=1))
+
+    ok = all("fold_epochs_per_s" in record.get(i, {})
+             for i in ("lax", "banded"))
+    if ok:
+        record["speedup"] = round(
+            record["banded"]["fold_epochs_per_s"]
+            / max(record["lax"]["fold_epochs_per_s"], 1e-9), 2)
+        # Honest MFU per arm: same lax-counted fold-epoch FLOPs for both.
+        counts = bench._flops_accounting(timeout_s=300.0)
+        fe = counts.get("fold_epoch_flops")
+        if fe:
+            from eegnetreplication_tpu.utils.flops import mfu
+
+            record["fold_epoch_gflops"] = round(fe / 1e9, 3)
+            for impl in ("lax", "banded"):
+                rate = record[impl]["fold_epochs_per_s"]
+                record[impl]["gflops_per_s"] = round(rate * fe / 1e9, 1)
+                if record["platform"] != "cpu":
+                    record[impl]["mfu_pct"] = round(
+                        mfu(rate * fe) * 100, 4)
+    record["ok"] = ok
+    Path(args.out).write_text(json.dumps(record, indent=1))
+    print(json.dumps(record, indent=1))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -39,10 +39,23 @@ C, T = 22, 257
 FS = 128.0
 CLASS_FREQS = (9.0, 13.0, 19.0, 25.0)   # Hz, inside the 4-38 Hz band
 GLOBAL_SEED = 7
-AMP_MEAN, AMP_STD = 1.0, 0.55           # per-trial template amplitude
-NOISE_BASE = 0.5                        # tuned via --probe (oracle ~60-85%)
-# Per-subject noise scale: spreads subject accuracy like acc.txt:1-9.
-SUBJECT_NOISE = (0.80, 1.05, 0.70, 0.95, 1.25, 1.55, 0.85, 0.95, 0.75)
+AMP_MEAN, AMP_STD = 1.0, 0.45           # per-trial template amplitude
+SIG_SCALE = 8.0                         # template gain: per-sample SNR must
+#   be LEARNABLE from ~345 trials (tuned with scripts/equiv_tune.py — at
+#   unit scale the matched-filter oracle solves the task but a CNN trained
+#   on 345 trials stays at chance; real motor-imagery band-power changes
+#   are far above that regime).
+NOISE_BASE = 0.5
+# Difficulty comes from LABEL NOISE, not vanishing SNR: a per-subject
+# fraction of trials carries a uniformly-wrong label.  Any near-Bayes
+# classifier then predicts the GENERATIVE class and errs on exactly the
+# flipped trials, so two correct implementations make the SAME errors and
+# per-subject accuracy differences measure implementation divergence, not
+# guessing noise.  Expected accuracy ~ (1 - flip) * clean-task accuracy,
+# spreading subjects like the reference's acc.txt:1-9.
+SUBJECT_FLIP = (0.12, 0.28, 0.06, 0.20, 0.33, 0.42, 0.15, 0.22, 0.08)
+# Mild per-subject noise variation keeps the clean task itself non-trivial.
+SUBJECT_NOISE = (0.90, 1.00, 0.85, 0.95, 1.05, 1.15, 0.90, 1.00, 0.85)
 
 
 def _templates(subject: int):
@@ -81,17 +94,24 @@ def make_session(subject: int, session: str, trials: int = TRIALS):
     rng = np.random.RandomState(5000 + subject * 10 + sess_id)
     mix = np.eye(C) + 0.3 * np.random.RandomState(2000 + subject).randn(C, C) / np.sqrt(C)
 
-    y = rng.randint(0, 4, size=trials)
+    y_gen = rng.randint(0, 4, size=trials)
     phase = rng.uniform(0, 2 * np.pi, size=trials)
-    amp = np.abs(rng.randn(trials) * AMP_STD + AMP_MEAN)
-    sigma = NOISE_BASE * SUBJECT_NOISE[(subject - 1) % len(SUBJECT_NOISE)]
+    amp = SIG_SCALE * np.abs(rng.randn(trials) * AMP_STD + AMP_MEAN)
+    idx = (subject - 1) % len(SUBJECT_NOISE)
+    sigma = NOISE_BASE * SUBJECT_NOISE[idx]
 
     x = sigma * _noise(rng, trials, mix)
     for i in range(trials):
-        k = y[i]
+        k = y_gen[i]
         temporal = (np.cos(phase[i]) * s[k, :, 0]
                     + np.sin(phase[i]) * s[k, :, 1])      # (T,)
         x[i] += amp[i] * np.outer(p[k], temporal)
+
+    # Label noise: flip a per-subject fraction to a uniformly-drawn WRONG
+    # class.  The observed label is what both training and evaluation see.
+    y = y_gen.copy()
+    flip = rng.rand(trials) < SUBJECT_FLIP[idx]
+    y[flip] = (y_gen[flip] + rng.randint(1, 4, size=int(flip.sum()))) % 4
     return x.astype(np.float32), y.astype(np.int64)
 
 

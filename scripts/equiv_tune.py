@@ -1,0 +1,67 @@
+"""Quick tuning probe for the equivalence task: one torch fold, few epochs.
+
+Each full tuning iteration of the 500-epoch protocol costs hours on this
+1-core host; this runs ONE fold of one subject for --epochs and prints
+val/test accuracy, enough to see whether EEGNet *learns* the task and
+roughly where it lands.  Knobs can be overridden per run without editing
+``equiv_task.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "scripts"))
+sys.path.insert(0, str(REPO / "tests"))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--subject", type=int, default=1)
+    ap.add_argument("--epochs", type=int, default=100)
+    ap.add_argument("--sig-scale", type=float, default=None)
+    ap.add_argument("--trials", type=int, default=288)
+    args = ap.parse_args(argv)
+
+    import equiv_task
+    from sklearn.model_selection import KFold
+    from torch_ws_replica import evaluate, train_fold
+
+    if args.sig_scale is not None:
+        equiv_task.SIG_SCALE = args.sig_scale
+
+    x1, y1 = equiv_task.make_session(args.subject, "Train", args.trials)
+    x2, y2 = equiv_task.make_session(args.subject, "Eval", args.trials)
+    x = np.concatenate([x1, x2]).astype(np.float32)
+    y = np.concatenate([y1, y2]).astype(np.int64)
+
+    kf = KFold(n_splits=4, shuffle=True, random_state=42)
+    train_val_ids, test_ids = next(iter(kf.split(x)))
+    val_size = len(train_val_ids) // 5
+    train_ids, val_ids = train_val_ids[val_size:], train_val_ids[:val_size]
+
+    t0 = time.time()
+    final_model, best_state, best_val = train_fold(
+        x, y, train_ids, val_ids, args.epochs, p=0.5,
+        seed=args.subject * 10)
+    if best_state is not None:
+        final_model.load_state_dict(best_state)
+    test = evaluate(final_model, x, y, test_ids)
+    flip = equiv_task.SUBJECT_FLIP[(args.subject - 1)
+                                   % len(equiv_task.SUBJECT_FLIP)]
+    print(f"subject {args.subject} sig_scale {equiv_task.SIG_SCALE} "
+          f"epochs {args.epochs}: best val {best_val:.1f}%, test "
+          f"{test:.1f}% (flip {flip:.2f} -> ceiling ~{100 * (1 - flip) * 0.97:.0f}%) "
+          f"in {time.time() - t0:.0f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -69,7 +69,7 @@ def main(argv=None) -> int:
     import jax
 
     k = 4
-    fold_accs = np.asarray(res.fold_test_accuracy)
+    fold_accs = np.asarray(res.fold_test_acc)
     record = {"protocol": "within_subject", "impl": "framework",
               "platform": jax.devices()[0].platform,
               "epochs": args.epochs, "subjects": list(subjects),
@@ -100,6 +100,8 @@ def main(argv=None) -> int:
                 "torch": round(t["test_acc"], 2),
                 "delta_pp": round(f_acc - t["test_acc"], 2),
             }
+        missing = [s for s in subjects
+                   if str(s) not in torch_rec.get("per_subject", {})]
         if deltas:
             max_abs = max(abs(v["delta_pp"]) for v in deltas.values())
             combined = {
@@ -108,8 +110,12 @@ def main(argv=None) -> int:
                         "oracle ~56-85%/subject)",
                 "epochs": args.epochs,
                 "per_subject": deltas,
+                "subjects_compared": sorted(int(s) for s in deltas),
+                "subjects_missing_torch": missing,
                 "max_abs_delta_pp": round(max_abs, 2),
-                "pass_1pp": bool(max_abs <= 1.0),
+                # The done-criterion is per-subject over ALL subjects; a
+                # partially-written torch record must not read as a pass.
+                "pass_1pp": bool(max_abs <= 1.0 and not missing),
                 "framework_platform": record["platform"],
                 "framework_wall_s": record["wall_s"],
                 "torch_wall_s": torch_rec.get("wall_s"),

@@ -35,6 +35,18 @@ def test_train_step_flops_positive_and_scales_with_batch():
     assert 1.5 < f32 / f16 < 2.5
 
 
+def test_banded_schedule_counted_at_canonical_cost():
+    """MFU honesty: the banded op schedule inflates conv MACs ~8x by
+    design; FLOP counts must measure the algorithm (lax schedule) so the
+    same model costs the same regardless of conv_impl."""
+    tx = make_optimizer()
+    lax_f = train_step_flops(MODEL, tx, 16, (C, T))
+    banded_f = train_step_flops(
+        EEGNet(n_channels=C, n_times=T, F1=4, D=2, conv_impl="banded"),
+        tx, 16, (C, T))
+    assert banded_f == lax_f
+
+
 def test_eval_cheaper_than_train():
     tx = make_optimizer()
     assert (eval_step_flops(MODEL, tx, 16, (C, T))
