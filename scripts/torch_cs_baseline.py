@@ -1,0 +1,105 @@
+"""Torch cross-subject throughput baseline (VERDICT r3 item 6).
+
+Round 3 quoted the CS at-scale number (14.71 protocol fold-epochs/s on
+chip) against the WITHIN-subject torch baseline (1.62 fold-epochs/s) —
+not apples-to-apples, since a CS fold-epoch trains ~1,400 pooled trials
+(5 subjects x 2 sessions, ``reference/src/eegnet_repl/train.py:199-226``)
+vs ~345 for WS.  This measures the reference's training style
+(``model.py:101-189``: per-batch python loop, per-step ``loss.item()``
+sync, per-epoch validation) at CS fold shapes and writes
+``BENCH_CS_BASELINE.json`` so ``cs_vs_baseline`` has an honest
+denominator.
+
+Shapes: the reference pools only the TRAIN sessions of the drawn subjects
+(``train.py:204-215``: ``all_subjects_data`` is mode="Train", 288
+trials/subject; the Eval session is reserved for the held-out test
+subject) — so one CS fold-epoch trains 5 x 288 = 1,440 trials (23
+batches of 64) and validates 3 x 288 = 864, matching the at-scale
+record's ``trials_per_session: 288``.  EEGNet p=0.25 as in
+``train.py:234``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(REPO / "tests"))
+
+BATCH = 64
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=6,
+                    help="measured epochs (after a 1-epoch warmup)")
+    ap.add_argument("--out", default=str(REPO / "BENCH_CS_BASELINE.json"))
+    args = ap.parse_args(argv)
+
+    import torch
+    import torch.nn as nn
+    from test_parity_torch import build_torch_eegnet
+
+    c, t = 22, 257
+    n_train, n_val = 5 * 288, 3 * 288
+    rng = np.random.RandomState(0)
+    xt = torch.from_numpy(rng.randn(n_train, c, t).astype(np.float32))
+    yt = torch.from_numpy(rng.randint(0, 4, n_train).astype(np.int64))
+    xv = torch.from_numpy(rng.randn(n_val, c, t).astype(np.float32))
+    yv = torch.from_numpy(rng.randint(0, 4, n_val).astype(np.int64))
+
+    torch.manual_seed(0)
+    model = build_torch_eegnet(C=c, T=t, p=0.25)
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3, eps=1e-7)
+    loss_fn = nn.CrossEntropyLoss()
+    erng = np.random.RandomState(0)
+
+    def one_epoch():
+        model.train()
+        order = erng.permutation(n_train)
+        for s in range(0, n_train, BATCH):
+            b = order[s:s + BATCH]
+            opt.zero_grad()
+            loss = loss_fn(model(xt[b]), yt[b])
+            loss.backward()
+            opt.step()
+            loss.item()  # per-step sync, model.py:143
+        model.eval()
+        with torch.no_grad():
+            for s in range(0, n_val, BATCH):
+                loss_fn(model(xv[s:s + BATCH]), yv[s:s + BATCH]).item()
+
+    one_epoch()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(args.epochs):
+        one_epoch()
+    dt = time.perf_counter() - t0
+    rate = args.epochs / dt
+
+    record = {
+        "metric": "cross_subject_torch_baseline",
+        "unit": "fold-epochs/s",
+        "value": round(rate, 3),
+        "epochs_measured": args.epochs,
+        "seconds_per_epoch": round(dt / args.epochs, 2),
+        "train_trials": n_train, "val_trials": n_val,
+        "batches_per_epoch": -(-n_train // BATCH) + -(-n_val // BATCH),
+        "style": "reference model.py:101-189 loop at CS fold shapes "
+                 "(train.py:199-243)",
+        "torch_threads": torch.get_num_threads(),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    Path(args.out).write_text(json.dumps(record, indent=1))
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
