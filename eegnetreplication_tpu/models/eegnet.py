@@ -31,7 +31,6 @@ import os
 from typing import Any, Callable
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 from jax.nn import initializers
 
@@ -114,14 +113,17 @@ class EEGNet(nn.Module):
     # parallelism (None = local-batch stats, the single-device semantics).
     bn_axis_name: str | None = None
     # Conv op schedule: "banded" computes every conv as banded/batched
-    # matmuls (``ops/banded.py`` — the MXU path; essential under the
-    # protocols' fold-vmap, where lax grouped convs with per-fold kernels
-    # lower to <0.1% MFU), "lax" uses ``lax.conv_general_dilated`` (the
-    # minimal-FLOP path — faster on CPU, where the banded form's deliberate
-    # FLOP inflation is paid by a scalar core, not an idle MXU).  "auto"
-    # resolves per backend at trace time; ``EEGTPU_CONV_IMPL`` overrides
-    # for A/B measurement.  Both impls share parameter shapes, names, and
-    # init — checkpoints and the eval fusion are impl-agnostic.
+    # matmuls (``ops/banded.py``), "lax" uses ``lax.conv_general_dilated``
+    # (minimal FLOPs).  "auto" resolves to banded on every backend: the
+    # banded form was built for the TPU's MXU (vmapped grouped convs with
+    # per-fold kernels lower to <0.1% MFU there), but measured 8.9x faster
+    # on CPU too, with 3.7x faster compiles — XLA's batched-grouped-conv
+    # lowering is the bottleneck everywhere, and its deliberate ~8x MAC
+    # inflation is cheaper than that lowering on every backend tested
+    # (BENCH_NOTES.md round 4).  ``EEGTPU_CONV_IMPL`` overrides "auto"
+    # for A/B measurement; explicit construction wins over both.  Both
+    # impls share parameter shapes, names, and init — checkpoints and the
+    # eval fusion are impl-agnostic.
     conv_impl: str = "auto"
 
     @property
@@ -134,9 +136,10 @@ class EEGNet(nn.Module):
             # The env override applies to "auto" models only: an explicitly
             # constructed conv_impl (e.g. the parity tests' lax-vs-banded
             # pairs) must not be silently redirected by ambient shell state.
-            impl = os.environ.get("EEGTPU_CONV_IMPL") or "auto"
-        if impl == "auto":
-            return jax.default_backend() == "tpu"
+            # Env "auto" (resetting the override to default) = banded.
+            impl = os.environ.get("EEGTPU_CONV_IMPL") or "banded"
+            if impl == "auto":
+                impl = "banded"
         if impl not in ("banded", "lax"):
             raise ValueError(
                 f"conv_impl must be 'auto', 'banded', or 'lax'; got {impl!r}")

@@ -45,7 +45,8 @@ def main(argv=None) -> int:
 
     import torch
     import torch.nn as nn
-    from test_parity_torch import build_torch_eegnet
+    from torch.utils.data import DataLoader, TensorDataset
+    from torch_ws_replica import build_model  # grad-clamp hooks included
 
     c, t = 22, 257
     n_train, n_val = 5 * 288, 3 * 288
@@ -56,25 +57,29 @@ def main(argv=None) -> int:
     yv = torch.from_numpy(rng.randint(0, 4, n_val).astype(np.int64))
 
     torch.manual_seed(0)
-    model = build_torch_eegnet(C=c, T=t, p=0.25)
+    # Full reference-loop fidelity, like the WS replica: grad-clamp hooks
+    # (model.py:43-44,83-84) and shuffled DataLoader (train.py:229-231)
+    # are part of the per-step work being priced.
+    model = build_model(c, t, p=0.25)
     opt = torch.optim.Adam(model.parameters(), lr=1e-3, eps=1e-7)
     loss_fn = nn.CrossEntropyLoss()
-    erng = np.random.RandomState(0)
+    train_loader = DataLoader(TensorDataset(xt, yt), batch_size=BATCH,
+                              shuffle=True)
+    val_loader = DataLoader(TensorDataset(xv, yv), batch_size=BATCH,
+                            shuffle=False)
 
     def one_epoch():
         model.train()
-        order = erng.permutation(n_train)
-        for s in range(0, n_train, BATCH):
-            b = order[s:s + BATCH]
+        for xb, yb in train_loader:
             opt.zero_grad()
-            loss = loss_fn(model(xt[b]), yt[b])
+            loss = loss_fn(model(xb), yb)
             loss.backward()
             opt.step()
             loss.item()  # per-step sync, model.py:143
         model.eval()
         with torch.no_grad():
-            for s in range(0, n_val, BATCH):
-                loss_fn(model(xv[s:s + BATCH]), yv[s:s + BATCH]).item()
+            for xb, yb in val_loader:
+                loss_fn(model(xb), yb).item()
 
     one_epoch()  # warmup
     t0 = time.perf_counter()
@@ -90,7 +95,8 @@ def main(argv=None) -> int:
         "epochs_measured": args.epochs,
         "seconds_per_epoch": round(dt / args.epochs, 2),
         "train_trials": n_train, "val_trials": n_val,
-        "batches_per_epoch": -(-n_train // BATCH) + -(-n_val // BATCH),
+        "train_batches_per_epoch": -(-n_train // BATCH),
+        "val_batches_per_epoch": -(-n_val // BATCH),
         "style": "reference model.py:101-189 loop at CS fold shapes "
                  "(train.py:199-243)",
         "torch_threads": torch.get_num_threads(),
