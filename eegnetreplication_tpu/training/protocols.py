@@ -208,8 +208,13 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
 
     ``fold_batch`` — at most this many folds per compiled program: groups
     run sequentially through the same chunked machinery and results are
-    concatenated, bit-identically to one program (per-fold init states and
-    epoch keys are derived globally, then sliced).  For protocols whose
+    concatenated.  Per-fold init states and epoch keys are derived
+    globally then sliced, so grouping is scientifically transparent;
+    numerically, a grouped run matches the single-program run to f32
+    rounding (not bitwise — differently-sized batched dot_generals may
+    tile their reductions differently, observed with the banded conv
+    schedule).  Resume WITHIN a fixed grouping remains bit-identical
+    (same program, same shapes).  For protocols whose
     fold axis exceeds what the device can take in one program (observed:
     the 90-fold cross-subject segment faults a v5e chip that handles 36
     comfortably).  Ignored under a mesh (shard folds across devices

@@ -130,6 +130,57 @@ def accuracy_chart_figure(results: list[dict], title_prefix: str,
     return fig
 
 
+def performance_overview_lines(root: Path | None = None) -> list[str]:
+    """Plain-string summary of the repo's committed benchmark artifacts.
+
+    Framework-native surface (no reference counterpart — the reference
+    measures no throughput anywhere, SURVEY §6): renders whatever evidence
+    files exist at the repo root so the GUI can answer "how fast is this
+    thing, on what hardware" without leaving the app.  Missing artifacts
+    are skipped, never errors — the tab degrades to what is measured.
+    """
+    root = root or Path(__file__).resolve().parents[1]
+    lines: list[str] = []
+
+    def read(name):
+        try:
+            with open(root / name) as f:
+                return json.load(f)
+        except Exception:  # noqa: BLE001 — absent/corrupt = not measured
+            return None
+
+    last = read("BENCH_ONCHIP_LAST.json")
+    if last and last.get("value"):
+        lines.append(
+            f"Training throughput ({last.get('platform', '?')}): "
+            f"{last['value']} fold-epochs/s — "
+            f"{last.get('vs_baseline', '?')}x the reference loop "
+            f"({last.get('utc', '')})")
+    cs = read("BENCH_CS_SCALE.json")
+    if cs and cs.get("ok"):
+        lines.append(
+            f"Cross-subject at scale: {cs.get('n_folds')} folds x "
+            f"{cs.get('epochs')} epochs in {cs.get('wall_s', 0) / 60:.0f} "
+            f"min on {cs.get('platform', '?')} "
+            f"({cs.get('protocol_fold_epochs_per_s')} fold-epochs/s)")
+    base = read("BENCH_CS_BASELINE.json")
+    if base and base.get("value"):
+        lines.append(
+            f"Reference-style torch CS baseline: {base['value']} "
+            f"fold-epochs/s (measured, {base.get('torch_threads')} thread)")
+    ab = read("BENCH_CONV_AB.json")
+    if ab and ab.get("ok"):
+        lines.append(
+            f"Conv schedule A/B on {ab.get('platform', '?')}: banded "
+            f"{ab['banded'].get('fold_epochs_per_s')} vs lax "
+            f"{ab['lax'].get('fold_epochs_per_s')} fold-epochs/s "
+            f"({ab.get('speedup')}x)")
+    if not lines:
+        lines.append("No benchmark artifacts found — run bench.py or the "
+                     "scripts/ benchmarks to populate this tab.")
+    return lines
+
+
 def get_report(paths: Paths | None = None) -> dict:
     """Load the most recent training reports (``ui.py:597-620``)."""
     paths = paths or Paths.from_here()
@@ -181,6 +232,7 @@ class App(tk.Tk):
         self.create_logs_tab()
         self.create_reports_tab()
         self.create_exploration_tab()
+        self.create_performance_tab()
 
         self.current_process = None
         self.reports_data = {}
@@ -303,6 +355,28 @@ class App(tk.Tk):
         ttk.Button(viz_frame, text="Evaluate on Eval Session",
                    command=self.evaluate_model).grid(
             row=0, column=3, padx=5, pady=5)
+
+    def create_performance_tab(self):
+        """Framework-native tab (no reference twin): the repo's measured
+        benchmark evidence, rendered from the committed JSON artifacts via
+        the headless :func:`performance_overview_lines`."""
+        frame = ttk.Frame(self.notebook)
+        self.notebook.add(frame, text="Performance")
+        box = ttk.LabelFrame(frame, text="Measured Throughput", padding=10)
+        box.pack(fill=tk.BOTH, expand=True, padx=10, pady=10)
+        self.perf_labels = ttk.Frame(box)
+        self.perf_labels.pack(fill=tk.BOTH, expand=True)
+        ttk.Button(box, text="Refresh",
+                   command=self.load_performance).pack(pady=5)
+        self.load_performance()
+
+    def load_performance(self):
+        for child in self.perf_labels.winfo_children():
+            child.destroy()
+        for line in performance_overview_lines():
+            ttk.Label(self.perf_labels, text=line, font=("Arial", 11),
+                      wraplength=1100, justify=tk.LEFT).pack(
+                anchor=tk.W, pady=3)
 
     # ---------------------------------------------------- subprocess jobs
     def _launch(self, cmd: list[str], busy_message: str, success_message: str):

@@ -349,8 +349,14 @@ class TestFoldBatching:
         whole = self._run(tmp_paths)                 # 8 folds, one program
         with caplog.at_level(logging.INFO):
             batched = self._run(tmp_paths, fold_batch=3)  # groups of 3+3+2
-        np.testing.assert_array_equal(batched.fold_test_acc,
-                                      whole.fold_test_acc)
+        # Grouping must be scientifically transparent: same fold accuracies
+        # and same trajectories to f32 rounding.  Bitwise equality is NOT
+        # the contract across groupings — an 8-fold and a 3-fold batched
+        # dot_general may tile reductions differently (seen with the
+        # banded conv schedule); resume within one grouping stays bitwise
+        # (test_batched_chunked_crash_resume).
+        np.testing.assert_allclose(batched.fold_test_acc,
+                                   whole.fold_test_acc, atol=1e-3)
         # grouped runs log per-group lines AND a protocol-level aggregate
         lines = [r.getMessage() for r in caplog.records
                  if r.getMessage().startswith("Throughput: ")]
@@ -359,7 +365,10 @@ class TestFoldBatching:
         for a, b in zip(batched.best_states, whole.best_states):
             for la, lb in zip(jax.tree_util.tree_leaves(a),
                               jax.tree_util.tree_leaves(b)):
-                np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+                # atol: reduction-order noise (~1e-7/step) amplified by 4
+                # epochs of Adam+BN; near-zero params make rtol meaningless.
+                np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                           atol=5e-4, rtol=5e-2)
 
     def test_batched_chunked_crash_resume(self, tmp_paths):
         uninterrupted = self._run(tmp_paths, fold_batch=3, checkpoint_every=2)

@@ -325,6 +325,38 @@ class TestModelNameSync(unittest.TestCase):
 
         self.assertEqual(MODEL_NAMES, sorted(MODEL_REGISTRY))
 
+    def test_performance_overview_lines(self):
+        """The Performance tab's headless core: renders whatever artifacts
+        exist, skips the rest, degrades to a hint when none do."""
+        import json
+        import tempfile
+        from pathlib import Path
+
+        from eegnetreplication_tpu.ui import performance_overview_lines
+
+        with tempfile.TemporaryDirectory() as d:
+            root = Path(d)
+            self.assertIn("No benchmark artifacts",
+                          performance_overview_lines(root)[0])
+            (root / "BENCH_ONCHIP_LAST.json").write_text(json.dumps(
+                {"value": 49.4, "vs_baseline": 17.1, "platform": "tpu",
+                 "utc": "2026-07-31T03:31:50Z"}))
+            (root / "BENCH_CONV_AB.json").write_text(json.dumps(
+                {"ok": True, "platform": "cpu", "speedup": 8.94,
+                 "banded": {"fold_epochs_per_s": 1.52},
+                 "lax": {"fold_epochs_per_s": 0.17}}))
+            (root / "BENCH_CS_SCALE.json").write_text("{corrupt")
+            lines = performance_overview_lines(root)
+        self.assertEqual(len(lines), 2)
+        self.assertTrue(any("49.4 fold-epochs/s" in ln for ln in lines))
+        self.assertTrue(any("8.94x" in ln for ln in lines))
+
+    def test_performance_lines_on_repo_root(self):
+        """Against the real repo root: never raises, always one line+."""
+        from eegnetreplication_tpu.ui import performance_overview_lines
+
+        self.assertTrue(len(performance_overview_lines()) >= 1)
+
 
 # Keep last: classes defined below this guard would be invisible to a
 # direct ``python tests/test_viz_ui.py`` run (ADVICE r2).
