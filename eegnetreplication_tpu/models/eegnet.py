@@ -114,36 +114,54 @@ class EEGNet(nn.Module):
     bn_axis_name: str | None = None
     # Conv op schedule: "banded" computes every conv as banded/batched
     # matmuls (``ops/banded.py``), "lax" uses ``lax.conv_general_dilated``
-    # (minimal FLOPs).  "auto" resolves to banded on every backend: the
-    # banded form was built for the TPU's MXU (vmapped grouped convs with
-    # per-fold kernels lower to <0.1% MFU there), but measured 8.9x faster
-    # on CPU too, with 3.7x faster compiles — XLA's batched-grouped-conv
-    # lowering is the bottleneck everywhere, and its deliberate ~8x MAC
-    # inflation is cheaper than that lowering on every backend tested
-    # (BENCH_NOTES.md round 4).  ``EEGTPU_CONV_IMPL`` overrides "auto"
-    # for A/B measurement; explicit construction wins over both.  Both
-    # impls share parameter shapes, names, and init — checkpoints and the
-    # eval fusion are impl-agnostic.
+    # (minimal FLOPs).  "auto" resolves to banded up to
+    # ``BANDED_AUTO_MAX_T`` timesteps: the banded form was built for the
+    # TPU's MXU (vmapped grouped convs with per-fold kernels lower to
+    # <0.1% MFU there), measured 8.9x faster on CPU too, with 3.7x faster
+    # compiles — XLA's batched-grouped-conv lowering is the bottleneck
+    # everywhere, and the deliberate ~T/K MAC inflation is cheaper than
+    # that lowering at protocol sizes (T=257: ~8x, BENCH_NOTES.md round
+    # 4).  The inflation and the O(K*T^2) expansion constant grow with T,
+    # so past the cap "auto" falls back to lax (at native 250 Hz length
+    # T=1125 banded would pay ~35x MACs and a ~166 MB jit constant);
+    # explicit ``conv_impl="banded"`` still honors the request at any T.
+    # ``EEGTPU_CONV_IMPL`` overrides "auto" for A/B measurement; explicit
+    # construction wins over both.  "auto" is resolved ONCE at module
+    # construction (the resolved schedule participates in the module's
+    # hash/equality, so jit caches cannot conflate programs compiled under
+    # different env values — ADVICE r4).  Both impls share parameter
+    # shapes, names, and init — checkpoints and the eval fusion are
+    # impl-agnostic.
     conv_impl: str = "auto"
+
+    # Above this n_times, "auto" prefers lax: banded's MAC inflation is
+    # ~T/32 and its expansion constant ~4*32*T^2 bytes; 512 caps them at
+    # 16x and ~36 MB.
+    BANDED_AUTO_MAX_T = 512
 
     @property
     def F2(self) -> int:
         return self.F1 * self.D
 
-    def _banded(self) -> bool:
-        impl = self.conv_impl
-        if impl == "auto":
+    def __post_init__(self):
+        if self.conv_impl == "auto":
             # The env override applies to "auto" models only: an explicitly
             # constructed conv_impl (e.g. the parity tests' lax-vs-banded
-            # pairs) must not be silently redirected by ambient shell state.
-            # Env "auto" (resetting the override to default) = banded.
-            impl = os.environ.get("EEGTPU_CONV_IMPL") or "banded"
+            # pairs) must not be silently redirected by ambient shell
+            # state.  Env "auto" (resetting the override) = the default.
+            impl = os.environ.get("EEGTPU_CONV_IMPL") or "auto"
             if impl == "auto":
-                impl = "banded"
-        if impl not in ("banded", "lax"):
+                impl = ("banded" if self.n_times <= self.BANDED_AUTO_MAX_T
+                        else "lax")
+            object.__setattr__(self, "conv_impl", impl)
+        if self.conv_impl not in ("banded", "lax"):
             raise ValueError(
-                f"conv_impl must be 'auto', 'banded', or 'lax'; got {impl!r}")
-        return impl == "banded"
+                f"conv_impl must be 'auto', 'banded', or 'lax'; "
+                f"got {self.conv_impl!r}")
+        super().__post_init__()
+
+    def _banded(self) -> bool:
+        return self.conv_impl == "banded"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
