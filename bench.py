@@ -680,8 +680,12 @@ def _attempt_late_tpu_promotion(record: dict, deadline_s: float,
                                       child_error=f"{type(exc).__name__}: "
                                                   f"{exc}"[:160])
         return
+    # isinstance guard: a child emitting "value": null would make a bare
+    # `> 0` raise TypeError, and the caller's blanket except would then
+    # clobber the structured probe diagnostics (ADVICE r4).
     if (parsed and parsed.get("platform") not in (None, "cpu")
-            and parsed.get("value", 0) > 0 and not parsed.get("error")):
+            and isinstance(parsed.get("value"), (int, float))
+            and parsed.get("value") > 0 and not parsed.get("error")):
         cpu_summary = {k: record.get(k) for k in
                        ("value", "vs_baseline", "compile_s",
                         "fallback_reason", "probe_attempts",

@@ -114,6 +114,11 @@ class TrainingConfig:
     #               logits come out of the bf16 classifier matmul and are
     #               cast to f32 for the loss).
     precision: str = "highest"
+    # BatchNorm training semantics: "flax" (nn.BatchNorm) or "torch"
+    # (masked statistics excluding padded batch slots + unbiased running
+    # variance — the reference's exact semantics; models/norm.py).  Only
+    # models that declare masked BN honor it (EEGNet does).
+    bn_mode: str = "flax"
 
     def replace(self, **kw) -> "TrainingConfig":
         return dataclasses.replace(self, **kw)

@@ -54,7 +54,11 @@ class ShallowConvNet(nn.Module):
     bn_axis_name: str | None = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 sample_weights: jnp.ndarray | None = None) -> jnp.ndarray:
+        # sample_weights accepted for train-step uniformity; these
+        # baselines keep flax BN semantics (del: unused).
+        del sample_weights
         min_t = self.filter_time_length + self.pool_time_length - 1
         if x.shape[-1] < min_t:
             raise ValueError(
@@ -110,7 +114,11 @@ class DeepConvNet(nn.Module):
     bn_axis_name: str | None = None
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 sample_weights: jnp.ndarray | None = None) -> jnp.ndarray:
+        # sample_weights accepted for train-step uniformity; these
+        # baselines keep flax BN semantics (del: unused).
+        del sample_weights
         t = x.shape[-1]
         for _ in self.filters:
             t = (t - (self.kernel_length - 1)) // self.pool_length

@@ -34,6 +34,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 from jax.nn import initializers
 
+from eegnetreplication_tpu.models.norm import TorchBatchNorm
 from eegnetreplication_tpu.ops.banded import (
     avg_pool_width,
     depthwise_conv_banded,
@@ -133,6 +134,14 @@ class EEGNet(nn.Module):
     # shapes, names, and init — checkpoints and the eval fusion are
     # impl-agnostic.
     conv_impl: str = "auto"
+    # BatchNorm training semantics: "flax" (nn.BatchNorm: padding included
+    # in batch stats, biased running-var update) or "torch"
+    # (models/norm.py::TorchBatchNorm: loss-weight-0 padding masked out of
+    # the statistics, unbiased running-var update — the reference's exact
+    # semantics).  Eval mode is identical either way; checkpoints are
+    # interchangeable (same param/stat names).  See EQUIV_WS_MULTISEED for
+    # the measured accuracy effect.
+    bn_mode: str = "flax"
 
     # Above this n_times, "auto" prefers lax: banded's MAC inflation is
     # ~T/32 and its expansion constant ~4*32*T^2 bytes; 512 caps them at
@@ -158,13 +167,17 @@ class EEGNet(nn.Module):
             raise ValueError(
                 f"conv_impl must be 'auto', 'banded', or 'lax'; "
                 f"got {self.conv_impl!r}")
+        if self.bn_mode not in ("flax", "torch"):
+            raise ValueError(
+                f"bn_mode must be 'flax' or 'torch'; got {self.bn_mode!r}")
         super().__post_init__()
 
     def _banded(self) -> bool:
         return self.conv_impl == "banded"
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+    def __call__(self, x: jnp.ndarray, train: bool = False,
+                 sample_weights: jnp.ndarray | None = None) -> jnp.ndarray:
         if x.shape[-2:] != (self.n_channels, self.n_times):
             raise ValueError(
                 f"Expected input (..., {self.n_channels}, {self.n_times}); got {x.shape}"
@@ -182,6 +195,22 @@ class EEGNet(nn.Module):
                            kernel_init=torch_kernel_init, dtype=self.dtype,
                            precision=self.precision, name=name, **lax_kw)
 
+        def batch_norm(name):
+            if self.bn_mode == "torch":
+                layer = TorchBatchNorm(
+                    momentum=self.momentum, epsilon=self.bn_epsilon,
+                    dtype=self.dtype, axis_name=self.bn_axis_name,
+                    name=name)
+                return lambda h: layer(
+                    h, use_running_average=use_ra,
+                    sample_weights=None if use_ra else sample_weights)
+            layer = nn.BatchNorm(use_running_average=use_ra,
+                                 momentum=self.momentum,
+                                 axis_name=self.bn_axis_name,
+                                 epsilon=self.bn_epsilon, dtype=self.dtype,
+                                 name=name)
+            return layer
+
         def pool(h, window):
             if banded:
                 return avg_pool_width(h, window)
@@ -190,17 +219,11 @@ class EEGNet(nn.Module):
         # --- Block 1: temporal filter bank + depthwise spatial filters ---
         x = conv("temporal_conv", (1, 32, 1, self.F1),
                  temporal_conv_banded, padding="SAME")(x)
-        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
-                         axis_name=self.bn_axis_name,
-                         epsilon=self.bn_epsilon, dtype=self.dtype,
-                         name="temporal_bn")(x)
+        x = batch_norm("temporal_bn")(x)
         x = conv("spatial_conv", (self.n_channels, 1, 1, self.D * self.F1),
                  spatial_conv_banded, padding="VALID",
                  feature_group_count=self.F1)(x)
-        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
-                         axis_name=self.bn_axis_name,
-                         epsilon=self.bn_epsilon, dtype=self.dtype,
-                         name="spatial_bn")(x)
+        x = batch_norm("spatial_bn")(x)
         x = nn.elu(x)
         x = pool(x, 4)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
@@ -211,10 +234,7 @@ class EEGNet(nn.Module):
                  feature_group_count=self.D * self.F1)(x)
         x = conv("separable_pointwise", (1, 1, self.F2, self.F2),
                  pointwise_conv_banded, padding="SAME")(x)
-        x = nn.BatchNorm(use_running_average=use_ra, momentum=self.momentum,
-                         axis_name=self.bn_axis_name,
-                         epsilon=self.bn_epsilon, dtype=self.dtype,
-                         name="block2_bn")(x)
+        x = batch_norm("block2_bn")(x)
         x = nn.elu(x)
         x = pool(x, 8)
         x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)

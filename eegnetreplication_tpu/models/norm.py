@@ -1,0 +1,111 @@
+"""BatchNorm with torch-exact training semantics and padding masks.
+
+Round 4's protocol-level accuracy-equivalence experiment left the framework
+below the faithful torch replica on 7 of 9 subjects (mean -1.8 pp,
+``EQUIV_WS.json``).  Two small but *systematic* BatchNorm divergences are
+the named mechanism candidates (VERDICT r4 weak #3), and this module
+removes both behind ``EEGNet(bn_mode="torch")``:
+
+1. **Wraparound padding inside batch statistics.**  The fused training
+   loop feeds fixed-size batches whose tail slots repeat real samples with
+   loss-weight 0 (``training/loop.py::_shuffled_slots``); ``nn.BatchNorm``
+   has no notion of sample weights, so those duplicates skew the batch
+   mean/var AND the running stats of every final partial batch, every
+   epoch.  The reference's DataLoader simply makes the last batch smaller
+   (``model.py:136``), so its statistics see each real sample exactly
+   once.  Here the mask excludes zero-weight samples from the statistics
+   (masked samples are still normalized — their outputs carry no loss and,
+   with masked stats everywhere, no longer contaminate anything).
+
+2. **Biased vs unbiased running variance.**  flax updates the running
+   variance with the *biased* batch variance; torch uses the *unbiased*
+   one (``n/(n-1)``, torch ``_BatchNorm.forward``).  At batch 64 that is a
+   systematic ~1.6% scale difference in eval-mode normalization — exactly
+   what best-model selection (which evaluates with running stats) sees.
+
+Parameter and variable names/shapes mirror ``nn.BatchNorm`` (params
+``scale``/``bias``, batch_stats ``mean``/``var``), so checkpoints, the
+eval-path BN folding (``ops/fused_eegnet.py``), and the ``.pth`` interop
+are bn_mode-agnostic.  Cross-device sync under data parallelism matches
+``nn.BatchNorm(axis_name=...)``: the weighted sums are ``psum``-reduced so
+sharded statistics equal the global batch's.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class TorchBatchNorm(nn.Module):
+    """Feature-last BatchNorm, torch training semantics, optional mask.
+
+    ``use_running_average=True`` (eval) is numerically identical to
+    ``nn.BatchNorm``; training differs as documented in the module
+    docstring.  ``momentum`` follows the flax convention (running <-
+    momentum * running + (1 - momentum) * batch), i.e. 0.9 here equals
+    torch's ``momentum=0.1``.
+    """
+
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, use_running_average: bool,
+                 sample_weights: jnp.ndarray | None = None) -> jnp.ndarray:
+        feat = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones, (feat,),
+                           jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (feat,),
+                          jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda s: jnp.zeros(s, jnp.float32), (feat,))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda s: jnp.ones(s, jnp.float32), (feat,))
+
+        if use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            reduce_axes = tuple(range(x.ndim - 1))  # all but feature
+            xf = x.astype(jnp.float32)
+            if sample_weights is None:
+                w = jnp.ones((x.shape[0],), jnp.float32)
+            else:
+                w = (sample_weights > 0).astype(jnp.float32)
+            # Per-feature weighted sums; each batch sample contributes its
+            # H*W spatial positions, like torch's reduction over (B, H, W).
+            spatial = 1
+            for d in x.shape[1:-1]:
+                spatial *= d
+            w_b = w.reshape((-1,) + (1,) * (x.ndim - 1))
+            s1 = jnp.sum(xf * w_b, axis=reduce_axes)
+            s2 = jnp.sum(xf * xf * w_b, axis=reduce_axes)
+            denom = jnp.sum(w) * spatial
+            if self.axis_name is not None:
+                s1 = jax.lax.psum(s1, axis_name=self.axis_name)
+                s2 = jax.lax.psum(s2, axis_name=self.axis_name)
+                denom = jax.lax.psum(denom, axis_name=self.axis_name)
+            d = jnp.maximum(denom, 1.0)
+            mean = s1 / d
+            # E[x^2] - E[x]^2: fine in f32 for standardized EEG-scale
+            # activations; clamp the rounding-negative tail.
+            var = jnp.maximum(s2 / d - mean * mean, 0.0)
+            if not self.is_initializing():
+                # torch: running update uses the UNBIASED variance.
+                unbiased = var * d / jnp.maximum(d - 1.0, 1.0)
+                keep = denom > 0  # all-padding batch: stats unchanged
+                ra_mean.value = jnp.where(
+                    keep, self.momentum * ra_mean.value
+                    + (1.0 - self.momentum) * mean, ra_mean.value)
+                ra_var.value = jnp.where(
+                    keep, self.momentum * ra_var.value
+                    + (1.0 - self.momentum) * unbiased, ra_var.value)
+
+        inv = jax.lax.rsqrt(var + self.epsilon) * scale
+        y = (x.astype(jnp.float32) - mean) * inv + bias
+        return y.astype(self.dtype)

@@ -50,7 +50,7 @@ def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
         def loss_fn(params):
             logits, updates = model.apply(
                 {"params": params, "batch_stats": state.batch_stats},
-                x, train=True, mutable=["batch_stats"],
+                x, train=True, sample_weights=w, mutable=["batch_stats"],
                 rngs={"dropout": rng},
             )
             ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)

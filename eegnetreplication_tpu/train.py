@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "cheaper); 'default' = backend matmul precision "
                              "(TPU rounds operands to bf16 — fastest f32 "
                              "layout); 'bf16' = bf16 activations end-to-end.")
+    parser.add_argument("--bnMode", type=str, default="flax",
+                        choices=["flax", "torch"],
+                        help="BatchNorm training semantics: 'torch' masks "
+                             "padded batch slots out of the statistics and "
+                             "updates the running variance unbiased (the "
+                             "reference's exact semantics); 'flax' is "
+                             "nn.BatchNorm.  Eval is identical either way.")
     parser.add_argument("--subjects", type=str, default=None,
                         help="Comma-separated subject ids (default: 1-9).")
     parser.add_argument("--profileDir", type=str, default=None,
@@ -132,7 +139,8 @@ def main() -> None:
     )
 
     config = DEFAULT_TRAINING.replace(maxnorm_mode=args.maxnormMode,
-                                      precision=args.precision)
+                                      precision=args.precision,
+                                      bn_mode=args.bnMode)
     subjects = (tuple(int(s) for s in args.subjects.split(","))
                 if args.subjects else tuple(range(1, 10)))
     if args.trainingType != "Within-Subject":

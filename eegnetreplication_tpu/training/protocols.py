@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import functools
 import hashlib
+import json
 import math
+import os
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable
@@ -106,6 +108,10 @@ class ProtocolResult:
     avg_test_acc: float
     best_states: list[Any]          # per-subject (WS) or single-element (CS)
     fold_test_acc: np.ndarray       # all folds' test accuracies
+    # Training wall only (chunked runs exclude the one-off test-set pass,
+    # which is logged separately; single-program runs compile eval into
+    # the fused program and cannot split it — BENCH_NOTES.md "metric
+    # definitions").  Basis of epoch_throughput.
     wall_seconds: float
     epochs: int
     subjects: tuple[int, ...] = tuple(range(1, 10))
@@ -116,6 +122,9 @@ class ProtocolResult:
     # Folds per compiled program this run ACTUALLY used (None = one fused
     # program): the CS auto resolution means the caller's argument is not
     # necessarily what ran — measurement artifacts should record this.
+    # The grouping this run STARTED with; a device fault mid-run halves
+    # later groups (see _run_folds), which the log and the per-device
+    # limit record capture.
     fold_batch: int | None = None
     # Per-fold min validation loss: continuous (unlike the coarsely
     # quantized accuracies), so measurement scripts can use it as
@@ -187,6 +196,13 @@ def _model_kwargs_for_precision(config: TrainingConfig) -> dict:
         "expected 'highest', 'high', 'default', or 'bf16'")
 
 
+def _model_kwargs_for_bn(config: TrainingConfig) -> dict:
+    """Model kwargs for the config's BatchNorm semantics.  "flax" (the
+    field default) passes nothing so every model accepts it; "torch"
+    requires an architecture that declares masked BN (EEGNet) and fails
+    loudly otherwise."""
+    return {} if config.bn_mode == "flax" else {"bn_mode": config.bn_mode}
+
 def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                config: TrainingConfig, epochs: int, seed: int, mesh=None,
                checkpoint_every: int | None = None,
@@ -194,7 +210,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                signature: dict | None = None,
                fold_batch: int | None = None,
                _states=None, _keys=None, _keep_snapshot: bool = False,
-               _crash_after_chunk: int | None = None):
+               _crash_after_chunk: int | None = None,
+               _fault_if_folds_over: int | None = None):
     """Train all folds fused; returns stacked FoldResult.
 
     ``checkpoint_every`` — ``0``: the whole run is ONE compiled program (the
@@ -219,8 +236,9 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     the 90-fold cross-subject segment faults a v5e chip that handles 36
     comfortably).  Ignored under a mesh (shard folds across devices
     instead).  ``_states``/``_keys``/``_keep_snapshot`` are internal to
-    that grouping; ``_crash_after_chunk`` is a test-only fault-injection
-    hook.
+    that grouping; ``_crash_after_chunk`` and ``_fault_if_folds_over``
+    (raise a synthetic accelerator fault for any program over N folds —
+    exercises the adaptive halving) are test-only fault-injection hooks.
     """
     # The protocol programs use the algebraically fused jnp eval path only;
     # the Pallas kernel stays out of these large scanned programs (it
@@ -273,8 +291,18 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                 "fused program, but only on a backend that can run it — "
                 "large cross-subject programs fault the v5e, which is why "
                 "grouping engaged.)", checkpoint_path, fold_batch)
-        for gi, lo in enumerate(range(0, n_folds, fold_batch)):
-            hi = min(lo + fold_batch, n_folds)
+        # Adaptive halving (VERDICT r4 weak #4): a fold_batch too large for
+        # THIS device generation faults the chip mid-group; instead of dying
+        # hours into a protocol, catch the accelerator-runtime fault, halve
+        # the group size, record the working size per device_kind (consulted
+        # by the next auto resolution), and continue from the same fold.
+        # Completed groups are kept; the failed group retrains at the
+        # smaller size (its snapshot signature carries fold_range, so a
+        # crashed-then-halved resume retrains the reshaped groups fresh).
+        gi, lo, cur_batch = 0, 0, fold_batch
+        halved = False  # a fault shrank cur_batch; record it once PROVEN
+        while lo < n_folds:
+            hi = min(lo + cur_batch, n_folds)
             logger.info("Training fold group %d: folds %d-%d of %d",
                         gi, lo, hi - 1, n_folds)
             gpath = (None if checkpoint_path is None
@@ -307,17 +335,39 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                         "training group %d fresh",
                         gpath, stored.get("fold_range"), [lo, hi], gi)
                     gresume = False
-            r, w, fe = _run_folds(
-                model, specs[lo:hi], pool_x, pool_y, config=config,
-                epochs=epochs, seed=seed, mesh=None,
-                checkpoint_every=checkpoint_every, checkpoint_path=gpath,
-                resume=gresume, signature=gsig,
-                _states=jax.tree_util.tree_map(lambda l: l[lo:hi], states),
-                _keys=keys[lo:hi], _keep_snapshot=True,
-                _crash_after_chunk=_crash_after_chunk)
+            try:
+                r, w, fe = _run_folds(
+                    model, specs[lo:hi], pool_x, pool_y, config=config,
+                    epochs=epochs, seed=seed, mesh=None,
+                    checkpoint_every=checkpoint_every, checkpoint_path=gpath,
+                    resume=gresume, signature=gsig,
+                    _states=jax.tree_util.tree_map(
+                        lambda l: l[lo:hi], states),
+                    _keys=keys[lo:hi], _keep_snapshot=True,
+                    _crash_after_chunk=_crash_after_chunk,
+                    _fault_if_folds_over=_fault_if_folds_over)
+            except Exception as exc:  # noqa: BLE001 — gated below
+                if cur_batch <= 1 or not _is_device_fault(exc):
+                    raise
+                cur_batch = max(1, cur_batch // 2)
+                halved = True
+                logger.warning(
+                    "Device fault training folds %d-%d (%s: %.160s) — "
+                    "halving the fold group to %d and retrying from fold "
+                    "%d", lo, hi - 1, type(exc).__name__, exc, cur_batch,
+                    lo)
+                continue
             group_results.append(r)
             wall += w
             fold_epochs += fe
+            lo, gi = hi, gi + 1
+            if halved:
+                # Only a size that COMPLETED a group is worth remembering
+                # (recording at fault time would let a transient
+                # preemption-style UNAVAILABLE ratchet the persisted limit
+                # down to a size never even tried — review r5).
+                _record_fold_batch_limit(cur_batch)
+                halved = False
         results = jax.tree_util.tree_map(
             lambda *leaves: jnp.concatenate(leaves, axis=0), *group_results)
         # All groups done: every snapshot at this path — this run's group
@@ -332,6 +382,12 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                         f"{n_folds} folds x {epochs} epochs in "
                         f"{len(group_results)} groups")
         return results, wall, fold_epochs
+
+    if _fault_if_folds_over is not None and n_folds > _fault_if_folds_over:
+        # Shaped like the measured v5e failure (UNAVAILABLE mid-group).
+        raise RuntimeError(
+            f"UNAVAILABLE: TPU device error (injected test fault: "
+            f"{n_folds} folds > {_fault_if_folds_over})")
 
     stacked = _stack_specs(specs)
 
@@ -437,24 +493,39 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                 return {k: v for k, v in (sig or {}).items()
                         if k != "pool_sha1"}
 
-            if (stored_sig is not None
-                    and stored_sig.get("pool_sha1")
-                    != signature.get("pool_sha1")
-                    and _sans_digest(stored_sig) == _sans_digest(signature)):
-                # Same run geometry, different (or pre-digest legacy) data
-                # content: resuming would splice two datasets' training
-                # histories — the graceful outcome is a fresh start, not a
-                # hard error (the rehearsal's auto --resume gate checks
-                # geometry only and relies on this downgrade).  Any OTHER
-                # signature mismatch still hard-fails in the loader below.
+            geometry_match = (stored_sig is not None
+                              and _sans_digest(stored_sig)
+                              == _sans_digest(signature))
+            if (geometry_match and "pool_sha1" in stored_sig
+                    and stored_sig["pool_sha1"]
+                    != signature.get("pool_sha1")):
+                # Same run geometry, BOTH digests present and different:
+                # resuming would splice two datasets' training histories —
+                # the graceful outcome is a fresh start, not a hard error
+                # (the rehearsal's auto --resume gate checks geometry only
+                # and relies on this downgrade).  Any OTHER signature
+                # mismatch still hard-fails in the loader below.
                 logger.warning(
                     "Resume: snapshot %s matches this run's geometry but "
                     "not its data content (pool digest %s vs %s) — "
                     "training from scratch", checkpoint_path,
                     stored_sig.get("pool_sha1"), signature.get("pool_sha1"))
             else:
+                resume_sig = signature
+                if geometry_match and "pool_sha1" not in stored_sig:
+                    # Pre-digest legacy snapshot: geometry verified,
+                    # content unverifiable.  Resume — silently discarding
+                    # an in-flight hours-long run on the first post-upgrade
+                    # invocation is worse than the unverifiable-content
+                    # risk; the fresh-start downgrade above is reserved
+                    # for a PROVEN content mismatch (ADVICE r4).
+                    logger.warning(
+                        "Resume: snapshot %s predates pool digests; "
+                        "resuming on geometry alone (content unverified)",
+                        checkpoint_path)
+                    resume_sig = _sans_digest(signature)
                 carry, stored, start_epoch = ckpt_lib.load_run_snapshot(
-                    checkpoint_path, carry, signature)
+                    checkpoint_path, carry, resume_sig)
                 for name in metrics:
                     metrics[name] = [stored[name]]
                 logger.info("Resuming from %s at epoch %d", checkpoint_path,
@@ -490,9 +561,17 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
 
     _, best_state, best_acc, min_loss = carry
     evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size)
-    with timer:
+    # Separate timer: fold-epochs/s and MFU measure TRAINING strictly;
+    # folding the one-off test-set pass into the same wall deflated them
+    # (VERDICT r4 weak #5).  The single-program path above cannot split
+    # (eval is compiled into the fused program) — see BENCH_NOTES.md for
+    # the metric definition.
+    eval_timer = StepTimer()
+    with eval_timer:
         test_acc = jax.block_until_ready(
             evaluator(pool_x, pool_y, stacked, best_state))
+    logger.info("Test-set evaluation: %.2fs (excluded from training "
+                "throughput)", eval_timer.total)
     wall = timer.total
 
     results = FoldResult(
@@ -679,7 +758,8 @@ def within_subject_training(epochs: int | None = None, *,
                             fold_batch: int | None = None,
                             checkpoint_every: int | None = None,
                             resume: bool = False,
-                            _crash_after_chunk: int | None = None) -> ProtocolResult:
+                            _crash_after_chunk: int | None = None,
+                            _fault_if_folds_over: int | None = None) -> ProtocolResult:
     """Within-subject protocol: per subject, 4-fold CV over both sessions."""
     _check_ckpt_format(ckpt_format)
     epochs = epochs if epochs is not None else config.epochs
@@ -694,7 +774,8 @@ def within_subject_training(epochs: int | None = None, *,
     model = get_model(model_name, n_channels=n_ch, n_times=n_t,
                       dropout_rate=config.dropout_within_subject,
                       **_model_kwargs_for_mesh(mesh),
-                      **_model_kwargs_for_precision(config))
+                      **_model_kwargs_for_precision(config),
+                      **_model_kwargs_for_bn(config))
 
     # Build the 4 folds per subject (reference fold order preserved).
     raw_folds: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
@@ -723,7 +804,8 @@ def within_subject_training(epochs: int | None = None, *,
         resume=resume,
         signature={"protocol": "within_subject", "model": model_name,
                    "subjects": list(subjects)},
-        _crash_after_chunk=_crash_after_chunk)
+        _crash_after_chunk=_crash_after_chunk,
+        _fault_if_folds_over=_fault_if_folds_over)
 
     fold_test = np.asarray(results.test_accuracy)  # (n_subjects*4,)
     fold_best_val = np.asarray(results.best_val_acc)
@@ -752,6 +834,73 @@ def within_subject_training(epochs: int | None = None, *,
                           fold_min_val_loss=np.asarray(results.min_val_loss))
 
 
+def _is_device_fault(exc: BaseException) -> bool:
+    """True for accelerator-runtime faults worth retrying with a smaller
+    program — the measured v5e failure mode is ``UNAVAILABLE: TPU device
+    error`` ~200-260 s into a 30+-fold CS group's compile/run.
+    Deliberately narrow: Python-level errors (bad arguments, the injected
+    ``_crash_after_chunk`` test crash) must propagate.  XlaRuntimeError
+    subclasses RuntimeError, so the message tokens do the discrimination.
+    """
+    if not isinstance(exc, RuntimeError):
+        return False
+    msg = str(exc)
+    return any(tok in msg for tok in
+               ("UNAVAILABLE", "RESOURCE_EXHAUSTED", "TPU device",
+                "device error", "DATA_LOSS"))
+
+
+def _fold_batch_limit_path() -> Path:
+    """Per-user record of the discovered per-device-kind fold-group limit
+    (same uid-suffix convention as the probe/compile caches)."""
+    uid = os.getuid() if hasattr(os, "getuid") else "u"
+    return Path(f"/tmp/eegtpu_fold_batch.{uid}.json")
+
+
+# A recorded limit older than this is ignored: one transient fault must
+# not pessimize every future run on this device generation forever.
+_FOLD_BATCH_LIMIT_TTL_S = 30 * 24 * 3600.0
+
+
+def _record_fold_batch_limit(limit: int) -> None:
+    """Persist a fold-group size that COMPLETED a group after fault-halving,
+    keyed by ``device_kind`` — the next auto resolution on this device
+    generation starts there instead of re-faulting (VERDICT r4 weak #4:
+    the 15 was a single-device-kind constant with no adaptive path).
+    Overwrites (latest proven value wins — a stale small limit from a
+    transient fault is replaced, not min'd); entries expire after
+    :data:`_FOLD_BATCH_LIMIT_TTL_S`.  Best-effort."""
+    import time
+
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", jax.default_backend())
+        path = _fold_batch_limit_path()
+        data = {}
+        if path.exists():
+            data = json.loads(path.read_text())
+        data[kind] = {"limit": int(limit), "t": time.time()}
+        path.write_text(json.dumps(data))
+    except Exception:  # noqa: BLE001 — the record is an optimization only
+        pass
+
+
+def _known_fold_batch_limit() -> int | None:
+    """The recorded proven group size for this device_kind, or None."""
+    import time
+
+    try:
+        kind = getattr(jax.devices()[0], "device_kind", jax.default_backend())
+        data = json.loads(_fold_batch_limit_path().read_text())
+        rec = data.get(kind)
+        if (isinstance(rec, dict) and isinstance(rec.get("limit"), int)
+                and rec["limit"] > 0
+                and time.time() - rec.get("t", 0) < _FOLD_BATCH_LIMIT_TTL_S):
+            return rec["limit"]
+        return None
+    except Exception:  # noqa: BLE001 — no record = no opinion
+        return None
+
+
 def _effective_fold_batch(fold_batch, mesh, n_folds: int) -> int | None:
     """The grouping :func:`_run_folds` ACTUALLY uses: ``None`` (one fused
     program) under a mesh, for the 0 opt-out, and when the fold count fits
@@ -776,14 +925,19 @@ def _cs_auto_fold_batch(n_folds: int, mesh, fold_batch: int | None):
         return None
     if fold_batch is not None:
         return fold_batch
-    if mesh is None and n_folds > CS_ACCEL_FOLD_BATCH:
-        if jax.default_backend() != "cpu":
+    if mesh is None and jax.default_backend() != "cpu":
+        # A previously discovered per-device_kind limit (written by the
+        # adaptive halving after a real fault) overrides the v5e-measured
+        # default; either way larger programs fault-halve at runtime.
+        batch = min(CS_ACCEL_FOLD_BATCH, _known_fold_batch_limit()
+                    or CS_ACCEL_FOLD_BATCH)
+        if n_folds > batch:
             logger.info(
                 "Auto fold batching: %d folds per compiled program on %s "
                 "(larger CS programs fault the device; --maxFoldsPerProgram "
                 "overrides, 0 forces one program)",
-                CS_ACCEL_FOLD_BATCH, jax.default_backend())
-            return CS_ACCEL_FOLD_BATCH
+                batch, jax.default_backend())
+            return batch
     return None
 
 
@@ -799,7 +953,8 @@ def cross_subject_training(epochs: int | None = None, *,
                            fold_batch: int | None = None,
                            checkpoint_every: int | None = None,
                            resume: bool = False,
-                           _crash_after_chunk: int | None = None) -> ProtocolResult:
+                           _crash_after_chunk: int | None = None,
+                           _fault_if_folds_over: int | None = None) -> ProtocolResult:
     """Cross-subject protocol: 5-train/3-val/1-test subjects, 10 repeats."""
     _check_ckpt_format(ckpt_format)
     epochs = epochs if epochs is not None else config.epochs
@@ -823,7 +978,8 @@ def cross_subject_training(epochs: int | None = None, *,
     model = get_model(model_name, n_channels=n_ch, n_times=n_t,
                       dropout_rate=config.dropout_cross_subject,
                       **_model_kwargs_for_mesh(mesh),
-                      **_model_kwargs_for_precision(config))
+                      **_model_kwargs_for_precision(config),
+                      **_model_kwargs_for_bn(config))
 
     raw_folds = []
     fold_count = 0
@@ -854,7 +1010,8 @@ def cross_subject_training(epochs: int | None = None, *,
         resume=resume,
         signature={"protocol": "cross_subject", "model": model_name,
                    "subjects": list(subjects)},
-        _crash_after_chunk=_crash_after_chunk)
+        _crash_after_chunk=_crash_after_chunk,
+        _fault_if_folds_over=_fault_if_folds_over)
 
     fold_test = np.asarray(results.test_accuracy)
     min_val_loss = np.asarray(results.min_val_loss)

@@ -121,13 +121,18 @@ def weighted_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
 
 
 def apply_model(model, params, batch_stats, x, *, train: bool,
-                dropout_rng=None):
-    """Forward pass; returns (logits, new_batch_stats)."""
+                dropout_rng=None, sample_weights=None):
+    """Forward pass; returns (logits, new_batch_stats).
+
+    ``sample_weights`` (train only) marks padded batch slots so masked
+    BatchNorm (``bn_mode="torch"``) can exclude them from its statistics;
+    models without masked BN accept and ignore it.
+    """
     variables = {"params": params, "batch_stats": batch_stats}
     if train:
         logits, updates = model.apply(
-            variables, x, train=True, mutable=["batch_stats"],
-            rngs={"dropout": dropout_rng},
+            variables, x, train=True, sample_weights=sample_weights,
+            mutable=["batch_stats"], rngs={"dropout": dropout_rng},
         )
         return logits, updates["batch_stats"]
     logits = model.apply(variables, x, train=False)
@@ -156,7 +161,8 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
 
     def loss_fn(params):
         logits, new_bs = apply_model(model, params, state.batch_stats, x,
-                                     train=True, dropout_rng=dropout_rng)
+                                     train=True, dropout_rng=dropout_rng,
+                                     sample_weights=w)
         return weighted_cross_entropy(logits, y, w, data_axis), new_bs
 
     (loss, new_bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(state.params)

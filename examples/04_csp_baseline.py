@@ -1,12 +1,14 @@
-"""Classical-baseline comparison: CSP+LDA vs EEGNet, per subject.
+"""Classical-baseline comparison: CSP+LDA and Riemannian tangent-space
+vs EEGNet, per subject.
 
 Script equivalent of the reference's baseline study
 (``notebooks/01_explore_data.ipynb`` cells 11-18 and ``notebooks/03``), which
 benchmarks EEGNet against moabb/pyriemann classical pipelines (CSP+LDA,
 tangent-space classifiers).  Those stacks are unavailable (and CPU-bound)
-here; the same comparison runs on the JAX-native CSP+LDA implementation
-(``models/csp.py``) — every fold's fit+predict is one XLA program, vmapped
-across folds.
+here; the same comparison runs on the JAX-native implementations
+(``models/csp.py``, ``models/riemann.py`` — SPD covariances -> Karcher-mean
+tangent space -> LDA) — every fold's fit+predict is one XLA program,
+vmapped across folds.
 
 With real preprocessed data under ``data/processed`` it compares on the real
 within-subject task (Train+Eval pooled, KFold(4, seed 42), like
@@ -32,6 +34,7 @@ import jax.numpy as jnp
 
 from eegnetreplication_tpu.data.splits import kfold_indices
 from eegnetreplication_tpu.models.csp import csp_lda_fit_predict
+from eegnetreplication_tpu.models.riemann import tangent_lda_fit_predict
 from eegnetreplication_tpu.utils.logging import logger
 
 
@@ -73,8 +76,9 @@ def load_subject(subject: int):
         return X, y, "synthetic"
 
 
-def csp_lda_cv(X, y, n_splits=4, seed=42) -> float:
-    """Mean KFold test accuracy of CSP+LDA, all folds in one vmap.
+def classical_cv(X, y, n_splits=4, seed=42) -> dict:
+    """Mean KFold test accuracy of CSP+LDA and tangent-space+LDA, each
+    with all folds in one vmap.
 
     Ragged folds (n not divisible by n_splits) are handled the same way the
     training engine's FoldSpec does: wraparound padding to a common static
@@ -96,13 +100,17 @@ def csp_lda_cv(X, y, n_splits=4, seed=42) -> float:
     te_w = jnp.stack([jnp.asarray(p[1]) for p in te_parts])
     Xd, yd = jnp.asarray(X), jnp.asarray(y)
 
-    preds = jax.vmap(
-        lambda tr, te: csp_lda_fit_predict(Xd[tr], yd[tr], Xd[te])
-    )(tr_idx, te_idx)
-    accs = jax.vmap(
-        lambda p, te, w: 100.0 * jnp.sum((p == yd[te]) * w) / jnp.sum(w)
-    )(preds, te_idx, te_w)
-    return float(jnp.mean(accs))
+    accs = {}
+    for name, pipeline in (("csp", csp_lda_fit_predict),
+                           ("riemann", tangent_lda_fit_predict)):
+        preds = jax.vmap(
+            lambda tr, te: pipeline(Xd[tr], yd[tr], Xd[te])
+        )(tr_idx, te_idx)
+        fold_accs = jax.vmap(
+            lambda p, te, w: 100.0 * jnp.sum((p == yd[te]) * w) / jnp.sum(w)
+        )(preds, te_idx, te_w)
+        accs[name] = float(jnp.mean(fold_accs))
+    return accs
 
 
 def eegnet_cv(X, y, epochs: int) -> float:
@@ -129,17 +137,22 @@ def main() -> None:
     rows = []
     for s in subjects:
         X, y, origin = load_subject(s)
-        acc_csp = csp_lda_cv(X, y)
+        classical = classical_cv(X, y)
         acc_net = eegnet_cv(X, y, epochs)
-        rows.append((s, origin, acc_csp, acc_net))
-        logger.info("Subject %d (%s): CSP+LDA %.2f%% | EEGNet %.2f%%",
-                    s, origin, acc_csp, acc_net)
+        rows.append((s, origin, classical["csp"], classical["riemann"],
+                     acc_net))
+        logger.info(
+            "Subject %d (%s): CSP+LDA %.2f%% | tangent-LDA %.2f%% | "
+            "EEGNet %.2f%%", s, origin, classical["csp"],
+            classical["riemann"], acc_net)
 
-    print(f"\n{'subject':>8} {'data':>10} {'CSP+LDA':>10} {'EEGNet':>10}")
-    for s, origin, a, b in rows:
-        print(f"{s:>8} {origin:>10} {a:>9.2f}% {b:>9.2f}%")
-    print(f"{'mean':>8} {'':>10} {np.mean([r[2] for r in rows]):>9.2f}% "
-          f"{np.mean([r[3] for r in rows]):>9.2f}%")
+    print(f"\n{'subject':>8} {'data':>10} {'CSP+LDA':>10} "
+          f"{'tangent-LDA':>12} {'EEGNet':>10}")
+    for s, origin, a, r, b in rows:
+        print(f"{s:>8} {origin:>10} {a:>9.2f}% {r:>11.2f}% {b:>9.2f}%")
+    print(f"{'mean':>8} {'':>10} {np.mean([x[2] for x in rows]):>9.2f}% "
+          f"{np.mean([x[3] for x in rows]):>11.2f}% "
+          f"{np.mean([x[4] for x in rows]):>9.2f}%")
 
 
 if __name__ == "__main__":

@@ -45,6 +45,14 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpointEvery", type=int, default=50)
     parser.add_argument("--trials", type=int, default=288,
                         help="Trials per session (competition: 288).")
+    parser.add_argument("--pool", default=None,
+                        help="Path to an equiv_task pool (.npz): trains on "
+                             "the NON-saturating task instead of the easy "
+                             "synthetic loader.  The easy task drives every "
+                             "fold's min val loss to exactly 0.0, which "
+                             "collapses the distinct-val-loss freshness "
+                             "evidence (measured 2026-08-01: "
+                             "distinct_fold_val_losses=1 at 90x500).")
     args = parser.parse_args(argv)
 
     out = Path(args.out)
@@ -58,11 +66,26 @@ def main(argv=None) -> int:
 
     platform = select_platform()
     sys.path.insert(0, str(REPO / "tests"))
-    from synthetic import make_loader
+    if args.pool:
+        sys.path.insert(0, str(REPO / "scripts"))
+        import equiv_task
 
-    loader = make_loader(n_trials=args.trials, n_channels=22, n_times=257,
-                         class_sep=1.0)
+        from eegnetreplication_tpu.data.containers import BCICI2ADataset
+
+        pool_loader = equiv_task.load_pool(Path(args.pool))
+        # Record the pool's REAL per-session trial count, not --trials.
+        args.trials = int(np.asarray(pool_loader(1, "Train")[1]).shape[0])
+
+        def loader(subject: int, mode: str) -> BCICI2ADataset:
+            x, y = pool_loader(subject, mode)
+            return BCICI2ADataset(X=np.asarray(x), y=np.asarray(y))
+    else:
+        from synthetic import make_loader
+
+        loader = make_loader(n_trials=args.trials, n_channels=22,
+                             n_times=257, class_sep=1.0)
     record = {"platform": platform, "epochs": args.epochs,
+              "pool": args.pool,
               "fold_batch_arg": args.foldBatch,
               "checkpoint_every": args.checkpointEvery,
               "trials_per_session": args.trials,

