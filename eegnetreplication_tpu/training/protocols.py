@@ -72,15 +72,18 @@ AUTO_CHUNK_THRESHOLD = 100
 AUTO_CHUNK_EPOCHS = 50
 
 # Auto fold-batching for the cross-subject protocol on accelerator
-# backends.  Measured on the tunneled TPU v5e (2026-07-31): 90-, 45- and
-# 30-fold CS programs all fault the device (``UNAVAILABLE: TPU device
-# error`` ~200-260 s in, during/after the group's first compile) while
-# 15-fold groups run the full 90x500 protocol to completion.  The CS
-# per-fold program is ~6x the within-subject one (45 train batches per
-# epoch vs 7), which is why WS runs 36 folds comfortably in one program
-# and CS cannot.  ``fold_batch=None`` therefore defaults to this group
-# size for CS runs on a non-CPU backend; pass ``fold_batch=0``
-# (``--maxFoldsPerProgram 0``) to force one fused program.
+# backends.  History: under the LAX conv schedule, 90-, 45- and 30-fold
+# CS programs all faulted the tunneled v5e (``UNAVAILABLE: TPU device
+# error`` ~200-260 s in; measured 2026-07-31) while 15-fold groups
+# completed — root-caused 2026-08-01 (BENCH_CS_FOLDBATCH_PROBE.json):
+# with the banded conv schedule, 30-fold groups AND the full 90-fold
+# single program now complete on the same chip, so the faults were the
+# vmapped-grouped-conv lowering's program/memory footprint, not a chip
+# fold limit.  15 is retained as the measured THROUGHPUT optimum
+# (83.6 vs 76.9 @30 vs 51.5 @90 fold-epochs/s at 500/100 epochs); on
+# other device generations the fault-halving path (below) and the
+# per-device_kind proven-limit record adapt automatically.  Pass
+# ``fold_batch=0`` (``--maxFoldsPerProgram 0``) to force one program.
 CS_ACCEL_FOLD_BATCH = 15
 
 
