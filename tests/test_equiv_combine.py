@@ -1,0 +1,111 @@
+"""Unit tests of the multi-seed equivalence combiner's statistics.
+
+The combiner (`scripts/equiv_combine.py`) produces the round-5 equivalence
+verdicts; its sign test, Welch CI, and guard rails are load-bearing for
+`EQUIV_WS_MULTISEED.json` and are pinned here on constructed records.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import pytest
+
+SCRIPTS = Path(__file__).resolve().parents[1] / "scripts"
+sys.path.insert(0, str(SCRIPTS))
+
+import equiv_combine  # noqa: E402
+
+
+def _write_records(tmp_path, arm, accs_by_seed, epochs=100):
+    """accs_by_seed: list (one per seed) of dicts subject -> acc."""
+    for i, accs in enumerate(accs_by_seed):
+        rec = {"epochs": epochs,
+               "per_subject": {str(s): {"test_acc": a}
+                               for s, a in accs.items()}}
+        (tmp_path / f"{arm}_{i}.json").write_text(json.dumps(rec))
+    return str(tmp_path / f"{arm}_*.json")
+
+
+class TestSignTest:
+    def test_exact_binomial_values(self):
+        # 7-of-7 one-signed: classic p = 2 * (1/2)^7
+        assert equiv_combine._binom_two_sided_p(7, 7) == pytest.approx(
+            2 * 0.5 ** 7)
+        # balanced: p caps at 1
+        assert equiv_combine._binom_two_sided_p(4, 9) == pytest.approx(
+            1.0, abs=0.35)
+        assert equiv_combine._binom_two_sided_p(0, 0) == 1.0
+
+    def test_ties_drop_out(self, tmp_path, capsys):
+        """Exact-zero deltas are ties: 4 negative + 2 zero must be tested
+        as 4-of-4, not 4-of-6 (review r5)."""
+        base = {s: 60.0 for s in range(1, 7)}
+        shifted = {**base, **{s: 62.0 for s in (1, 2, 3, 4)}}
+        fw = _write_records(tmp_path, "fw", [base] * 3)
+        th = _write_records(tmp_path, "th", [shifted] * 3)
+        out = tmp_path / "out.json"
+        equiv_combine.main(["--framework", fw, "--torch", th,
+                            "--out", str(out)])
+        rec = json.loads(out.read_text())
+        assert rec["subjects_delta_zero"] == 2
+        assert rec["subjects_delta_negative"] == 4
+        assert rec["sign_test_p"] == pytest.approx(2 * 0.5 ** 4)
+
+
+class TestVerdicts:
+    def test_tost_needs_containment_not_overlap(self, tmp_path):
+        """A noisy arm whose CI straddles far past +-1 pp must NOT claim
+        equivalent_1pp (review r5: overlap rewards noise)."""
+        import numpy as np
+
+        rng = np.random.RandomState(0)
+        fw_seeds = [{1: 60.0 + 8 * rng.randn()} for _ in range(3)]
+        th_seeds = [{1: 60.0 + 8 * rng.randn()} for _ in range(3)]
+        fw = _write_records(tmp_path, "fw", fw_seeds)
+        th = _write_records(tmp_path, "th", th_seeds)
+        out = tmp_path / "out.json"
+        equiv_combine.main(["--framework", fw, "--torch", th,
+                            "--out", str(out)])
+        rec = json.loads(out.read_text())
+        ci = rec["per_subject"]["1"]["delta_ci95"]
+        assert ci[1] - ci[0] > 2.0  # wide CI by construction
+        assert rec["equivalent_1pp"] is False
+        assert rec["consistent_with_1pp"] is True
+
+    def test_identical_arms_degenerate_flagged(self, tmp_path):
+        same = [{1: 70.0, 2: 55.0}] * 3
+        fw = _write_records(tmp_path, "fw", same)
+        th = _write_records(tmp_path, "th", same)
+        out = tmp_path / "out.json"
+        equiv_combine.main(["--framework", fw, "--torch", th,
+                            "--out", str(out)])
+        rec = json.loads(out.read_text())
+        assert all(v["degenerate_variance"]
+                   for v in rec["per_subject"].values())
+        assert rec["subjects_delta_zero"] == 2
+
+
+class TestGuards:
+    def test_min_seeds_enforced(self, tmp_path):
+        fw = _write_records(tmp_path, "fw", [{1: 60.0}] * 2)
+        th = _write_records(tmp_path, "th", [{1: 60.0}] * 3)
+        with pytest.raises(SystemExit, match="multi-seed design"):
+            equiv_combine.main(["--framework", fw, "--torch", th,
+                                "--out", str(tmp_path / "o.json")])
+
+    def test_cross_arm_epoch_mismatch_rejected(self, tmp_path):
+        fw = _write_records(tmp_path, "fw", [{1: 60.0}] * 3, epochs=200)
+        th = _write_records(tmp_path, "th", [{1: 60.0}] * 3, epochs=100)
+        with pytest.raises(SystemExit, match="arms trained differently"):
+            equiv_combine.main(["--framework", fw, "--torch", th,
+                                "--out", str(tmp_path / "o.json")])
+
+    def test_missing_subject_rejected(self, tmp_path):
+        fw = _write_records(tmp_path, "fw", [{1: 60.0, 2: 50.0}] * 3)
+        th = _write_records(tmp_path, "th",
+                            [{1: 60.0, 2: 50.0}, {1: 60.0, 2: 50.0},
+                             {1: 60.0}])
+        with pytest.raises(SystemExit, match="missing subjects"):
+            equiv_combine.main(["--framework", fw, "--torch", th,
+                                "--out", str(tmp_path / "o.json")])
