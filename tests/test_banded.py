@@ -211,3 +211,53 @@ class TestModelParity:
         # Distinct per-fold inits must stay distinct after the step.
         k0 = np.asarray(new_states.params["temporal_conv"]["kernel"])
         assert not np.allclose(k0[0], k0[1])
+
+
+class TestAutoResolution:
+    """conv_impl='auto' resolves at CONSTRUCTION (ADVICE r4): the resolved
+    schedule enters the module's hash/equality so jit caches cannot
+    conflate programs compiled under different env values, and 'auto'
+    guards against banded's O(T^2) expansion at long T."""
+
+    def test_auto_resolves_to_banded_at_protocol_length(self):
+        m = EEGNet(n_channels=22, n_times=257)
+        assert m.conv_impl == "banded"
+
+    def test_auto_falls_back_to_lax_past_the_t_cap(self):
+        """At native 250 Hz length (T=1125) banded would pay ~35x MACs and
+        a ~166 MB jit constant; 'auto' must pick lax there."""
+        m = EEGNet(n_channels=22, n_times=1125)
+        assert m.conv_impl == "lax"
+        assert EEGNet.BANDED_AUTO_MAX_T < 1125
+
+    def test_explicit_banded_honored_at_any_t(self):
+        m = EEGNet(n_channels=22, n_times=1125, conv_impl="banded")
+        assert m.conv_impl == "banded"
+
+    def test_env_override_applies_at_construction(self, monkeypatch):
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "lax")
+        assert EEGNet(n_channels=22, n_times=257).conv_impl == "lax"
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "banded")
+        assert EEGNet(n_channels=22, n_times=1125).conv_impl == "banded"
+        # Env changes cannot retarget an ALREADY-constructed module.
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "banded")
+        m = EEGNet(n_channels=22, n_times=257)
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "lax")
+        assert m.conv_impl == "banded"
+
+    def test_modules_under_different_env_values_are_unequal(self,
+                                                            monkeypatch):
+        """The jit-cache hazard itself: two 'auto' modules constructed
+        under different env values must not compare equal."""
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "banded")
+        a = EEGNet(n_channels=C, n_times=T)
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "lax")
+        b = EEGNet(n_channels=C, n_times=T)
+        assert a != b
+
+    def test_invalid_values_rejected(self, monkeypatch):
+        with pytest.raises(ValueError, match="conv_impl"):
+            EEGNet(conv_impl="cudnn")
+        monkeypatch.setenv("EEGTPU_CONV_IMPL", "winograd")
+        with pytest.raises(ValueError, match="conv_impl"):
+            EEGNet(n_channels=C, n_times=T)
