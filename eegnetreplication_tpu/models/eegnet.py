@@ -115,17 +115,18 @@ class EEGNet(nn.Module):
     bn_axis_name: str | None = None
     # Conv op schedule: "banded" computes every conv as banded/batched
     # matmuls (``ops/banded.py``), "lax" uses ``lax.conv_general_dilated``
-    # (minimal FLOPs).  "auto" resolves to banded up to
-    # ``BANDED_AUTO_MAX_T`` timesteps: the banded form was built for the
-    # TPU's MXU (vmapped grouped convs with per-fold kernels lower to
-    # <0.1% MFU there), measured 8.9x faster on CPU too, with 3.7x faster
-    # compiles — XLA's batched-grouped-conv lowering is the bottleneck
-    # everywhere, and the deliberate ~T/K MAC inflation is cheaper than
-    # that lowering at protocol sizes (T=257: ~8x, BENCH_NOTES.md round
-    # 4).  The inflation and the O(K*T^2) expansion constant grow with T,
-    # so past the cap "auto" falls back to lax (at native 250 Hz length
-    # T=1125 banded would pay ~35x MACs and a ~166 MB jit constant);
-    # explicit ``conv_impl="banded"`` still honors the request at any T.
+    # (minimal FLOPs).  "auto" resolves to banded at EVERY length: the
+    # banded form was built for the TPU's MXU (vmapped grouped convs with
+    # per-fold kernels lower to <0.1% MFU there; on-chip A/B at protocol
+    # length T=257: 5.37x, BENCH_CONV_AB.json), measured 8.9x faster on
+    # CPU too, with 3.7x faster compiles.  Past ``ops.banded.
+    # BANDED_TILE_T`` outputs the banded ops TILE the time axis (one
+    # shared per-tile band: O(K*tile^2) memory and ~tile/K MAC inflation
+    # INDEPENDENT of T), so long sequences keep the MXU schedule instead
+    # of falling off an O(T^2) cliff — measured on chip at native 250 Hz
+    # length T=1125: tiled-banded 4.94x lax with 5x faster compiles
+    # (BENCH_LONGT_AB.json; the r4 ADVICE T-cap is dissolved by tiling,
+    # not guarded by a fallback).
     # ``EEGTPU_CONV_IMPL`` overrides "auto" for A/B measurement; explicit
     # construction wins over both.  "auto" is resolved ONCE at module
     # construction (the resolved schedule participates in the module's
@@ -143,11 +144,6 @@ class EEGNet(nn.Module):
     # the measured accuracy effect.
     bn_mode: str = "flax"
 
-    # Above this n_times, "auto" prefers lax: banded's MAC inflation is
-    # ~T/32 and its expansion constant ~4*32*T^2 bytes; 512 caps them at
-    # 16x and ~36 MB.
-    BANDED_AUTO_MAX_T = 512
-
     @property
     def F2(self) -> int:
         return self.F1 * self.D
@@ -158,10 +154,9 @@ class EEGNet(nn.Module):
             # constructed conv_impl (e.g. the parity tests' lax-vs-banded
             # pairs) must not be silently redirected by ambient shell
             # state.  Env "auto" (resetting the override) = the default.
-            impl = os.environ.get("EEGTPU_CONV_IMPL") or "auto"
+            impl = os.environ.get("EEGTPU_CONV_IMPL") or "banded"
             if impl == "auto":
-                impl = ("banded" if self.n_times <= self.BANDED_AUTO_MAX_T
-                        else "lax")
+                impl = "banded"
             object.__setattr__(self, "conv_impl", impl)
         if self.conv_impl not in ("banded", "lax"):
             raise ValueError(

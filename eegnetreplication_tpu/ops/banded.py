@@ -39,9 +39,19 @@ f32 summation order; parity is pinned by ``tests/test_banded.py``.
 from __future__ import annotations
 
 import functools
+import math
 
 import jax.numpy as jnp
 import numpy as np
+
+# Above this output length the banded ops switch to the TILED formulation:
+# the band matrix is built once per TILE (O(K * TILE^2) constant, ~8.4 MB
+# at 256) instead of per full length (O(K * T^2), ~166 MB at T=1125), and
+# the MAC inflation stays ~TILE/K regardless of T.  Long recordings (the
+# native 250 Hz BCI-IV-2a length and beyond) therefore keep the MXU
+# schedule with bounded memory.
+BANDED_TILE_T = 512
+_TILE = 256
 
 
 @functools.lru_cache(maxsize=32)
@@ -69,11 +79,58 @@ def conv1d_same_banded(x_pad: jnp.ndarray, taps: jnp.ndarray, t_out: int,
         t_out: output length T.
     Returns:
         ``(..., T, F)``.
+
+    Past :data:`BANDED_TILE_T` outputs, dispatches to the tiled
+    formulation (same math, bounded memory and MAC inflation).
     """
+    if t_out > BANDED_TILE_T:
+        return conv1d_same_banded_tiled(x_pad, taps, t_out,
+                                        precision=precision)
     k = taps.shape[0]
     e = jnp.asarray(_expansion_host(k, t_out), dtype=taps.dtype)
     band = jnp.einsum("kpt,kf->ptf", e, taps, precision=precision)
     return jnp.einsum("...p,ptf->...tf", x_pad, band, precision=precision)
+
+
+def _tile_windows(x_pad: jnp.ndarray, k: int, t_out: int,
+                  tile: int) -> jnp.ndarray:
+    """Overlapping output-tile windows ``(..., n_tiles, tile + K - 1)``.
+
+    Output position ``t`` of a SAME conv reads ``x_pad[t : t + K]``; the
+    tile of outputs ``[i*tile, (i+1)*tile)`` therefore reads the window
+    ``x_pad[i*tile : i*tile + tile + K - 1]``.  Windows are static slices
+    (n_tiles is a trace-time constant), so the VJP is XLA's add-to-slice
+    overlap-add — no gather/scatter.
+    """
+    n_tiles = math.ceil(t_out / tile)
+    full = n_tiles * tile + k - 1
+    pad = [(0, 0)] * (x_pad.ndim - 1) + [(0, full - x_pad.shape[-1])]
+    xp = jnp.pad(x_pad, pad)
+    return jnp.stack(
+        [xp[..., i * tile: i * tile + tile + k - 1]
+         for i in range(n_tiles)], axis=-2)
+
+
+def conv1d_same_banded_tiled(x_pad: jnp.ndarray, taps: jnp.ndarray,
+                             t_out: int, tile: int = _TILE,
+                             precision=None) -> jnp.ndarray:
+    """Tiled twin of :func:`conv1d_same_banded` for long sequences.
+
+    One ``(tile + K - 1, tile)`` band matrix is shared by every tile, so
+    memory is O(K * tile^2) and MAC inflation ~tile/K *independent of T*
+    — the MXU formulation extends to arbitrarily long time axes (native
+    250 Hz recordings and beyond) instead of falling off an O(T^2)
+    cliff.  Numerics match the untiled form exactly (same taps, same
+    zero padding; only the summation tiling differs).
+    """
+    k = taps.shape[0]
+    windows = _tile_windows(x_pad, k, t_out, tile)   # (..., n, tile+k-1)
+    e = jnp.asarray(_expansion_host(k, tile), dtype=taps.dtype)
+    band = jnp.einsum("kpt,kf->ptf", e, taps, precision=precision)
+    out = jnp.einsum("...np,ptf->...ntf", windows, band,
+                     precision=precision)
+    shape = out.shape[:-3] + (windows.shape[-2] * tile, taps.shape[1])
+    return out.reshape(shape)[..., :t_out, :]
 
 
 def same_pad_1d(x: jnp.ndarray, k: int) -> jnp.ndarray:
@@ -126,6 +183,15 @@ def depthwise_conv_banded(x: jnp.ndarray, kernel: jnp.ndarray,
     k = taps.shape[0]
     t = x.shape[2]
     xp = same_pad_1d(jnp.swapaxes(x[:, 0], 1, 2), k)   # (B, F2, P)
+    if t > BANDED_TILE_T:
+        windows = _tile_windows(xp, k, t, _TILE)   # (B, F2, n, tile+k-1)
+        e = jnp.asarray(_expansion_host(k, _TILE), dtype=taps.dtype)
+        band = jnp.einsum("kpt,kf->fpt", e, taps, precision=precision)
+        h = jnp.einsum("bfnp,fpt->bntf", windows, band,
+                       precision=precision)
+        h = h.reshape(x.shape[0], windows.shape[-2] * _TILE,
+                      taps.shape[1])[:, :t]
+        return h[:, None]
     e = jnp.asarray(_expansion_host(k, t), dtype=taps.dtype)
     band = jnp.einsum("kpt,kf->fpt", e, taps, precision=precision)
     h = jnp.einsum("bfp,fpt->btf", xp, band, precision=precision)
