@@ -582,9 +582,13 @@ def _write_last_onchip(record: dict) -> None:
 
     A later CPU-fallback line embeds this as ``last_onchip`` so the
     artifact is self-explaining about what the chip measured most
-    recently — informational only, never the headline value.
+    recently — informational only, never the headline value.  Written
+    through the shared telemetry schema writer (``obs/schema.py``:
+    validated envelope + atomic replace) like every other BENCH artifact.
     """
     try:
+        from eegnetreplication_tpu.obs import schema as obs_schema
+
         entry = {
             "value": record.get("value"),
             "unit": record.get("unit"),
@@ -592,12 +596,8 @@ def _write_last_onchip(record: dict) -> None:
             "platform": record.get("platform"),
             "compile_s": record.get("compile_s"),
             "train_mfu_pct": record.get("train_mfu_pct"),
-            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         }
-        tmp = f"{_ONCHIP_LAST_PATH}.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump(entry, f)
-        os.replace(tmp, _ONCHIP_LAST_PATH)
+        obs_schema.write_json_artifact(_ONCHIP_LAST_PATH, entry, kind="bench")
     except Exception:  # noqa: BLE001
         pass
 
@@ -703,7 +703,13 @@ def _attempt_late_tpu_promotion(record: dict, deadline_s: float,
 
 def main() -> None:
     """Run the bench; ALWAYS print exactly one JSON line on stdout."""
+    from eegnetreplication_tpu.obs import schema as obs_schema
+
     record = {
+        # Telemetry-schema envelope (obs/schema.py): the stdout line and
+        # every BENCH_*.json written from it validate the same way.
+        "schema_version": obs_schema.SCHEMA_VERSION,
+        "utc": obs_schema.utc_now(),
         "metric": "within_subject_training_throughput",
         "value": 0.0,
         "unit": "fold-epochs/s",

@@ -76,6 +76,11 @@ class TorchBatchNorm(nn.Module):
             if sample_weights is None:
                 w = jnp.ones((x.shape[0],), jnp.float32)
             else:
+                # ``sample_weights`` is a 0/1 padding mask (is this batch
+                # slot a real trial?), NOT an importance weight: the ``> 0``
+                # threshold deliberately discards any magnitude so every
+                # real sample contributes to the statistics equally, like
+                # torch BN over an unpadded batch.
                 w = (sample_weights > 0).astype(jnp.float32)
             # Per-feature weighted sums; each batch sample contributes its
             # H*W spatial positions, like torch's reduction over (B, H, W).

@@ -1,0 +1,46 @@
+"""Structured telemetry: run journal, metrics registry, artifact schema.
+
+One schema'd pipeline replacing the framework's three ad-hoc measurement
+paths (stdlib log lines, ``StepTimer`` sums, hand-built JSON dicts):
+
+- :mod:`~eegnetreplication_tpu.obs.journal` — run-scoped JSONL event
+  streams (``events.jsonl``) with a context-local active journal;
+- :mod:`~eegnetreplication_tpu.obs.metrics` — counters/gauges/histograms
+  flushed to ``metrics.json``, optional TensorBoard scalar mirror;
+- :mod:`~eegnetreplication_tpu.obs.schema` — validation + the shared
+  atomic artifact writer (``BENCH_*.json`` goes through it too).
+
+Entry points open a run with :func:`journal.run`; library code reaches the
+active journal via :func:`journal.current` (a no-op outside a run).
+"""
+
+from eegnetreplication_tpu.obs import journal, metrics, schema
+from eegnetreplication_tpu.obs.journal import (
+    NullJournal,
+    RunJournal,
+    current,
+    new_run_id,
+    run,
+)
+from eegnetreplication_tpu.obs.metrics import MetricsRegistry
+from eegnetreplication_tpu.obs.schema import (
+    SCHEMA_VERSION,
+    SchemaError,
+    read_events,
+    read_metrics,
+    validate_bench,
+    validate_event,
+    validate_events,
+    validate_metrics,
+    write_json_artifact,
+)
+
+__all__ = [
+    "journal", "metrics", "schema",
+    "RunJournal", "NullJournal", "MetricsRegistry",
+    "current", "run", "new_run_id",
+    "SCHEMA_VERSION", "SchemaError",
+    "read_events", "read_metrics",
+    "validate_bench", "validate_event", "validate_events",
+    "validate_metrics", "write_json_artifact",
+]

@@ -1,0 +1,277 @@
+"""Run journal: run-scoped structured JSONL event streams.
+
+Every training/bench invocation opens a run context with a unique run id;
+everything the run does is appended as one JSON object per line to
+``<metrics_dir>/<run_id>/events.jsonl`` (``run_start`` with git sha +
+device kind + mesh shape + config, ``compile_begin``/``compile_end``,
+per-epoch metrics, device-fault/retry events, ``run_end`` with exit
+status), and the run's :class:`~eegnetreplication_tpu.obs.metrics.MetricsRegistry`
+is flushed to ``metrics.json`` beside it.
+
+The active journal is held in a :mod:`contextvars` variable so deep
+callees (``training/protocols.py``, ``training/loop.py`` consumers) can
+emit without threading a journal object through every signature:
+:func:`current` returns the active journal, or an inert no-op journal when
+no run context is open — instrumented code needs no "is telemetry on?"
+branches, and library use of the protocols stays telemetry-free by
+default.
+
+Emission is crash-safe by construction: events append-and-flush one line
+at a time (a SIGKILL mid-run loses at most the line being written), and a
+schema-invalid event is written with a ``_schema_error`` field plus a
+warning instead of raising — a telemetry bug must never kill an
+hours-long training run (the tests assert no ``_schema_error`` ever
+appears, so drift is still caught where it matters).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+from typing import Any, Iterator
+
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.obs.metrics import MetricsRegistry, TensorBoardMirror
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def _git_sha() -> str:
+    """Short git sha of the working tree, or "unknown" (best-effort)."""
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=5)
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else "unknown"
+    except Exception:  # noqa: BLE001 — telemetry must not require git
+        return "unknown"
+
+
+def _device_info() -> dict[str, Any]:
+    """Platform/device-kind/count without forcing a backend choice."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+        return {"platform": devices[0].platform,
+                "device_kind": getattr(devices[0], "device_kind",
+                                       devices[0].platform),
+                "n_devices": len(devices)}
+    except Exception:  # noqa: BLE001 — pre-init or broken backend
+        return {"platform": "unknown", "device_kind": "unknown",
+                "n_devices": 0}
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort conversion of config-ish values to JSON-serializable."""
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        # Recurse through the asdict result: nested field values (Path,
+        # numpy arrays, ...) are not JSON-safe just because the container is.
+        return _jsonable(dataclasses.asdict(value))
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, Path):
+        return str(value)
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    return repr(value)
+
+
+def new_run_id() -> str:
+    """Unique, sortable run id: UTC timestamp + random suffix."""
+    return (time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+            + "-" + os.urandom(3).hex())
+
+
+class RunJournal:
+    """One run's event stream + metrics registry.
+
+    Use through :func:`run` (the context manager) in entrypoints; library
+    code reaches the active instance via :func:`current`.
+    """
+
+    def __init__(self, metrics_dir: str | Path, run_id: str | None = None,
+                 tb_dir: str | Path | None = None):
+        self.run_id = run_id or new_run_id()
+        self.dir = Path(metrics_dir) / self.run_id
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.events_path = self.dir / "events.jsonl"
+        self.metrics_path = self.dir / "metrics.json"
+        self.metrics = MetricsRegistry()
+        self._t0 = time.perf_counter()
+        self._ended = False
+        self._tb = TensorBoardMirror(tb_dir) if tb_dir else None
+
+    # -- event emission ---------------------------------------------------
+    @property
+    def active(self) -> bool:
+        return True
+
+    def event(self, event: str, **fields: Any) -> dict:
+        """Append one structured event; stamps t/run_id, validates, flushes."""
+        record = {"event": event, "t": round(time.time(), 3),
+                  "run_id": self.run_id}
+        record.update({k: _jsonable(v) for k, v in fields.items()})
+        try:
+            schema.validate_event(record)
+        except schema.SchemaError as exc:
+            logger.warning("Telemetry event failed schema validation "
+                           "(emitted anyway): %s", exc)
+            record["_schema_error"] = str(exc)[:300]
+        try:
+            line = json.dumps(record)
+        except (TypeError, ValueError) as exc:
+            # A field _jsonable could not tame (exotic object, NaN under a
+            # strict encoder): degrade to repr-stringified values.
+            logger.warning("Telemetry event %r not JSON-serializable (%s); "
+                           "emitting repr-coerced fields", event, exc)
+            line = json.dumps({k: v if isinstance(v, (str, int, float, bool))
+                               or v is None else repr(v)
+                               for k, v in record.items()})
+        try:
+            with open(self.events_path, "a") as fh:
+                fh.write(line + "\n")
+                fh.flush()
+        except OSError as exc:
+            # Full/read-only filesystem hours into a run: drop the event,
+            # never the run (the module contract).
+            logger.warning("Telemetry event %r dropped (cannot write %s: "
+                           "%s)", event, self.events_path, exc)
+        return record
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        """Mirror a scalar to TensorBoard when a backend is active."""
+        if self._tb is not None:
+            self._tb.scalar(tag, float(value), int(step))
+
+    # -- lifecycle --------------------------------------------------------
+    def run_start(self, config: Any = None, mesh_shape: dict | None = None,
+                  **extra: Any) -> None:
+        info = _device_info()
+        self.event("run_start", schema_version=schema.SCHEMA_VERSION,
+                   git_sha=_git_sha(), utc=schema.utc_now(),
+                   mesh_shape=mesh_shape, config=_jsonable(config) or {},
+                   argv=list(sys.argv), **info, **extra)
+
+    def run_end(self, status: str = "ok", error: str | None = None,
+                **extra: Any) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        wall = time.perf_counter() - self._t0
+        fields = dict(status=status, wall_s=round(wall, 3), **extra)
+        if error:
+            fields["error"] = error[:500]
+        self.metrics.set("wall_seconds", round(wall, 3))
+        self.event("run_end", **fields)
+        try:
+            self.flush_metrics()
+        except OSError as exc:
+            # Same contract as event(): a failed metrics flush at run end
+            # must not surface as the run's own failure.
+            logger.warning("Telemetry metrics flush to %s failed: %s",
+                           self.metrics_path, exc)
+        if self._tb is not None:
+            self._tb.close()
+
+    def flush_metrics(self) -> None:
+        self.metrics.flush(self.metrics_path, run_id=self.run_id)
+
+    def sample_device_memory(self) -> None:
+        """Gauge ``hbm_bytes_in_use`` per local device (accelerators only;
+        CPU backends report no memory stats and are skipped)."""
+        try:
+            import jax
+
+            for i, dev in enumerate(jax.local_devices()):
+                stats = getattr(dev, "memory_stats", lambda: None)()
+                if stats and "bytes_in_use" in stats:
+                    self.metrics.set("hbm_bytes_in_use",
+                                     float(stats["bytes_in_use"]),
+                                     device=str(i))
+        except Exception:  # noqa: BLE001 — sampling is an add-on
+            pass
+
+
+class NullJournal:
+    """Inert journal returned by :func:`current` outside a run context.
+
+    Same surface as :class:`RunJournal`; every method is a no-op (the
+    metrics registry is real but never flushed, so instrumented code can
+    read back what it wrote within one call if it wants to).
+    """
+
+    run_id = "none"
+    dir = None
+    events_path = None
+
+    def __init__(self):
+        self.metrics = MetricsRegistry()
+
+    @property
+    def active(self) -> bool:
+        return False
+
+    def event(self, event: str, **fields: Any) -> dict:
+        return {}
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        pass
+
+    def run_start(self, *a: Any, **k: Any) -> None:
+        pass
+
+    def run_end(self, *a: Any, **k: Any) -> None:
+        pass
+
+    def flush_metrics(self) -> None:
+        pass
+
+    def sample_device_memory(self) -> None:
+        pass
+
+
+_ACTIVE: contextvars.ContextVar[RunJournal | None] = contextvars.ContextVar(
+    "eegtpu_obs_journal", default=None)
+
+
+def current() -> RunJournal | NullJournal:
+    """The active run journal, or an inert no-op outside a run context."""
+    return _ACTIVE.get() or NullJournal()
+
+
+@contextlib.contextmanager
+def run(metrics_dir: str | Path, config: Any = None,
+        mesh_shape: dict | None = None, tb_dir: str | Path | None = None,
+        run_id: str | None = None, **run_start_extra: Any
+        ) -> Iterator[RunJournal]:
+    """Open a run context: journal + metrics under ``metrics_dir/<run_id>``.
+
+    Emits ``run_start`` on entry and ``run_end`` (status ``ok`` or
+    ``error`` with the exception) on exit; sets the context-local active
+    journal so every protocol/loop callee journals into this run.
+    """
+    journal = RunJournal(metrics_dir, run_id=run_id, tb_dir=tb_dir)
+    journal.run_start(config=config, mesh_shape=mesh_shape,
+                      **run_start_extra)
+    logger.info("Telemetry run %s -> %s", journal.run_id, journal.dir)
+    token = _ACTIVE.set(journal)
+    try:
+        yield journal
+    except BaseException as exc:
+        journal.run_end(status="error",
+                        error=f"{type(exc).__name__}: {exc}")
+        raise
+    finally:
+        _ACTIVE.reset(token)
+        journal.run_end(status="ok")

@@ -1,0 +1,185 @@
+"""Metrics registry: counters, gauges, and histograms with labeled series.
+
+The framework's measurement surface before this module was three divergent
+ad-hoc paths (stdlib log lines, ``StepTimer`` sums, hand-built JSON dicts);
+the registry gives them one aggregation model:
+
+- **counter** — monotonically accumulating total (``fold_epochs_total``,
+  ``device_fault_retries``, ``fault_retry_wall_s``);
+- **gauge** — last-written value (``hbm_bytes_in_use``, ``wall_seconds``);
+- **histogram** — count/sum/min/max/mean of observations
+  (``chunk_wall_s``, ``compile_seconds``).
+
+Every metric name holds a family of series keyed by labels
+(``inc("hbm_bytes_in_use", v, device="0")``), Prometheus-style.  The
+registry is flushed to a ``metrics.json`` summary validated by
+:mod:`eegnetreplication_tpu.obs.schema`; scalars can additionally be
+mirrored as TensorBoard scalars next to the ``--profileDir`` traces when a
+summary-writer backend is importable (best-effort — no hard dependency).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+@dataclass
+class _Histogram:
+    count: int = 0
+    sum: float = 0.0
+    min: float = float("inf")
+    max: float = float("-inf")
+
+    def observe(self, value: float) -> None:
+        self.count += 1
+        self.sum += value
+        self.min = min(self.min, value)
+        self.max = max(self.max, value)
+
+    def to_dict(self, labels: dict) -> dict:
+        return {"labels": labels, "count": self.count,
+                "sum": round(self.sum, 6),
+                "min": round(self.min, 6), "max": round(self.max, 6),
+                "mean": round(self.sum / self.count, 6) if self.count else 0.0}
+
+
+@dataclass
+class MetricsRegistry:
+    """Thread-safe in-process metrics aggregation.
+
+    One instance per run journal; a standalone instance works too (tests,
+    scripts).  Types are enforced per name: incrementing a name that was
+    used as a gauge raises — silently mixing kinds is exactly the drift
+    this subsystem exists to prevent.
+    """
+
+    _counters: dict = field(default_factory=dict)
+    _gauges: dict = field(default_factory=dict)
+    _histograms: dict = field(default_factory=dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def _check_kind(self, name: str, kind: dict) -> None:
+        for other in (self._counters, self._gauges, self._histograms):
+            if other is not kind and name in other:
+                raise ValueError(
+                    f"metric {name!r} is already registered as a different "
+                    "kind; counter/gauge/histogram names must not collide")
+
+    def inc(self, name: str, value: float = 1.0, **labels: str) -> None:
+        """Add ``value`` to the counter series ``name{labels}``."""
+        if value < 0:
+            raise ValueError(f"counter {name!r} cannot decrease ({value})")
+        with self._lock:
+            self._check_kind(name, self._counters)
+            series = self._counters.setdefault(name, {})
+            key = _label_key(labels)
+            series[key] = series.get(key, 0.0) + float(value)
+
+    def set(self, name: str, value: float, **labels: str) -> None:
+        """Set the gauge series ``name{labels}`` to ``value``."""
+        with self._lock:
+            self._check_kind(name, self._gauges)
+            self._gauges.setdefault(name, {})[_label_key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, **labels: str) -> None:
+        """Record one observation into the histogram ``name{labels}``."""
+        with self._lock:
+            self._check_kind(name, self._histograms)
+            series = self._histograms.setdefault(name, {})
+            key = _label_key(labels)
+            if key not in series:
+                series[key] = _Histogram()
+            series[key].observe(float(value))
+
+    def get(self, name: str, **labels: str) -> float | None:
+        """Current value of a counter/gauge series (None when absent)."""
+        key = _label_key(labels)
+        with self._lock:
+            for store in (self._counters, self._gauges):
+                if name in store and key in store[name]:
+                    return store[name][key]
+        return None
+
+    def snapshot(self, run_id: str = "standalone") -> dict:
+        """The registry's full state as a schema-valid metrics record."""
+        with self._lock:
+            counters = {
+                name: [{"labels": dict(k), "value": round(v, 6)}
+                       for k, v in sorted(series.items())]
+                for name, series in sorted(self._counters.items())}
+            gauges = {
+                name: [{"labels": dict(k), "value": round(v, 6)}
+                       for k, v in sorted(series.items())]
+                for name, series in sorted(self._gauges.items())}
+            histograms = {
+                name: [h.to_dict(dict(k)) for k, h in sorted(series.items())]
+                for name, series in sorted(self._histograms.items())}
+        return {"schema_version": schema.SCHEMA_VERSION, "run_id": run_id,
+                "utc": schema.utc_now(), "counters": counters,
+                "gauges": gauges, "histograms": histograms}
+
+    def flush(self, path: str | Path, run_id: str = "standalone") -> Path:
+        """Write the validated ``metrics.json`` summary atomically."""
+        return schema.write_json_artifact(path, self.snapshot(run_id),
+                                          kind="metrics", indent=1)
+
+
+class TensorBoardMirror:
+    """Best-effort scalar mirror next to the ``--profileDir`` traces.
+
+    Tries the available summary-writer backends in order; when none is
+    importable the mirror is inert (``active`` False) — telemetry must
+    never add a hard dependency to the training path.
+    """
+
+    def __init__(self, log_dir: str | Path):
+        self._writer = None
+        for importer in (self._try_tensorboardx, self._try_torch_tb):
+            try:
+                self._writer = importer(str(log_dir))
+                break
+            except Exception:  # noqa: BLE001 — backend absent/broken: next
+                continue
+        if self._writer is None:
+            logger.debug("No TensorBoard summary-writer backend available; "
+                         "scalar mirroring to %s disabled", log_dir)
+
+    @staticmethod
+    def _try_tensorboardx(log_dir: str):
+        from tensorboardX import SummaryWriter
+
+        return SummaryWriter(log_dir)
+
+    @staticmethod
+    def _try_torch_tb(log_dir: str):
+        from torch.utils.tensorboard import SummaryWriter
+
+        return SummaryWriter(log_dir)
+
+    @property
+    def active(self) -> bool:
+        return self._writer is not None
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.add_scalar(tag, value, step)
+            except Exception:  # noqa: BLE001 — mirroring is an add-on
+                self._writer = None
+
+    def close(self) -> None:
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+            self._writer = None

@@ -1,0 +1,238 @@
+"""Telemetry schemas: the single source of truth for run artifacts.
+
+Three artifact families share this module so they cannot silently drift
+(the pre-obs state: ``bench.py``, ``scripts/cs_at_scale.py`` and
+``training/protocols.py`` each hand-rolled its own dict layout):
+
+- **events.jsonl** — the run journal's structured event stream
+  (:data:`EVENT_REQUIRED` names each event type's required keys);
+- **metrics.json** — the metrics registry's flushed summary
+  (:func:`validate_metrics`);
+- **BENCH_*.json** — measurement artifacts, written atomically through
+  :func:`write_json_artifact` which stamps ``schema_version``/``utc`` and
+  validates before the bytes land.
+
+Validation is stdlib-only (no jsonschema dependency): a required-key table
+plus type checks.  Extra keys are always allowed — emitters grow fields
+freely; only *removing* a required key breaks the contract.
+``scripts/obs_report.py`` and the test suite both validate through here.
+"""
+
+from __future__ import annotations
+
+import json
+import numbers
+import os
+import time
+from pathlib import Path
+from typing import Any, Iterable
+
+SCHEMA_VERSION = 1
+
+# Keys every journal event carries (stamped by RunJournal.event).
+EVENT_BASE_REQUIRED = ("event", "t", "run_id")
+
+# Per-event-type required keys (beyond the base).  Unknown event types are
+# allowed (extension point) but must still carry the base keys.
+EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
+    "run_start": ("schema_version", "git_sha", "platform", "device_kind",
+                  "n_devices", "config"),
+    "train_setup": ("protocol", "n_folds", "epochs", "train_pad",
+                    "real_train_samples", "padded_train_slots"),
+    "compile_begin": ("what",),
+    "compile_end": ("what", "elapsed_s"),
+    "fold_group": ("group", "fold_lo", "fold_hi"),
+    "epoch": ("epoch", "total_epochs", "train_loss", "val_loss", "val_acc",
+              "grad_norm", "n_folds"),
+    "device_fault": ("error", "fold_lo", "fold_hi", "retry_fold_batch",
+                     "elapsed_s"),
+    "run_end": ("status", "wall_s"),
+}
+
+# metrics.json top-level sections and the keys every series entry needs.
+METRIC_SECTIONS = ("counters", "gauges", "histograms")
+_HISTOGRAM_KEYS = ("count", "sum", "min", "max", "mean")
+
+# Minimal envelope for measurement artifacts (BENCH_*.json).  Existing
+# committed artifacts predate the envelope; the writer stamps it on the
+# way out, and the validator is only applied to newly written records.
+BENCH_REQUIRED = ("schema_version", "utc", "platform")
+
+
+class SchemaError(ValueError):
+    """An artifact does not satisfy the telemetry schema."""
+
+
+def _require(record: dict, keys: Iterable[str], what: str) -> None:
+    missing = [k for k in keys if k not in record]
+    if missing:
+        raise SchemaError(f"{what} is missing required keys {missing}: "
+                          f"{record!r}")
+
+
+def validate_event(event: dict) -> dict:
+    """Validate one journal event; returns it unchanged on success."""
+    if not isinstance(event, dict):
+        raise SchemaError(f"event must be a dict, got {type(event).__name__}")
+    _require(event, EVENT_BASE_REQUIRED, "event")
+    kind = event["event"]
+    if not isinstance(kind, str):
+        raise SchemaError(f"event name must be a str, got {kind!r}")
+    if not isinstance(event["t"], numbers.Real):
+        raise SchemaError(f"event timestamp must be numeric: {event['t']!r}")
+    if "_schema_error" in event:
+        # Already flagged invalid by the emitter (which writes rather than
+        # crashes a run); re-raising here would make every reader of an
+        # otherwise-healthy stream die on it.  Readers surface the flag.
+        return event
+    _require(event, EVENT_REQUIRED.get(kind, ()), f"{kind!r} event")
+    return event
+
+
+def validate_events(events: list[dict], *, complete: bool = True) -> list[dict]:
+    """Validate a run's event stream.
+
+    ``complete=True`` additionally requires the stream to open with
+    ``run_start`` and close with ``run_end`` — what a finished run must
+    look like; pass ``False`` to inspect a live/crashed run's partial file.
+    """
+    for ev in events:
+        validate_event(ev)
+    if complete:
+        if not events:
+            raise SchemaError("event stream is empty")
+        if events[0]["event"] != "run_start":
+            raise SchemaError(
+                f"first event must be run_start, got {events[0]['event']!r}")
+        if events[-1]["event"] != "run_end":
+            raise SchemaError(
+                f"last event must be run_end, got {events[-1]['event']!r}")
+        run_ids = {ev["run_id"] for ev in events}
+        if len(run_ids) != 1:
+            raise SchemaError(f"mixed run_ids in one stream: {run_ids}")
+    return events
+
+
+def read_events(path: str | Path, *, complete: bool = True) -> list[dict]:
+    """Load and validate an ``events.jsonl`` file."""
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as exc:
+                raise SchemaError(
+                    f"{path}:{lineno} is not valid JSON: {exc}") from exc
+    return validate_events(events, complete=complete)
+
+
+def validate_metrics(record: dict) -> dict:
+    """Validate a flushed metrics.json record; returns it on success."""
+    if not isinstance(record, dict):
+        raise SchemaError("metrics record must be a dict")
+    _require(record, ("schema_version", "run_id", "utc") + METRIC_SECTIONS,
+             "metrics record")
+    for section in METRIC_SECTIONS:
+        series_map = record[section]
+        if not isinstance(series_map, dict):
+            raise SchemaError(f"metrics section {section!r} must be a dict")
+        for name, series in series_map.items():
+            if not isinstance(series, list):
+                raise SchemaError(
+                    f"metric {name!r} must be a list of labeled series")
+            for entry in series:
+                _require(entry, ("labels",), f"metric {name!r} series")
+                if not isinstance(entry["labels"], dict):
+                    raise SchemaError(f"metric {name!r} labels must be a dict")
+                if section == "histograms":
+                    _require(entry, _HISTOGRAM_KEYS,
+                             f"histogram {name!r} series")
+                else:
+                    _require(entry, ("value",), f"metric {name!r} series")
+                    if not isinstance(entry["value"], numbers.Real):
+                        raise SchemaError(
+                            f"metric {name!r} value must be numeric: "
+                            f"{entry['value']!r}")
+    return record
+
+
+def read_metrics(path: str | Path) -> dict:
+    """Load and validate a ``metrics.json`` file."""
+    with open(path) as fh:
+        return validate_metrics(json.load(fh))
+
+
+def validate_bench(record: dict) -> dict:
+    """Validate a measurement artifact's envelope; returns it on success."""
+    if not isinstance(record, dict):
+        raise SchemaError("bench record must be a dict")
+    _require(record, BENCH_REQUIRED, "bench record")
+    return record
+
+
+def utc_now() -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+
+
+def write_json_artifact(path: str | Path, record: dict,
+                        kind: str = "bench", indent: int | None = None) -> Path:
+    """Validate and atomically write a measurement artifact.
+
+    Stamps ``schema_version`` and ``utc`` when the caller did not, then
+    validates per ``kind`` (``"bench"`` or ``"metrics"``) and writes via a
+    same-directory temp file + rename so a crash mid-write can never leave
+    a truncated artifact where a valid one stood.
+    """
+    record = dict(record)
+    record.setdefault("schema_version", SCHEMA_VERSION)
+    record.setdefault("utc", utc_now())
+    if kind == "metrics":
+        validate_metrics(record)
+    elif kind == "bench":
+        validate_bench(record)
+    else:
+        raise ValueError(f"unknown artifact kind {kind!r}")
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(f"{path.name}.{os.getpid()}.tmp")
+    tmp.write_text(json.dumps(record, indent=indent))
+    tmp.replace(path)
+    return path
+
+
+def event_summary(events: list[dict]) -> dict[str, Any]:
+    """Condense one run's event stream into the fields the report table
+    shows (also used by tests as the canonical reading of a stream)."""
+    out: dict[str, Any] = {"run_id": events[0]["run_id"] if events else None,
+                           "status": "incomplete", "n_events": len(events)}
+    epochs = [e for e in events if e["event"] == "epoch"]
+    faults = [e for e in events if e["event"] == "device_fault"]
+    compiles = [e for e in events if e["event"] == "compile_end"]
+    for ev in events:
+        kind = ev["event"]
+        if kind == "run_start":
+            out.update(platform=ev.get("platform"),
+                       device_kind=ev.get("device_kind"),
+                       git_sha=ev.get("git_sha"),
+                       started_utc=ev.get("utc"))
+        elif kind == "train_setup":
+            out.update(protocol=ev.get("protocol"), n_folds=ev.get("n_folds"),
+                       epochs=ev.get("epochs"))
+        elif kind == "run_end":
+            out.update(status=ev.get("status"), wall_s=ev.get("wall_s"))
+            if ev.get("error"):
+                out["error_message"] = ev["error"]
+    out["n_epoch_events"] = len(epochs)
+    out["device_fault_retries"] = len(faults)
+    out["compile_s"] = round(sum(e.get("elapsed_s", 0.0) for e in compiles), 2)
+    if epochs:
+        last = epochs[-1]
+        out.update(last_epoch=last.get("epoch"),
+                   last_train_loss=last.get("train_loss"),
+                   last_val_loss=last.get("val_loss"),
+                   last_val_acc=last.get("val_acc"),
+                   last_grad_norm=last.get("grad_norm"))
+    return out

@@ -196,8 +196,9 @@ def _build_sp_ems(mesh, axis_name: str, ndim: int, factor_new: float,
     Keyed on (mesh, axis, rank, hyperparams) so the 18-session preprocessing
     sweep compiles once per shape instead of re-tracing per call.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from eegnetreplication_tpu.utils.compat import shard_map
 
     def fn(x_local):
         k = jax.lax.axis_index(axis_name)

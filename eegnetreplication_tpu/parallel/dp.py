@@ -15,9 +15,9 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 
 from eegnetreplication_tpu.parallel.mesh import DATA_AXIS
+from eegnetreplication_tpu.utils.compat import shard_map
 from eegnetreplication_tpu.training.steps import (
     TrainState,
     clamp_reference_maxnorm,
@@ -86,7 +86,7 @@ def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
         in_specs=(replicated, batch_sharded, batch_sharded, batch_sharded,
                   replicated),
         out_specs=(replicated, replicated),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(mapped)
 
@@ -108,6 +108,6 @@ def make_dp_eval_step(model, mesh, *, data_axis: str = DATA_AXIS):
         sharded_eval, mesh=mesh,
         in_specs=(P(), P(data_axis), P(data_axis), P(data_axis)),
         out_specs=(P(), P()),
-        check_vma=False,
+        check=False,
     )
     return jax.jit(mapped)

@@ -70,6 +70,12 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Comma-separated subject ids (default: 1-9).")
     parser.add_argument("--profileDir", type=str, default=None,
                         help="Write a jax.profiler trace (TensorBoard) here.")
+    parser.add_argument("--metricsDir", type=str, default=None,
+                        help="Telemetry root: every run writes structured "
+                             "events.jsonl + metrics.json under "
+                             "<metricsDir>/<run_id>/ (schema: obs/schema.py; "
+                             "render with scripts/obs_report.py). Default: "
+                             "reports/obs next to the report output.")
     parser.add_argument("--ckptFormat", type=str, default="npz",
                         choices=["npz", "orbax"],
                         help="Native artifact format for saved models: npz "
@@ -169,42 +175,51 @@ def main() -> None:
         mesh = make_mesh(n_fold=args.meshFold, n_data=args.meshData)
         logger.info("Using device mesh %s", dict(mesh.shape))
 
-    if args.trainingType == "Within-Subject":
-        logger.info("Training Within-Subject models for all subjects...")
+    from pathlib import Path
+
+    from eegnetreplication_tpu import obs
+    from eegnetreplication_tpu.config import Paths
+
+    paths = Paths.from_here()
+    metrics_dir = (Path(args.metricsDir) if args.metricsDir
+                   else paths.reports / "obs")
+    with obs.run(metrics_dir, config=config,
+                 mesh_shape=dict(mesh.shape) if mesh is not None else None,
+                 tb_dir=args.profileDir,
+                 training_type=args.trainingType, model=args.model,
+                 epochs=args.epochs, seed=args.seed,
+                 subjects=list(subjects)) as journal:
+        train_fn = (within_subject_training
+                    if args.trainingType == "Within-Subject"
+                    else cross_subject_training)
+        logger.info("Training %s model(s)...", args.trainingType)
         with trace(args.profileDir):
-            result = within_subject_training(epochs=args.epochs, config=config,
-                                             seed=args.seed, mesh=mesh,
-                                             model_name=args.model,
-                                             subjects=subjects,
-                                             ckpt_format=args.ckptFormat,
-                                             fold_batch=args.maxFoldsPerProgram,
-                                             checkpoint_every=args.checkpointEvery,
-                                             resume=args.resume)
+            result = train_fn(epochs=args.epochs, config=config,
+                              seed=args.seed, mesh=mesh,
+                              model_name=args.model,
+                              subjects=subjects,
+                              paths=paths,
+                              ckpt_format=args.ckptFormat,
+                              fold_batch=args.maxFoldsPerProgram,
+                              checkpoint_every=args.checkpointEvery,
+                              resume=args.resume)
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
+        journal.metrics.set("epoch_throughput", result.epoch_throughput)
+        journal.metrics.set("wall_seconds_training", result.wall_seconds)
+        journal.metrics.set("avg_test_acc", result.avg_test_acc)
+        journal.sample_device_memory()
         if args.generateReport:
-            generate_ws_report(result.per_subject_test_acc,
-                               result.avg_test_acc, result.best_states,
-                               epochs=args.epochs, subjects=result.subjects,
-                               config=config)
-    else:
-        logger.info("Training Cross-Subject model...")
-        with trace(args.profileDir):
-            result = cross_subject_training(epochs=args.epochs, config=config,
-                                            seed=args.seed, mesh=mesh,
-                                            model_name=args.model,
-                                            subjects=subjects,
-                                            ckpt_format=args.ckptFormat,
-                                            fold_batch=args.maxFoldsPerProgram,
-                                            checkpoint_every=args.checkpointEvery,
-                                            resume=args.resume)
-        logger.info("Epoch throughput: %.1f fold-epochs/s",
-                    result.epoch_throughput)
-        if args.generateReport:
-            generate_cs_report(result.best_states[0],
-                               result.per_subject_test_acc,
-                               result.avg_test_acc, epochs=args.epochs,
-                               subjects=result.subjects, config=config)
+            if args.trainingType == "Within-Subject":
+                generate_ws_report(result.per_subject_test_acc,
+                                   result.avg_test_acc, result.best_states,
+                                   epochs=args.epochs,
+                                   subjects=result.subjects, config=config)
+            else:
+                generate_cs_report(result.best_states[0],
+                                   result.per_subject_test_acc,
+                                   result.avg_test_acc, epochs=args.epochs,
+                                   subjects=result.subjects, config=config)
 
 
 if __name__ == "__main__":

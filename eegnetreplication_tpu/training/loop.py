@@ -61,6 +61,7 @@ class FoldResult:
     train_losses: jnp.ndarray     # (epochs,)
     val_losses: jnp.ndarray       # (epochs,)
     val_accuracies: jnp.ndarray   # (epochs,) percentage
+    grad_norms: jnp.ndarray       # (epochs,) mean per-step raw grad norm
     test_accuracy: jnp.ndarray    # () f32, percentage (best model on test set)
 
 
@@ -181,13 +182,14 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
             if data_axis is not None:
                 batch_idx = _shard_slice(batch_idx, data_axis, data_shards)
                 w = _shard_slice(w, data_axis, data_shards)
-            state, loss = steps_lib.train_step(
+            state, loss, gnorm = steps_lib.train_step(
                 model, tx, state, pool_x[batch_idx], pool_y[batch_idx], w,
                 rng, maxnorm_mode=maxnorm_mode, data_axis=data_axis,
+                return_grad_norm=True,
             )
-            return state, loss
+            return state, (loss, gnorm)
 
-        state, step_losses = jax.lax.scan(
+        state, (step_losses, step_gnorms) = jax.lax.scan(
             train_body, state,
             (gather_idx.reshape(train_steps, batch_size),
              weights.reshape(train_steps, batch_size), step_rngs),
@@ -197,6 +199,11 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
             jnp.ceil(spec.train_n / batch_size), 1
         ).astype(jnp.float32)
         train_loss = jnp.sum(step_losses) / n_real_train_batches
+        # Mean raw-gradient global norm over real steps (phantom all-padding
+        # steps contribute 0 to the sum and are excluded from the count):
+        # the journal's per-epoch training-health scalar, carried out of the
+        # scan for free alongside the loss.
+        grad_norm = jnp.sum(step_gnorms) / n_real_train_batches
 
         # Validation pass (eval mode; running BN stats, like model.py:151-168).
         val_gather, val_w = _linear_slots(
@@ -227,12 +234,12 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
         ).astype(jnp.float32)
         val_loss = val_loss_sum / n_real_val_batches
         val_acc = 100.0 * correct / jnp.maximum(spec.val_n, 1)
-        return state, train_loss, val_loss, val_acc
+        return state, train_loss, val_loss, val_acc, grad_norm
 
     def segment(pool_x, pool_y, spec: FoldSpec, carry, epoch_keys):
         def epoch_body(carry, epoch_key):
             state, best_state, best_acc, min_loss = carry
-            state, train_loss, val_loss, val_acc = run_epoch(
+            state, train_loss, val_loss, val_acc, grad_norm = run_epoch(
                 pool_x, pool_y, spec, state, epoch_key
             )
             improved = val_acc > best_acc  # strict >, model.py:180
@@ -242,7 +249,7 @@ def make_epoch_scanner(model, tx, *, batch_size: int,
             best_acc = jnp.maximum(best_acc, val_acc)
             min_loss = jnp.minimum(min_loss, val_loss)
             return ((state, best_state, best_acc, min_loss),
-                    (train_loss, val_loss, val_acc))
+                    (train_loss, val_loss, val_acc, grad_norm))
 
         return jax.lax.scan(epoch_body, carry, epoch_keys)
 
@@ -276,7 +283,7 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
         (state, best_state, best_acc, min_loss), per_epoch = segment(
             pool_x, pool_y, spec, init_fold_carry(init_state), epoch_keys
         )
-        train_losses, val_losses, val_accs = per_epoch
+        train_losses, val_losses, val_accs, grad_norms = per_epoch
         test_acc = evaluate_pool(
             model, best_state, pool_x, pool_y, spec.test_idx, spec.test_n,
             batch_size, data_axis=data_axis, data_shards=data_shards,
@@ -288,6 +295,7 @@ def make_fold_trainer(model, tx, *, batch_size: int, epochs: int,
             train_losses=train_losses,
             val_losses=val_losses,
             val_accuracies=val_accs,
+            grad_norms=grad_norms,
             test_accuracy=test_acc,
         )
 
@@ -303,12 +311,13 @@ def shard_over_fold_axis(fn, mesh, fold_axis: str, mapped: tuple[bool, ...]):
     permutation test); callers pad the mapped axis to a multiple of
     ``mesh.shape[fold_axis]``.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from eegnetreplication_tpu.utils.compat import shard_map
 
     in_specs = tuple(P(fold_axis) if m else P() for m in mapped)
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
-                     out_specs=P(fold_axis), check_vma=False)
+                     out_specs=P(fold_axis), check=False)
 
 
 def _mesh_data_sharding(mesh, batch_size: int):

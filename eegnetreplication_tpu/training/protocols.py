@@ -29,6 +29,7 @@ import hashlib
 import json
 import math
 import os
+import time
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Any, Callable
@@ -56,6 +57,7 @@ from eegnetreplication_tpu.training.loop import (
     make_multi_fold_segment,
     make_multi_fold_trainer,
 )
+from eegnetreplication_tpu.obs import journal as obs_journal
 from eegnetreplication_tpu.training.steps import make_optimizer
 from eegnetreplication_tpu.utils.logging import logger
 from eegnetreplication_tpu.utils.profiling import StepTimer
@@ -114,7 +116,9 @@ class ProtocolResult:
     # Training wall only (chunked runs exclude the one-off test-set pass,
     # which is logged separately; single-program runs compile eval into
     # the fused program and cannot split it — BENCH_NOTES.md "metric
-    # definitions").  Basis of epoch_throughput.
+    # definitions").  INCLUDES time burned by faulted fold-group attempts
+    # (fault_retry_wall_s, broken out below) so halved runs do not
+    # over-report throughput.  Basis of epoch_throughput.
     wall_seconds: float
     epochs: int
     subjects: tuple[int, ...] = tuple(range(1, 10))
@@ -134,6 +138,12 @@ class ProtocolResult:
     # replay-freshness evidence — N independently-initialized folds
     # cannot produce identical loss trajectories.
     fold_min_val_loss: np.ndarray | None = None
+    # Wall seconds burned by fold-group attempts that FAULTED and were
+    # retried at a halved size (ADVICE r5): included in wall_seconds (a
+    # halved run's throughput must not over-report) and broken out here /
+    # as the ``fault_retry_wall_s`` journal metric so the training-only
+    # wall is reconstructable.
+    fault_retry_wall_s: float = 0.0
 
     @property
     def epoch_throughput(self) -> float:
@@ -215,7 +225,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                _states=None, _keys=None, _keep_snapshot: bool = False,
                _crash_after_chunk: int | None = None,
                _fault_if_folds_over: int | None = None):
-    """Train all folds fused; returns stacked FoldResult.
+    """Train all folds fused; returns ``(results, wall, fold_epochs,
+    fault_retry_wall_s)`` with ``results`` a stacked FoldResult.
 
     ``checkpoint_every`` — ``0``: the whole run is ONE compiled program (the
     round-1 design); ``N``: the epoch scan runs in N-epoch chunks with a run
@@ -253,6 +264,24 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     val_pad = specs[0].val_idx.shape[0]
     test_pad = specs[0].test_idx.shape[0]
 
+    jr = obs_journal.current()
+    # Padded-vs-real sample accounting for the journal: per epoch each fold
+    # trains ceil(train_pad/batch)*batch slots of which train_n are real
+    # (the rest wrap around at loss-weight 0) — host-side values, so the
+    # per-epoch journal lines cost no extra device syncs.
+    real_train = int(sum(int(s.train_n) for s in specs))
+    slots_per_fold = (math.ceil(train_pad / config.batch_size)
+                      * config.batch_size)
+    padded_train = n_folds * slots_per_fold - real_train
+    if _states is None:  # top-level call, not a fold-group member
+        jr.event("train_setup",
+                 protocol=(signature or {}).get("protocol", "adhoc"),
+                 n_folds=n_folds, epochs=epochs, train_pad=train_pad,
+                 val_pad=val_pad, test_pad=test_pad,
+                 real_train_samples=real_train,
+                 padded_train_slots=padded_train,
+                 fold_batch=fold_batch)
+
     states = (_states if _states is not None else
               init_fold_states(model, tx, n_folds,
                                (pool_x.shape[1], pool_x.shape[2]), seed=seed))
@@ -277,6 +306,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
         fold_batch = None
     if fold_batch and n_folds > fold_batch:
         group_results, wall, fold_epochs = [], 0.0, 0.0
+        fault_wall = 0.0
         n_groups = -(-n_folds // fold_batch)
         if (resume and checkpoint_path is not None
                 and Path(checkpoint_path).exists()
@@ -308,6 +338,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             hi = min(lo + cur_batch, n_folds)
             logger.info("Training fold group %d: folds %d-%d of %d",
                         gi, lo, hi - 1, n_folds)
+            jr.event("fold_group", group=gi, fold_lo=lo, fold_hi=hi,
+                     n_folds=n_folds, fold_batch=cur_batch)
             gpath = (None if checkpoint_path is None
                      else Path(f"{checkpoint_path}.g{gi}"))
             gsig = dict(signature or {}, fold_group=gi,
@@ -338,8 +370,9 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                         "training group %d fresh",
                         gpath, stored.get("fold_range"), [lo, hi], gi)
                     gresume = False
+            t_attempt = time.perf_counter()
             try:
-                r, w, fe = _run_folds(
+                r, w, fe, _ = _run_folds(
                     model, specs[lo:hi], pool_x, pool_y, config=config,
                     epochs=epochs, seed=seed, mesh=None,
                     checkpoint_every=checkpoint_every, checkpoint_path=gpath,
@@ -352,8 +385,22 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             except Exception as exc:  # noqa: BLE001 — gated below
                 if cur_batch <= 1 or not _is_device_fault(exc):
                     raise
+                # The faulted attempt burned real wall: fold it into the
+                # protocol wall so a halved run's wall_seconds and
+                # epoch_throughput stop over-reporting (ADVICE r5), and
+                # break it out as its own metric.
+                elapsed = time.perf_counter() - t_attempt
+                wall += elapsed
+                fault_wall += elapsed
                 cur_batch = max(1, cur_batch // 2)
                 halved = True
+                jr.event("device_fault",
+                         error=f"{type(exc).__name__}: {exc}"[:300],
+                         fold_lo=lo, fold_hi=hi,
+                         retry_fold_batch=cur_batch,
+                         elapsed_s=round(elapsed, 3))
+                jr.metrics.inc("device_fault_retries")
+                jr.metrics.inc("fault_retry_wall_s", elapsed)
                 logger.warning(
                     "Device fault training folds %d-%d (%s: %.160s) — "
                     "halving the fold group to %d and retrying from fold "
@@ -384,7 +431,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                         val_pad,
                         f"{n_folds} folds x {epochs} epochs in "
                         f"{len(group_results)} groups")
-        return results, wall, fold_epochs
+        return results, wall, fold_epochs, fault_wall
 
     if _fault_if_folds_over is not None and n_folds > _fault_if_folds_over:
         # Shaped like the measured v5e failure (UNAVAILABLE mid-group).
@@ -436,23 +483,31 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             train_pad=train_pad, val_pad=val_pad, test_pad=test_pad,
             maxnorm_mode=config.maxnorm_mode, mesh=mesh,
         )
+        # A single fused program cannot split compile from execution (eval
+        # is compiled in); the journal says so instead of faking a split.
+        jr.event("compile_begin", what="fused_trainer")
         timer = StepTimer()
         with timer:
             results = trainer(pool_x, pool_y, stacked, states, keys)
             results = jax.block_until_ready(results)
         wall = timer.total
+        jr.event("compile_end", what="fused_trainer",
+                 elapsed_s=round(wall, 3), includes_execution=True)
+        jr.sample_device_memory()
         if padded != n_folds:
             results = jax.tree_util.tree_map(lambda leaf: leaf[:n_folds],
                                              results)
         # Single fused program: per-epoch arrays only exist once the whole
         # run returns, so the cadence lines land post-hoc (chunked runs —
         # the default past AUTO_CHUNK_THRESHOLD epochs — emit them live).
-        _log_epoch_cadence(
-            (results.train_losses, results.val_losses,
-             results.val_accuracies), 0, epochs, epochs, n_folds)
+        per_epoch = (results.train_losses, results.val_losses,
+                     results.val_accuracies, results.grad_norms)
+        _log_epoch_cadence(per_epoch, 0, epochs, epochs, n_folds)
+        _journal_epochs(jr, per_epoch, 0, epochs, epochs, n_folds)
+        jr.metrics.inc("fold_epochs_total", float(n_folds * epochs))
         _log_throughput(model, config, n_folds * epochs, wall, train_pad,
                         val_pad, f"{n_folds} folds x {epochs} epochs")
-        return results, wall, float(n_folds * epochs)
+        return results, wall, float(n_folds * epochs), 0.0
 
     # --- chunked, resumable path ---
     # padded_folds in the signature: a snapshot from a different device
@@ -485,7 +540,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     # Same key schedule as the fused path: split(key, epochs) per fold.
     epoch_keys = jax.vmap(lambda k: jax.random.split(k, epochs))(keys)
     carry = jax.vmap(init_fold_carry)(states)
-    metrics = {"train_losses": [], "val_losses": [], "val_accuracies": []}
+    metrics = {"train_losses": [], "val_losses": [], "val_accuracies": [],
+               "grad_norms": []}
     start_epoch = 0
 
     if resume and checkpoint_path is not None:
@@ -530,7 +586,14 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                 carry, stored, start_epoch = ckpt_lib.load_run_snapshot(
                     checkpoint_path, carry, resume_sig)
                 for name in metrics:
-                    metrics[name] = [stored[name]]
+                    if name in stored:
+                        metrics[name] = [stored[name]]
+                    else:
+                        # Snapshot from before this metric existed (e.g.
+                        # grad_norms): zero-fill the resumed prefix rather
+                        # than reject an in-flight run over telemetry.
+                        metrics[name] = [np.zeros_like(
+                            stored["train_losses"])]
                 logger.info("Resuming from %s at epoch %d", checkpoint_path,
                             start_epoch)
         else:
@@ -543,14 +606,27 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     chunk_no = 0
     for lo in range(start_epoch, epochs, checkpoint_every):
         hi = min(lo + checkpoint_every, epochs)
+        if chunk_no == 0:
+            # First segment call compiles (or hits the persistent cache);
+            # later chunks reuse the executable, so chunk-0 wall minus a
+            # later chunk's wall bounds the compile cost.
+            jr.event("compile_begin", what="epoch_segment")
         with timer:
             carry, per_epoch = segment(pool_x, pool_y, stacked, carry,
                                        epoch_keys[:, lo:hi])
             carry = jax.block_until_ready(carry)
+        if chunk_no == 0:
+            jr.event("compile_end", what="epoch_segment",
+                     elapsed_s=round(timer.times[-1], 3),
+                     includes_execution=True)
+            jr.sample_device_memory()
+        jr.metrics.observe("chunk_wall_s", timer.times[-1])
         for name, arr in zip(
-                ("train_losses", "val_losses", "val_accuracies"), per_epoch):
+                ("train_losses", "val_losses", "val_accuracies",
+                 "grad_norms"), per_epoch):
             metrics[name].append(np.asarray(arr))
         _log_epoch_cadence(per_epoch, lo, hi, epochs, n_folds)
+        _journal_epochs(jr, per_epoch, lo, hi, epochs, n_folds)
         if checkpoint_path is not None:
             ckpt_lib.save_run_snapshot(
                 checkpoint_path, carry,
@@ -587,6 +663,8 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             [jnp.asarray(a) for a in metrics["val_losses"]], axis=1),
         val_accuracies=jnp.concatenate(
             [jnp.asarray(a) for a in metrics["val_accuracies"]], axis=1),
+        grad_norms=jnp.concatenate(
+            [jnp.asarray(a) for a in metrics["grad_norms"]], axis=1),
         test_accuracy=test_acc,
     )
     if padded != n_folds:
@@ -595,13 +673,14 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     # only the post-resume chunks, so the full epoch count would overstate
     # throughput (and MFU) by the resumed fraction.
     trained = n_folds * (epochs - start_epoch)
+    jr.metrics.inc("fold_epochs_total", float(trained))
     _log_throughput(model, config, trained, wall, train_pad, val_pad,
                     f"{n_folds} folds x {epochs - start_epoch} epochs")
     if not _keep_snapshot:
         # Complete: the run snapshot AND stale group snapshots from an
         # earlier fold_batch run of this protocol are no longer needed.
         _clear_run_snapshots(checkpoint_path)
-    return results, wall, float(trained)
+    return results, wall, float(trained), 0.0
 
 
 def _pool_digest(pool_x, pool_y) -> str:
@@ -643,11 +722,11 @@ def _log_epoch_cadence(per_epoch, lo: int, hi: int, total_epochs: int,
     cadence epoch; the fold MEAN with the val-accuracy span carries the
     same live-progress signal in one line (and keeps a 500-epoch run's GUI
     Logs tab alive between chunk lines — VERDICT r2 item 5).  ``per_epoch``
-    holds ``(train_losses, val_losses, val_accuracies)`` shaped
+    holds ``(train_losses, val_losses, val_accuracies, grad_norms)`` shaped
     ``(padded_folds, hi-lo)`` for epochs ``[lo, hi)``; padding folds (mesh
     rounding) are excluded via ``n_folds``.
     """
-    tl, vl, va = (np.asarray(a)[:n_folds] for a in per_epoch)
+    tl, vl, va = (np.asarray(a)[:n_folds] for a in per_epoch[:3])
     for e in range(lo + 1, hi + 1):
         if not (e == 1 or e % 50 == 0 or e == total_epochs):
             continue
@@ -659,6 +738,36 @@ def _log_epoch_cadence(per_epoch, lo: int, hi: int, total_epochs: int,
             e, total_epochs, float(np.mean(tl[:, i])),
             float(np.mean(vl[:, i])), float(np.mean(va[:, i])), n_folds,
             float(np.min(va[:, i])), float(np.max(va[:, i])))
+
+
+def _journal_epochs(jr, per_epoch, lo: int, hi: int, total_epochs: int,
+                    n_folds: int) -> None:
+    """Emit one fold-aggregated ``epoch`` journal event per trained epoch.
+
+    Same aggregation as :func:`_log_epoch_cadence` (fold mean over the real
+    folds) but for EVERY epoch in ``[lo, hi)`` — the journal is the
+    machine-readable record, the log lines stay at the reference's cadence.
+    The arrays already live on host (the chunk boundary materialized them),
+    so journaling adds no device syncs.  Scalars mirror to TensorBoard when
+    the run context opened with a summary-writer backend available.
+    """
+    if not jr.active:
+        return
+    tl, vl, va, gn = (np.asarray(a)[:n_folds] for a in per_epoch)
+    for e in range(lo + 1, hi + 1):
+        i = e - lo - 1
+        train_loss = float(np.mean(tl[:, i]))
+        val_loss = float(np.mean(vl[:, i]))
+        val_acc = float(np.mean(va[:, i]))
+        grad_norm = float(np.mean(gn[:, i]))
+        jr.event("epoch", epoch=e, total_epochs=total_epochs,
+                 train_loss=round(train_loss, 6),
+                 val_loss=round(val_loss, 6), val_acc=round(val_acc, 4),
+                 grad_norm=round(grad_norm, 6), n_folds=n_folds)
+        jr.scalar("train/loss", train_loss, e)
+        jr.scalar("val/loss", val_loss, e)
+        jr.scalar("val/accuracy", val_acc, e)
+        jr.scalar("train/grad_norm", grad_norm, e)
 
 
 @functools.lru_cache(maxsize=16)
@@ -799,7 +908,7 @@ def within_subject_training(epochs: int | None = None, *,
     logger.info("Training %d folds (%d subjects x %d) for %d epochs, "
                 "fused+vmapped", len(specs), len(subjects),
                 config.kfold_splits, epochs)
-    results, wall, fold_epochs_trained = _run_folds(
+    results, wall, fold_epochs_trained, fault_wall = _run_folds(
         model, specs, pool_x, pool_y, config=config, epochs=epochs,
         seed=seed, mesh=mesh, fold_batch=fold_batch,
         checkpoint_every=checkpoint_every,
@@ -834,7 +943,8 @@ def within_subject_training(epochs: int | None = None, *,
                           fold_epochs_trained=fold_epochs_trained,
                           fold_batch=_effective_fold_batch(fold_batch, mesh,
                                                            len(specs)),
-                          fold_min_val_loss=np.asarray(results.min_val_loss))
+                          fold_min_val_loss=np.asarray(results.min_val_loss),
+                          fault_retry_wall_s=fault_wall)
 
 
 def _is_device_fault(exc: BaseException) -> bool:
@@ -930,8 +1040,11 @@ def _cs_auto_fold_batch(n_folds: int, mesh, fold_batch: int | None):
         return fold_batch
     if mesh is None and jax.default_backend() != "cpu":
         # A previously discovered per-device_kind limit (written by the
-        # adaptive halving after a real fault) overrides the v5e-measured
-        # default; either way larger programs fault-halve at runtime.
+        # adaptive halving after a real fault) can only SHRINK the
+        # v5e-measured default, never raise it — the min() keeps 15 as the
+        # ceiling because it is the measured throughput optimum, not just
+        # a safety bound; either way larger programs fault-halve at
+        # runtime.
         batch = min(CS_ACCEL_FOLD_BATCH, _known_fold_batch_limit()
                     or CS_ACCEL_FOLD_BATCH)
         if n_folds > batch:
@@ -1005,7 +1118,7 @@ def cross_subject_training(epochs: int | None = None, *,
     fold_batch = _cs_auto_fold_batch(len(specs), mesh, fold_batch)
     logger.info("Training %d cross-subject folds for %d epochs, fused+vmapped",
                 len(specs), epochs)
-    results, wall, fold_epochs_trained = _run_folds(
+    results, wall, fold_epochs_trained, fault_wall = _run_folds(
         model, specs, pool_x, pool_y, config=config, epochs=epochs,
         seed=seed, mesh=mesh, fold_batch=fold_batch,
         checkpoint_every=checkpoint_every,
@@ -1043,4 +1156,5 @@ def cross_subject_training(epochs: int | None = None, *,
                           fold_epochs_trained=fold_epochs_trained,
                           fold_batch=_effective_fold_batch(fold_batch, mesh,
                                                            len(specs)),
-                          fold_min_val_loss=min_val_loss)
+                          fold_min_val_loss=min_val_loss,
+                          fault_retry_wall_s=fault_wall)

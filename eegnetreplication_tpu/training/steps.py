@@ -141,13 +141,17 @@ def apply_model(model, params, batch_stats, x, *, train: bool,
 
 def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
                maxnorm_mode: str = "reference",
-               data_axis: str | None = None):
+               data_axis: str | None = None,
+               return_grad_norm: bool = False):
     """One optimization step on a (possibly padding-weighted) batch.
 
-    Returns ``(new_state, batch_loss)``.  If the batch contains no real
-    samples (all weights zero), the state is returned unchanged — the
-    reference never runs empty batches, so neither do we (and Adam moments
-    must not decay on phantom steps).
+    Returns ``(new_state, batch_loss)``, or with ``return_grad_norm``
+    ``(new_state, batch_loss, grad_global_norm)`` — the raw (pre-clamp)
+    gradient global norm, a cheap on-chip training-health scalar the epoch
+    scanner carries out of ``lax.scan`` for the run journal.  If the batch
+    contains no real samples (all weights zero), the state is returned
+    unchanged — the reference never runs empty batches, so neither do we
+    (and Adam moments must not decay on phantom steps).
 
     With ``data_axis`` the step runs batch-sharded inside a ``shard_map``
     over that mesh axis: gradients and the loss are ``psum``-reduced, the
@@ -171,6 +175,9 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
         # shard-loss sums equal the full-batch gradient and loss.
         grads = jax.lax.psum(grads, axis_name=data_axis)
         loss = jax.lax.psum(loss, axis_name=data_axis)
+    # Raw-gradient norm (pre-maxnorm treatment): the post-psum grads are
+    # already global under DP, so no further reduction is needed.
+    grad_norm = optax.global_norm(grads) if return_grad_norm else None
 
     # Max-norm treatment is per-architecture: models declare their constrained
     # layers (EEGNet does; the ConvNet baselines declare none).
@@ -196,7 +203,10 @@ def train_step(model, tx, state: TrainState, x, y, w, dropout_rng,
         batch_stats=select(new_bs, state.batch_stats),
         opt_state=select(new_opt_state, state.opt_state),
     )
-    return new_state, jnp.where(has_real, loss, 0.0)
+    loss = jnp.where(has_real, loss, 0.0)
+    if return_grad_norm:
+        return new_state, loss, jnp.where(has_real, grad_norm, 0.0)
+    return new_state, loss
 
 
 def eval_forward(model, params, batch_stats, x, allow_pallas: bool = True):

@@ -1,0 +1,36 @@
+"""JAX version compatibility shims.
+
+The framework targets the jax>=0.7 public API, but must degrade gracefully
+on older toolchains (this container ships 0.4.x): ``jax.shard_map`` only
+became a top-level export around 0.6, and its replication-check kwarg was
+renamed ``check_rep`` -> ``check_vma`` in the same window.  Every
+``shard_map`` call site routes through :func:`shard_map` so the version
+probe lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def shard_map(fn, *, mesh, in_specs, out_specs,
+              check: bool | None = None) -> Any:
+    """``jax.shard_map`` across jax versions.
+
+    ``check=None`` keeps the library default replication checking;
+    ``check=False`` disables it via whichever kwarg this jax spells it
+    (``check_vma`` on >=0.6, ``check_rep`` on the 0.4.x experimental API).
+    """
+    kwargs = {}
+    try:
+        from jax import shard_map as sm  # jax >= 0.6 public API
+
+        if check is False:
+            kwargs["check_vma"] = False
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as sm
+
+        if check is False:
+            kwargs["check_rep"] = False
+    return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+              **kwargs)

@@ -130,11 +130,19 @@ def main(argv=None) -> int:
                 losses.tobytes()).hexdigest()[:16],
             n_params=n_params,
             protocol_wall_s=round(result.wall_seconds, 1),
+            # Wall burned by faulted-then-halved group attempts; included
+            # in protocol_wall_s (BENCH_NOTES.md metric definitions).
+            fault_retry_wall_s=round(result.fault_retry_wall_s, 1),
             protocol_fold_epochs_per_s=round(result.epoch_throughput, 2))
     except Exception as exc:  # noqa: BLE001 — the fault log IS the datum
         record.update(ok=False, wall_s=round(time.time() - t0, 1),
                       error=f"{type(exc).__name__}: {exc}"[:500])
-    (out / "cs_at_scale.json").write_text(json.dumps(record, indent=1))
+    from eegnetreplication_tpu.obs import schema as obs_schema
+
+    # Shared telemetry writer (obs/schema.py): validated envelope + atomic
+    # replace, same as every other BENCH artifact.
+    obs_schema.write_json_artifact(out / "cs_at_scale.json", record,
+                                   kind="bench", indent=1)
     print(json.dumps(record))
     return 0 if record.get("ok") else 1
 

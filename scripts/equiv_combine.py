@@ -92,9 +92,12 @@ def main(argv=None) -> int:
 
     per_subject: dict[str, dict] = {}
     ci_inside_1pp, ci_overlaps_1pp, mean_deltas = [], [], []
+    fw_subject_means, th_subject_means = [], []
     for s in subjects:
         f = np.array([r["per_subject"][str(s)]["test_acc"] for r in fw])
         t = np.array([r["per_subject"][str(s)]["test_acc"] for r in th])
+        fw_subject_means.append(float(f.mean()))
+        th_subject_means.append(float(t.mean()))
         delta = float(f.mean() - t.mean())
         # Welch: across-seed variance of each arm's mean.
         se = math.sqrt(f.var(ddof=1) / len(f) + t.var(ddof=1) / len(t))
@@ -137,10 +140,13 @@ def main(argv=None) -> int:
     nonzero = [d for d in mean_deltas if d != 0.0]
     neg = sum(d < 0 for d in nonzero)
     sign_p = _binom_two_sided_p(neg, len(nonzero))
-    fw_grand = float(np.mean([r["avg_test_acc"] for r in fw])) \
-        if all("avg_test_acc" in r for r in fw) else \
-        float(np.mean([v["framework_mean"] for v in per_subject.values()]))
-    th_grand = float(np.mean([v["torch_mean"] for v in per_subject.values()]))
+    # Symmetric grand-mean estimators (ADVICE r5): BOTH arms average the
+    # UNROUNDED per-subject across-seed means, rounding only for output.
+    # (Previously the framework arm averaged record-level avg_test_acc
+    # while the torch arm averaged 2-decimal-rounded per-subject means —
+    # up to ~0.01 pp of rounding skew baked into the headline delta.)
+    fw_grand = float(np.mean(fw_subject_means))
+    th_grand = float(np.mean(th_subject_means))
 
     record = {
         "experiment": "ws-protocol-accuracy-equivalence-multiseed",

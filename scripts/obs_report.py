@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Render telemetry run directories into a human-readable summary table.
+
+Each run directory (``<metricsDir>/<run_id>/``) holds the journal's
+``events.jsonl`` and the registry's ``metrics.json``; this script validates
+both against ``eegnetreplication_tpu/obs/schema.py`` (the same helper the
+tests use, so BENCH/obs artifacts cannot silently drift) and prints one row
+per run: protocol, device, epochs/folds, wall, throughput, fault retries,
+final losses.
+
+Usage:
+    python scripts/obs_report.py reports/obs              # a metricsDir root
+    python scripts/obs_report.py /tmp/obs/<run_id> ...    # explicit run dirs
+    python scripts/obs_report.py --json reports/obs       # machine output
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from eegnetreplication_tpu.obs import schema  # noqa: E402
+
+
+def discover_runs(paths: list[str]) -> list[Path]:
+    """Resolve CLI args into run directories (dirs holding events.jsonl).
+
+    An argument that is itself a run dir is taken as-is; otherwise it is
+    treated as a metricsDir root and scanned one level deep.
+    """
+    runs = []
+    for arg in paths:
+        p = Path(arg)
+        if (p / "events.jsonl").exists():
+            runs.append(p)
+        elif p.is_dir():
+            runs.extend(sorted(d for d in p.iterdir()
+                               if (d / "events.jsonl").exists()))
+    return runs
+
+
+def summarize_run(run_dir: Path) -> dict:
+    """Validated summary of one run directory (schema errors are reported
+    as a row, not a crash — a corrupt run must not hide the healthy ones)."""
+    out = {"dir": str(run_dir)}
+    try:
+        # complete=False: a live or crashed run is still worth a row.
+        events = schema.read_events(run_dir / "events.jsonl", complete=False)
+        out.update(schema.event_summary(events))
+        drift = [e for e in events if "_schema_error" in e]
+        if drift:
+            out["schema_drift"] = f"{len(drift)} event(s) failed validation"
+    except (OSError, schema.SchemaError) as exc:
+        out["error"] = f"{type(exc).__name__}: {exc}"[:200]
+        return out
+    metrics_path = run_dir / "metrics.json"
+    if metrics_path.exists():
+        try:
+            m = schema.read_metrics(metrics_path)
+
+            def first_value(section: str, name: str):
+                series = m[section].get(name) or []
+                return series[0]["value"] if series else None
+
+            out["fold_epochs_total"] = first_value("counters",
+                                                   "fold_epochs_total")
+            out["fault_retry_wall_s"] = first_value("counters",
+                                                    "fault_retry_wall_s")
+            out["epoch_throughput"] = first_value("gauges",
+                                                  "epoch_throughput")
+        except schema.SchemaError as exc:
+            out["metrics_error"] = str(exc)[:200]
+    return out
+
+
+_COLUMNS = (
+    ("run_id", "run"), ("status", "status"), ("protocol", "protocol"),
+    ("platform", "platform"), ("device_kind", "device"),
+    ("n_folds", "folds"), ("epochs", "epochs"),
+    ("wall_s", "wall_s"), ("epoch_throughput", "fold-ep/s"),
+    ("device_fault_retries", "faults"),
+    ("last_train_loss", "train_loss"), ("last_val_acc", "val_acc%"),
+    ("last_grad_norm", "grad_norm"),
+)
+
+
+def _cell(value) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, float):
+        return f"{value:.3f}".rstrip("0").rstrip(".")
+    return str(value)
+
+
+def render_table(summaries: list[dict]) -> str:
+    rows = [[label for _, label in _COLUMNS]]
+    for s in summaries:
+        if s.get("error"):
+            rows.append([s.get("dir", "?"), "INVALID: " + s["error"]]
+                        + ["-"] * (len(_COLUMNS) - 2))
+        else:
+            rows.append([_cell(s.get(key)) for key, _ in _COLUMNS])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(_COLUMNS))]
+    lines = []
+    for n, row in enumerate(rows):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if n == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize telemetry run directories.")
+    ap.add_argument("paths", nargs="+",
+                    help="metricsDir roots and/or individual run dirs")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object per run instead of a table")
+    args = ap.parse_args(argv)
+
+    runs = discover_runs(args.paths)
+    if not runs:
+        print(f"No run directories (events.jsonl) under {args.paths}",
+              file=sys.stderr)
+        return 1
+    summaries = [summarize_run(r) for r in runs]
+    if args.json:
+        for s in summaries:
+            print(json.dumps(s))
+    else:
+        print(render_table(summaries))
+    bad = [s for s in summaries if s.get("error") or s.get("schema_drift")]
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

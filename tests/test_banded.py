@@ -285,6 +285,7 @@ class TestTiledLongT:
                                        atol=1e-5,
                                        err_msg=f"t_out={t_out} tile={tile}")
 
+    @pytest.mark.slow
     def test_long_t_model_matches_lax_forward_and_grads(self):
         """EEGNet at a long time axis (banded => tiled path) must match
         the lax schedule through the full model and one training step."""

@@ -15,6 +15,7 @@ import unittest
 from pathlib import Path
 
 import numpy as np
+import pytest
 
 REPO = Path(__file__).resolve().parents[1]
 
@@ -61,6 +62,15 @@ class TestCLIBoundary(unittest.TestCase):
 
         shutil.rmtree(cls.tmp, ignore_errors=True)
 
+    def test_0_train_help_lists_telemetry_flag(self):
+        """`train --help` is the cheapest CI probe that the CLI imports and
+        the telemetry flag is wired."""
+        proc = _run(["eegnetreplication_tpu.train", "--help"], self.tmp,
+                    timeout=120)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        self.assertIn("--metricsDir", proc.stdout)
+        self.assertIn("--trainingType", proc.stdout)
+
     def test_1_dataset_cli(self):
         proc = _run(["eegnetreplication_tpu.dataset", "--src", "kaggle"],
                     self.tmp)
@@ -88,20 +98,56 @@ class TestCLIBoundary(unittest.TestCase):
         self.assertTrue(
             (self.tmp / "models" / "subject_01_best_model.npz").exists())
 
+    def test_2b_train_cli_writes_telemetry(self):
+        """The ISSUE-1 acceptance path: a 1-epoch, 1-subject CPU run with
+        --metricsDir yields a schema-valid events.jsonl (run_start, >=1
+        epoch event with loss and grad-norm, run_end) and metrics.json."""
+        from eegnetreplication_tpu.obs import schema
+
+        obs_dir = self.tmp / "obs_cli"
+        proc = _run(["eegnetreplication_tpu.train",
+                     "--trainingType", "Within-Subject", "--epochs", "1",
+                     "--subjects", "1", "--generateReport", "False",
+                     "--metricsDir", str(obs_dir)],
+                    self.tmp)
+        self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
+        runs = [d for d in obs_dir.iterdir()
+                if (d / "events.jsonl").exists()]
+        self.assertEqual(len(runs), 1, runs)
+        events = schema.read_events(runs[0] / "events.jsonl")
+        kinds = [e["event"] for e in events]
+        self.assertEqual(kinds[0], "run_start")
+        self.assertEqual(kinds[-1], "run_end")
+        self.assertNotIn("_schema_error",
+                         {k for e in events for k in e})
+        self.assertEqual(events[-1]["status"], "ok")
+        epochs = [e for e in events if e["event"] == "epoch"]
+        self.assertGreaterEqual(len(epochs), 1)
+        self.assertTrue(all("train_loss" in e and "grad_norm" in e
+                            for e in epochs))
+        metrics = schema.read_metrics(runs[0] / "metrics.json")
+        self.assertIn("fold_epochs_total", metrics["counters"])
+        self.assertIn("epoch_throughput", metrics["gauges"])
+
+    @pytest.mark.slow
     def test_3_generate_report_false_writes_nothing(self):
         # Quirk Q5: the reference's `--generateReport False` still wrote a
-        # report; ours must not.
+        # report; ours must not.  Telemetry goes to an explicit metricsDir
+        # outside reports/ so the run-journal default (reports/obs) does not
+        # shadow the report-writing invariant under test.
         before = set((self.tmp / "reports").glob("*")) \
             if (self.tmp / "reports").exists() else set()
         proc = _run(["eegnetreplication_tpu.train",
                      "--trainingType", "Within-Subject", "--epochs", "1",
-                     "--subjects", "1", "--generateReport", "False"],
+                     "--subjects", "1", "--generateReport", "False",
+                     "--metricsDir", str(self.tmp / "obs_q5")],
                     self.tmp)
         self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
         after = set((self.tmp / "reports").glob("*")) \
             if (self.tmp / "reports").exists() else set()
         self.assertEqual(before, after)
 
+    @pytest.mark.slow
     def test_4_train_cli_data_axis(self):
         """--meshData 2 composes within-fold DP with the fold sharding on
         the virtual 8-device mesh (conftest's XLA_FLAGS is inherited)."""
@@ -113,6 +159,7 @@ class TestCLIBoundary(unittest.TestCase):
         self.assertEqual(proc.returncode, 0, proc.stderr[-2000:])
         self.assertIn("'data': 2", proc.stderr + proc.stdout)
 
+    @pytest.mark.slow
     def test_5_train_cli_convnet_model(self):
         """The ConvNet baselines run the full protocol end-to-end through
         the CLI registry switch (VERDICT round-1 item 8)."""
@@ -130,6 +177,7 @@ class TestCLIBoundary(unittest.TestCase):
         _, _, meta = load_checkpoint(ckpt)
         self.assertEqual(meta["model"], "shallow_convnet")
 
+    @pytest.mark.slow
     def test_5b_train_cli_fold_batching(self):
         # Single-device env: under a multi-device mesh the flag is
         # (by design) ignored in favour of fold sharding.

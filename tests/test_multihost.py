@@ -15,6 +15,8 @@ import socket
 import subprocess
 import sys
 import unittest
+
+import pytest
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parents[1]
@@ -138,6 +140,7 @@ def run_two_process_workers(worker_src: str, timeout: int = 300):
 
 
 class TestMultiProcessBackend(unittest.TestCase):
+    @pytest.mark.slow
     def test_two_process_hybrid_mesh_psum(self):
         outs = run_two_process_workers(PSUM_WORKER)
         joined = "".join(outs)
@@ -146,6 +149,7 @@ class TestMultiProcessBackend(unittest.TestCase):
 
 
 class TestMultiProcessTraining(unittest.TestCase):
+    @pytest.mark.slow
     def test_fold_sharded_training_across_processes(self):
         """The actual product path: the fused fold trainer sharded over a
         hybrid mesh whose fold axis spans the process (DCN) boundary,

@@ -1,0 +1,266 @@
+"""Telemetry subsystem tests: schema, metrics registry, run journal, and
+the protocols' on-chip instrumentation end-to-end (all CPU).
+
+The journal/metrics/schema trio replaces three ad-hoc measurement paths;
+these tests pin the contracts that make that worthwhile: every emitted
+event validates (no ``_schema_error`` ever appears), a protocol run under
+a run context yields a complete ``events.jsonl`` + ``metrics.json``, a
+device fault is journaled with its retry wall, and ``scripts/obs_report.py``
+renders what the journal wrote.
+"""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu import obs
+from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
+from eegnetreplication_tpu.obs import MetricsRegistry, schema
+from synthetic import make_loader
+
+REPO = Path(__file__).resolve().parents[1]
+CFG = DEFAULT_TRAINING.replace(batch_size=16)
+
+
+def tiny_loader():
+    return make_loader(n_trials=24, n_channels=4, n_times=32, class_sep=1.5)
+
+
+class TestSchema:
+    def test_event_missing_required_keys_raises(self):
+        with pytest.raises(schema.SchemaError, match="missing required"):
+            schema.validate_event({"event": "epoch", "t": 1.0,
+                                   "run_id": "r", "epoch": 1})
+
+    def test_unknown_event_type_allowed_with_base_keys(self):
+        schema.validate_event({"event": "custom_probe", "t": 1.0,
+                               "run_id": "r", "anything": True})
+
+    def test_complete_stream_needs_start_and_end(self):
+        ep = {"event": "epoch", "t": 1.0, "run_id": "r", "epoch": 1,
+              "total_epochs": 1, "train_loss": 1.0, "val_loss": 1.0,
+              "val_acc": 50.0, "grad_norm": 0.5, "n_folds": 4}
+        with pytest.raises(schema.SchemaError, match="run_start"):
+            schema.validate_events([ep])
+        # but a live/partial stream is fine with complete=False
+        schema.validate_events([ep], complete=False)
+
+    def test_metrics_validation(self):
+        good = MetricsRegistry()
+        good.inc("n", 2.0)
+        schema.validate_metrics(good.snapshot("rid"))
+        with pytest.raises(schema.SchemaError):
+            schema.validate_metrics({"schema_version": 1, "run_id": "r",
+                                     "utc": "t", "counters": {},
+                                     "gauges": {}})  # histograms missing
+
+    def test_bench_writer_stamps_and_validates(self, tmp_path):
+        path = tmp_path / "BENCH_X.json"
+        schema.write_json_artifact(path, {"platform": "cpu", "value": 1.5})
+        rec = json.loads(path.read_text())
+        assert rec["schema_version"] == schema.SCHEMA_VERSION
+        assert "utc" in rec and rec["value"] == 1.5
+        schema.validate_bench(rec)
+        with pytest.raises(schema.SchemaError, match="platform"):
+            schema.write_json_artifact(tmp_path / "bad.json", {"value": 2})
+
+
+class TestMetricsRegistry:
+    def test_counter_accumulates_per_label_series(self):
+        m = MetricsRegistry()
+        m.inc("fold_epochs_total", 10)
+        m.inc("fold_epochs_total", 26)
+        m.inc("fold_epochs_total", 5, group="1")
+        assert m.get("fold_epochs_total") == 36
+        assert m.get("fold_epochs_total", group="1") == 5
+        with pytest.raises(ValueError, match="cannot decrease"):
+            m.inc("fold_epochs_total", -1)
+
+    def test_gauge_last_write_wins(self):
+        m = MetricsRegistry()
+        m.set("hbm_bytes_in_use", 100, device="0")
+        m.set("hbm_bytes_in_use", 200, device="0")
+        m.set("hbm_bytes_in_use", 50, device="1")
+        assert m.get("hbm_bytes_in_use", device="0") == 200
+        assert m.get("hbm_bytes_in_use", device="1") == 50
+
+    def test_histogram_aggregation(self):
+        m = MetricsRegistry()
+        for v in (1.0, 3.0, 2.0):
+            m.observe("chunk_wall_s", v)
+        snap = m.snapshot("rid")
+        [h] = snap["histograms"]["chunk_wall_s"]
+        assert h["count"] == 3 and h["sum"] == 6.0
+        assert h["min"] == 1.0 and h["max"] == 3.0 and h["mean"] == 2.0
+
+    def test_kind_collision_rejected(self):
+        m = MetricsRegistry()
+        m.inc("x")
+        with pytest.raises(ValueError, match="different kind"):
+            m.set("x", 1.0)
+
+    def test_flush_roundtrip(self, tmp_path):
+        m = MetricsRegistry()
+        m.inc("a", 2)
+        m.set("b", 3.5)
+        m.observe("c", 0.25)
+        path = m.flush(tmp_path / "metrics.json", run_id="rid")
+        rec = schema.read_metrics(path)
+        assert rec["run_id"] == "rid"
+        assert rec["counters"]["a"][0]["value"] == 2
+
+
+class TestRunJournal:
+    def test_run_context_roundtrip(self, tmp_path):
+        with obs.run(tmp_path, config={"epochs": 2}, note="test") as jr:
+            assert obs.current() is jr
+            jr.event("compile_begin", what="x")
+            jr.event("compile_end", what="x", elapsed_s=0.5)
+            jr.metrics.inc("fold_epochs_total", 8)
+        events = schema.read_events(jr.events_path)
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        assert events[0]["config"] == {"epochs": 2}
+        assert events[0]["device_kind"]
+        assert events[-1]["status"] == "ok"
+        assert not any("_schema_error" in e for e in events)
+        metrics = schema.read_metrics(jr.metrics_path)
+        assert metrics["counters"]["fold_epochs_total"][0]["value"] == 8
+        assert metrics["gauges"]["wall_seconds"][0]["value"] >= 0
+
+    def test_exception_journals_error_status(self, tmp_path):
+        with pytest.raises(RuntimeError, match="boom"):
+            with obs.run(tmp_path) as jr:
+                raise RuntimeError("boom")
+        events = schema.read_events(jr.events_path)
+        assert events[-1]["status"] == "error"
+        assert "boom" in events[-1]["error"]
+
+    def test_no_context_is_inert(self):
+        jr = obs.current()
+        assert not jr.active
+        jr.event("epoch")  # must not raise or write anywhere
+        jr.metrics.inc("x")
+        jr.run_end()
+
+    def test_dataclass_config_with_nested_path_serializes(self, tmp_path):
+        import dataclasses
+
+        @dataclasses.dataclass
+        class Cfg:
+            out: Path
+            arr: object
+
+        with obs.run(tmp_path,
+                     config=Cfg(out=tmp_path / "x", arr=np.arange(3))) as jr:
+            pass
+        events = schema.read_events(jr.events_path)
+        cfg = events[0]["config"]
+        assert cfg["out"] == str(tmp_path / "x")
+        assert isinstance(cfg["arr"], str)  # repr-coerced, not a crash
+
+    def test_unserializable_event_field_does_not_raise(self, tmp_path):
+        with obs.run(tmp_path) as jr:
+            jr.event("custom_probe", blob={1, 2})  # a set: not JSON
+        events = schema.read_events(jr.events_path)
+        probe = next(e for e in events if e["event"] == "custom_probe")
+        assert isinstance(probe["blob"], str)
+
+    def test_invalid_event_is_flagged_not_fatal(self, tmp_path):
+        with obs.run(tmp_path) as jr:
+            jr.event("epoch", epoch=1)  # missing most required keys
+        events = schema.read_events(jr.events_path)
+        bad = [e for e in events if e["event"] == "epoch"]
+        assert bad and "_schema_error" in bad[0]
+
+
+class TestProtocolTelemetry:
+    def _run_ws(self, tmp_path, **kw):
+        from eegnetreplication_tpu.training.protocols import (
+            within_subject_training,
+        )
+
+        with obs.run(tmp_path / "obs", config=CFG) as jr:
+            result = within_subject_training(
+                epochs=3, config=CFG, loader=tiny_loader(), subjects=(1,),
+                paths=Paths.from_root(tmp_path), seed=0, save_models=False,
+                **kw)
+        return result, jr
+
+    def test_ws_smoke_writes_complete_journal(self, tmp_path):
+        result, jr = self._run_ws(tmp_path, checkpoint_every=2)
+        events = schema.read_events(jr.events_path)
+        kinds = [e["event"] for e in events]
+        assert kinds[0] == "run_start" and kinds[-1] == "run_end"
+        assert "train_setup" in kinds and "compile_end" in kinds
+        assert not any("_schema_error" in e for e in events)
+        setup = next(e for e in events if e["event"] == "train_setup")
+        assert setup["n_folds"] == 4 and setup["epochs"] == 3
+        assert setup["real_train_samples"] > 0
+        epochs = [e for e in events if e["event"] == "epoch"]
+        assert len(epochs) == 3  # chunked path journals every epoch live
+        for ev in epochs:
+            assert np.isfinite(ev["train_loss"])
+            assert np.isfinite(ev["val_loss"])
+            assert ev["grad_norm"] > 0  # real gradients flowed
+        metrics = schema.read_metrics(jr.metrics_path)
+        assert metrics["counters"]["fold_epochs_total"][0]["value"] == 12
+        assert metrics["histograms"]["chunk_wall_s"][0]["count"] == 2
+
+    def test_ws_single_program_journals_epochs_posthoc(self, tmp_path):
+        result, jr = self._run_ws(tmp_path)  # 3 epochs -> one fused program
+        events = schema.read_events(jr.events_path)
+        epochs = [e for e in events if e["event"] == "epoch"]
+        assert len(epochs) == 3
+        assert all(e["grad_norm"] > 0 for e in epochs)
+        compile_end = next(e for e in events if e["event"] == "compile_end")
+        assert compile_end["includes_execution"] is True
+
+    def test_device_fault_journaled_with_retry_wall(self, tmp_path,
+                                                    monkeypatch):
+        from eegnetreplication_tpu.training import protocols as P
+
+        monkeypatch.setattr(P, "_fold_batch_limit_path",
+                            lambda: tmp_path / "limits.json")
+        # 4 folds at fold_batch=3: group 0 (3 folds) exceeds the injected
+        # 2-fold device limit, faults, halves to 1, completes all folds.
+        result, jr = self._run_ws(tmp_path, fold_batch=3,
+                                  _fault_if_folds_over=2)
+        events = schema.read_events(jr.events_path)
+        faults = [e for e in events if e["event"] == "device_fault"]
+        assert faults, "the injected fault must be journaled"
+        assert faults[0]["retry_fold_batch"] == 1
+        assert "UNAVAILABLE" in faults[0]["error"]
+        metrics = schema.read_metrics(jr.metrics_path)
+        assert metrics["counters"]["device_fault_retries"][0]["value"] >= 1
+        # ADVICE r5: the faulted attempt's wall is accounted, both in the
+        # metric and in the protocol's wall_seconds.
+        assert metrics["counters"]["fault_retry_wall_s"][0]["value"] > 0
+        assert result.fault_retry_wall_s > 0
+        assert result.wall_seconds >= result.fault_retry_wall_s
+
+    def test_obs_report_renders_run(self, tmp_path):
+        _, jr = self._run_ws(tmp_path, checkpoint_every=2)
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "obs_report.py"),
+             str(tmp_path / "obs")],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1"))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert jr.run_id in proc.stdout
+        assert "within_subject" in proc.stdout
+        proc_json = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "obs_report.py"),
+             "--json", str(jr.dir)],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1"))
+        assert proc_json.returncode == 0, proc_json.stderr[-2000:]
+        summary = json.loads(proc_json.stdout.strip().splitlines()[-1])
+        assert summary["status"] == "ok"
+        assert summary["n_epoch_events"] == 3
+        assert summary["fold_epochs_total"] == 12

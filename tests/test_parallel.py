@@ -114,6 +114,7 @@ class TestDataParallelStep:
 
 
 class TestFoldSharding:
+    @pytest.mark.slow
     def test_ws_protocol_sharded_matches_unsharded(self, devices8, tmp_path):
         loader = make_loader(n_trials=24, n_channels=4, n_times=64)
         cfg = DEFAULT_TRAINING.replace(batch_size=16)
@@ -124,6 +125,7 @@ class TestFoldSharding:
         np.testing.assert_allclose(sharded.fold_test_acc,
                                    plain.fold_test_acc, atol=1e-3)
 
+    @pytest.mark.slow
     def test_ws_protocol_data_sharded_matches_unsharded(self, devices8,
                                                         tmp_path):
         """Full protocol with a 2-wide data axis == unsharded result.

@@ -300,6 +300,7 @@ class TestLongHorizonDrift:
                                  t_prime=self.T // 32)
         return model, variables, tmodel
 
+    @pytest.mark.slow
     def test_identical_order_full_batches(self):
         """Same init, same per-epoch batch order, full batches only:
         isolates pure framework drift (torch loop vs jitted train_step)."""
@@ -357,6 +358,7 @@ class TestLongHorizonDrift:
             assert t_val >= 85.0 and j_val >= 85.0, (t_val, j_val)
         assert abs(t_val - j_val) <= 10.0, (t_val, j_val)
 
+    @pytest.mark.slow
     def test_partial_batch_bn_deviation(self):
         """Product-path deviation measured, not assumed: the fused trainer
         wrap-pads every batch to full size (``loop.py:87-102``) while the

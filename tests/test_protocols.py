@@ -212,6 +212,7 @@ class TestChunkedResume:
         assert len(result.per_subject_test_acc) == 1
         assert not snap.exists()
 
+    @pytest.mark.slow
     def test_legacy_snapshot_without_digest_resumes(self, tmp_paths, caplog):
         """A pre-digest (legacy) snapshot whose geometry matches resumes —
         content is unverifiable, and discarding an in-flight run's progress
@@ -343,6 +344,7 @@ class TestAutoChunking:
         with pytest.raises(ValueError, match="chunked run"):
             self._run(tmp_paths, epochs=4, resume=True)
 
+    @pytest.mark.slow
     def test_auto_chunked_resume_completes(self, tmp_paths):
         uninterrupted = self._run(tmp_paths, epochs=120)
         with pytest.raises(RuntimeError, match="injected crash"):
@@ -371,6 +373,7 @@ class TestFoldBatching:
             epochs=4, config=CFG, loader=loader, subjects=(1, 2),
             paths=tmp_paths, seed=0, save_models=False, **kw)
 
+    @pytest.mark.slow
     def test_batched_matches_single_program(self, tmp_paths, caplog):
         import logging
 
@@ -400,6 +403,7 @@ class TestFoldBatching:
                 np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
                                            atol=5e-4, rtol=5e-2)
 
+    @pytest.mark.slow
     def test_batched_chunked_crash_resume(self, tmp_paths):
         uninterrupted = self._run(tmp_paths, fold_batch=3, checkpoint_every=2)
         with pytest.raises(RuntimeError, match="injected crash"):
@@ -419,6 +423,7 @@ class TestFoldBatching:
         with pytest.raises(ValueError, match="fold_batch"):
             self._run(tmp_paths, fold_batch=-1)
 
+    @pytest.mark.slow
     def test_device_fault_halves_group_and_completes(self, tmp_paths,
                                                      caplog, monkeypatch):
         """An accelerator fault on a too-large group halves the group size
@@ -453,6 +458,7 @@ class TestFoldBatching:
             self._run(tmp_paths, fold_batch=3, checkpoint_every=2,
                       _crash_after_chunk=1)
 
+    @pytest.mark.slow
     def test_resume_across_group_size_change(self, tmp_paths, caplog):
         """A group snapshot from a DIFFERENT fold_batch (e.g. the old
         45-fold default crashed, the retry auto-resolves to 15) must retrain
@@ -475,6 +481,7 @@ class TestFoldBatching:
         np.testing.assert_array_equal(resumed.fold_test_acc,
                                       uninterrupted.fold_test_acc)
 
+    @pytest.mark.slow
     def test_resume_with_corrupt_group_snapshot(self, tmp_paths, caplog):
         """An existing-but-unreadable group snapshot degrades to a fresh
         retrain with a warning, not a loader crash."""
@@ -494,6 +501,7 @@ class TestFoldBatching:
         np.testing.assert_array_equal(resumed.fold_test_acc,
                                       whole.fold_test_acc)
 
+    @pytest.mark.slow
     def test_resume_across_batching_warns_and_cleans(self, tmp_paths, caplog):
         """A crashed UNBATCHED run's snapshot cannot seed a grouped retry
         (e.g. auto fold-batching kicked in on the rerun): the run must say

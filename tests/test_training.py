@@ -165,6 +165,7 @@ class TestFoldTrainer:
                                    float(np.min(np.asarray(r.val_losses))),
                                    rtol=1e-6)
 
+    @pytest.mark.slow
     def test_padded_fold_equivalent_to_exact_fold(self):
         """Padding the index arrays must not change the math."""
         model = small_model(p=0.0)  # no dropout so runs are comparable
