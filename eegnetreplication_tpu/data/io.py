@@ -7,6 +7,11 @@ subject/session holding the already-epoched trials — ``X: (n, C, T)``,
 ``y: (n,)`` — which loads in milliseconds and needs no MNE at train time.
 When MNE is installed, ``.fif`` files produced by the reference pipeline are
 also readable for drop-in compatibility.
+
+Reads go through the shared retry policy (``resil/``): processed trials
+often live on network filesystems whose transient ``OSError`` hiccups are
+worth a couple of spaced re-reads before they kill a run; the
+``data.read`` chaos site injects exactly that failure in tests.
 """
 
 from __future__ import annotations
@@ -17,7 +22,15 @@ import numpy as np
 
 from eegnetreplication_tpu.config import Paths
 from eegnetreplication_tpu.data.containers import BCICI2ADataset, concat_datasets
+from eegnetreplication_tpu.resil import inject
+from eegnetreplication_tpu.resil import retry as resil_retry
 from eegnetreplication_tpu.utils.logging import logger
+
+# Short budget: local-disk reads fail deterministically (FileNotFoundError
+# stays fatal in the classifier); only genuinely transient IO gets retried.
+READ_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.2,
+                                     max_delay_s=5.0,
+                                     retry_on=(resil_retry.TRANSIENT,))
 
 
 def trials_filename(subject: int, mode: str) -> str:
@@ -35,8 +48,12 @@ def save_trials(dataset: BCICI2ADataset, path: str | Path) -> Path:
 
 
 def load_trials(path: str | Path) -> BCICI2ADataset:
-    with np.load(Path(path)) as data:
-        return BCICI2ADataset(X=data["X"], y=data["y"])
+    def read() -> BCICI2ADataset:
+        inject.fire("data.read", path=path)
+        with np.load(Path(path)) as data:
+            return BCICI2ADataset(X=data["X"], y=data["y"])
+
+    return resil_retry.call(read, policy=READ_RETRY, site="data.read")
 
 
 def load_subject_dataset(subject: int | str = "all", mode: str = "Train",

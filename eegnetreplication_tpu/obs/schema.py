@@ -46,6 +46,11 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
               "grad_norm", "n_folds"),
     "device_fault": ("error", "fold_lo", "fold_hi", "retry_fold_batch",
                      "elapsed_s"),
+    # resil/: deterministic fault injection, shared retry policy, and
+    # checkpoint quarantine all journal through these.
+    "fault_injected": ("site", "action", "hit"),
+    "retry": ("site", "attempt", "max_attempts", "classification", "error"),
+    "checkpoint_quarantine": ("path", "quarantined_to"),
     "run_end": ("status", "wall_s"),
 }
 
@@ -113,19 +118,27 @@ def validate_events(events: list[dict], *, complete: bool = True) -> list[dict]:
     return events
 
 
-def read_events(path: str | Path, *, complete: bool = True) -> list[dict]:
-    """Load and validate an ``events.jsonl`` file."""
-    events = []
+def read_events(path: str | Path, *, complete: bool = True,
+                lenient_tail: bool = False) -> list[dict]:
+    """Load and validate an ``events.jsonl`` file.
+
+    ``lenient_tail=True`` tolerates an unparseable FINAL line: a run
+    killed mid-write (SIGKILL, OOM, preemption without grace) leaves at
+    most one truncated line at the tail, and that crash artifact must not
+    make the whole stream unreadable to post-mortem tooling
+    (``scripts/obs_report.py``).  Garbage anywhere else still raises.
+    """
     with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                events.append(json.loads(line))
-            except json.JSONDecodeError as exc:
-                raise SchemaError(
-                    f"{path}:{lineno} is not valid JSON: {exc}") from exc
+        lines = [(n, ln.strip()) for n, ln in enumerate(fh, 1) if ln.strip()]
+    events = []
+    for i, (lineno, line) in enumerate(lines):
+        try:
+            events.append(json.loads(line))
+        except json.JSONDecodeError as exc:
+            if lenient_tail and i == len(lines) - 1:
+                break  # truncated tail line: the crash artifact, skip it
+            raise SchemaError(
+                f"{path}:{lineno} is not valid JSON: {exc}") from exc
     return validate_events(events, complete=complete)
 
 
@@ -206,11 +219,20 @@ def write_json_artifact(path: str | Path, record: dict,
 def event_summary(events: list[dict]) -> dict[str, Any]:
     """Condense one run's event stream into the fields the report table
     shows (also used by tests as the canonical reading of a stream)."""
+    # A stream with no run_end is either still live or died without its
+    # terminal event (crash, SIGKILL) — indistinguishable from the stream
+    # alone, so the label stays the honest "incomplete" and the reader is
+    # never raised at (same contract as ``read_events(lenient_tail=True)``).
+    # A run that closed with ``status="preempted"`` (or any terminal
+    # status) overwrites this from its run_end below.
     out: dict[str, Any] = {"run_id": events[0]["run_id"] if events else None,
-                           "status": "incomplete", "n_events": len(events)}
+                           "status": "incomplete" if events else "empty",
+                           "n_events": len(events)}
     epochs = [e for e in events if e["event"] == "epoch"]
     faults = [e for e in events if e["event"] == "device_fault"]
     compiles = [e for e in events if e["event"] == "compile_end"]
+    injected = [e for e in events if e["event"] == "fault_injected"]
+    retries = [e for e in events if e["event"] == "retry"]
     for ev in events:
         kind = ev["event"]
         if kind == "run_start":
@@ -227,6 +249,10 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
                 out["error_message"] = ev["error"]
     out["n_epoch_events"] = len(epochs)
     out["device_fault_retries"] = len(faults)
+    if injected:
+        out["faults_injected"] = len(injected)
+    if retries:
+        out["retries"] = len(retries)
     out["compile_s"] = round(sum(e.get("elapsed_s", 0.0) for e in compiles), 2)
     if epochs:
         last = epochs[-1]

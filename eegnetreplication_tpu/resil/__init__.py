@@ -1,0 +1,44 @@
+"""Resilience subsystem: fault injection, retry policies, checkpoint
+integrity, preemption handling.
+
+The round-5 record shows real device faults are the dominant failure mode
+on this hardware (``training/protocols.py``: cross-subject programs fault
+the tunneled v5e mid-run).  PR 1 (``obs/``) gave us eyes on faults; this
+package gives us hands — every recovery decision in the framework flows
+through one subsystem and is journaled:
+
+- :mod:`~eegnetreplication_tpu.resil.inject` — a deterministic
+  fault-injection registry with named sites (``fetch.download``,
+  ``data.read``, ``train.step``, ``checkpoint.write``, ``host.preempt``)
+  that chaos plans arm from tests or the ``--chaos`` CLI flag.  Untestable
+  failure paths become one-liner tests.
+- :mod:`~eegnetreplication_tpu.resil.retry` — exponential backoff +
+  jitter with attempt/deadline budgets and a transient-vs-fatal fault
+  classifier shared by the fold-halving loop, the fetch layer and
+  snapshot IO (previously three bespoke inline policies).
+- :mod:`~eegnetreplication_tpu.resil.integrity` — sha256 content digests
+  embedded in every checkpoint/run-snapshot, verified on load; corrupt
+  files are quarantined to ``*.corrupt`` and loading falls back to the
+  newest valid generation (keep-N rotation in
+  ``training/checkpoint.py``), so resume survives a crash mid-replace.
+- :mod:`~eegnetreplication_tpu.resil.preempt` — SIGTERM/SIGINT (and the
+  armed ``host.preempt`` site) request a graceful stop: the training loop
+  raises :class:`~eegnetreplication_tpu.resil.preempt.Preempted` at the
+  next snapshot boundary, the journal records
+  ``run_end(status="preempted")``, and ``--resume`` continues from the
+  snapshot.
+
+Exercise everything end-to-end with ``scripts/chaos_drill.py``.
+"""
+
+from eegnetreplication_tpu.resil import inject, integrity, preempt, retry
+from eegnetreplication_tpu.resil.inject import FaultSpec, parse_plan
+from eegnetreplication_tpu.resil.integrity import IntegrityError
+from eegnetreplication_tpu.resil.preempt import Preempted
+from eegnetreplication_tpu.resil.retry import RetryPolicy, is_device_fault
+
+__all__ = [
+    "inject", "integrity", "preempt", "retry",
+    "FaultSpec", "parse_plan", "IntegrityError", "Preempted",
+    "RetryPolicy", "is_device_fault",
+]

@@ -1,0 +1,355 @@
+"""Deterministic fault-injection registry with named sites.
+
+Recovery code that only runs when a chip faults mid-protocol is code that
+never runs in CI.  Before this module the framework had exactly two
+test-only hooks threaded through ``_run_folds`` keyword arguments
+(``_crash_after_chunk``, ``_fault_if_folds_over``); every other failure
+path (corrupt snapshot, dropped download, preempted host) was untestable.
+
+Here instrumented code calls :func:`fire` at a **named site**; the call is
+a no-op (one dict lookup) unless a test or a ``--chaos`` plan has
+:func:`arm`-ed that site.  Arming is count-based and therefore
+deterministic — ``after=N`` skips the first N eligible hits, ``times=M``
+fires on the next M (``times=0`` = every subsequent hit) — so a chaos run
+is exactly reproducible.  Every firing is journaled as a
+``fault_injected`` event through the active run journal.
+
+Sites and their default actions:
+
+====================  =========  ==========================================
+site                  action     effect
+====================  =========  ==========================================
+``fetch.download``    raise      ``ConnectionError`` (transient — retried)
+``data.read``         raise      ``OSError`` (transient — retried)
+``train.step``        raise      device-fault-shaped ``RuntimeError``
+                                 (``UNAVAILABLE: TPU device error``) at
+                                 compiled-program dispatch
+``checkpoint.write``  corrupt    truncate+garble the staged snapshot bytes
+                                 (the crash-mid-``tmp.replace`` shape)
+``host.preempt``      preempt    request a graceful stop (same path as
+                                 SIGTERM), honored at the next snapshot
+                                 boundary
+``train.chunk``       raise      plain ``RuntimeError`` after an epoch
+                                 chunk (NOT device-fault shaped — the
+                                 ``_crash_after_chunk`` back-compat shim)
+====================  =========  ==========================================
+
+Chaos plans (the ``--chaos`` flag) are comma-separated site specs with
+colon-separated options::
+
+    --chaos "train.step:if_folds_over=4:times=0,checkpoint.write:action=corrupt,host.preempt:after=4"
+
+or ``--chaos @plan.json`` where the file holds a list of spec dicts.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, fields
+from pathlib import Path
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.utils.logging import logger
+
+# The named sites instrumented across the framework.  fire() accepts any
+# name (an extension point, like unknown journal event types), but arm()
+# rejects names outside this set so a chaos-plan typo fails loudly
+# instead of silently never firing.
+SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
+         "host.preempt", "train.chunk")
+
+ACTIONS = ("raise", "corrupt", "preempt")
+
+_EXC_TYPES: dict[str, type[Exception]] = {
+    "RuntimeError": RuntimeError,
+    "OSError": OSError,
+    "IOError": OSError,
+    "ConnectionError": ConnectionError,
+    "TimeoutError": TimeoutError,
+    "ValueError": ValueError,
+}
+
+# site -> (default action, default exception name, default message).
+# train.step's message is shaped like the measured v5e failure so the
+# adaptive fold-halving classifies it exactly like the real fault.
+_DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
+    "fetch.download": ("raise", "ConnectionError",
+                       "injected fault: fetch.download (hit {hit})"),
+    "data.read": ("raise", "OSError",
+                  "injected fault: data.read (hit {hit})"),
+    "train.step": ("raise", "RuntimeError",
+                   "UNAVAILABLE: TPU device error (injected fault: "
+                   "train.step, hit {hit})"),
+    "checkpoint.write": ("corrupt", "OSError",
+                         "injected fault: checkpoint.write (hit {hit})"),
+    "host.preempt": ("preempt", None, "injected host.preempt (hit {hit})"),
+    "train.chunk": ("raise", "RuntimeError",
+                    "injected crash after chunk {hit}"),
+}
+
+
+@dataclass
+class FaultSpec:
+    """One armed fault: which site, when it fires, and what it does.
+
+    ``after``/``times`` count **eligible** hits only (a ``train.step`` hit
+    whose program is under ``if_folds_over`` folds neither fires nor
+    advances the counter), so predicate-gated plans stay deterministic.
+    """
+
+    site: str
+    after: int = 0              # skip the first N eligible hits
+    times: int = 1              # fire on the next M hits; 0 = every hit
+    action: str | None = None   # None = the site's default action
+    exc: str | None = None      # exception class name for action="raise"
+    message: str | None = None  # may contain "{hit}"
+    if_folds_over: int | None = None  # train.step: only programs > N folds
+
+    def __post_init__(self):
+        if self.site not in SITES:
+            raise ValueError(
+                f"Unknown fault-injection site {self.site!r}; known sites: "
+                f"{', '.join(SITES)}")
+        if self.action is not None and self.action not in ACTIONS:
+            raise ValueError(
+                f"Unknown fault action {self.action!r}; expected one of "
+                f"{', '.join(ACTIONS)}")
+        if self.exc is not None and self.exc not in _EXC_TYPES:
+            raise ValueError(
+                f"Unknown exception type {self.exc!r}; expected one of "
+                f"{', '.join(sorted(_EXC_TYPES))}")
+        if self.after < 0 or self.times < 0:
+            raise ValueError(
+                f"after/times must be >= 0, got after={self.after} "
+                f"times={self.times}")
+
+
+class ArmedFault:
+    """Registry entry: a spec plus its hit/fire counters (a handle for
+    :func:`disarm`)."""
+
+    def __init__(self, spec: FaultSpec):
+        self.spec = spec
+        self.hits = 0    # eligible fire() invocations seen
+        self.fired = 0   # how many actually fired
+
+
+_registry: dict[str, list[ArmedFault]] = {}
+_lock = threading.Lock()
+
+
+def arm(spec: FaultSpec | str, **options) -> ArmedFault:
+    """Arm a site; returns a handle for :func:`disarm`.
+
+    Accepts a prebuilt :class:`FaultSpec` or a site name plus spec fields
+    as keyword options (``arm("train.step", if_folds_over=4, times=0)``).
+    """
+    if isinstance(spec, str):
+        spec = FaultSpec(site=spec, **options)
+    elif options:
+        raise TypeError("pass options either in the FaultSpec or as "
+                        "keywords, not both")
+    handle = ArmedFault(spec)
+    with _lock:
+        _registry.setdefault(spec.site, []).append(handle)
+    return handle
+
+
+def disarm(handle: ArmedFault) -> None:
+    """Remove one armed fault (no-op if already disarmed)."""
+    with _lock:
+        entries = _registry.get(handle.spec.site, [])
+        if handle in entries:
+            entries.remove(handle)
+        if not entries:
+            _registry.pop(handle.spec.site, None)
+
+
+def disarm_all() -> None:
+    """Clear the whole registry (test teardown)."""
+    with _lock:
+        _registry.clear()
+
+
+def armed() -> list[FaultSpec]:
+    """Snapshot of the currently armed specs (introspection/logging)."""
+    with _lock:
+        return [h.spec for entries in _registry.values() for h in entries]
+
+
+@contextmanager
+def scoped(*specs: FaultSpec):
+    """Arm ``specs`` for the duration of the block, then disarm them —
+    chaos stays scoped even when the injected fault propagates out."""
+    handles = [arm(s) for s in specs]
+    try:
+        yield handles
+    finally:
+        for h in handles:
+            disarm(h)
+
+
+def _eligible(spec: FaultSpec, ctx: dict) -> bool:
+    if spec.if_folds_over is not None:
+        n_folds = ctx.get("n_folds")
+        if n_folds is None or int(n_folds) <= spec.if_folds_over:
+            return False
+    return True
+
+
+def _corrupt_file(path: str | Path) -> None:
+    """Make the file at ``path`` look like a crash mid-write: truncate to
+    half its bytes and garble the tail, so every integrity layer
+    (zip/npz structure AND the embedded sha256) must catch it."""
+    p = Path(path)
+    data = p.read_bytes()
+    cut = max(1, len(data) // 2)
+    p.write_bytes(data[:cut][:-8] + b"\x00garbled" if cut > 8
+                  else b"\x00garbled")
+
+
+def fire(site: str, **ctx) -> None:
+    """Injection point: no-op unless ``site`` is armed and due.
+
+    ``ctx`` feeds predicates (``n_folds`` for ``if_folds_over``) and the
+    journal event; ``path`` names the file a ``corrupt`` action garbles.
+    Raises the spec's exception for ``action="raise"``; ``corrupt`` and
+    ``preempt`` return normally after their side effect.
+    """
+    if site not in _registry:  # hot path: nothing armed, no lock taken
+        return
+    to_fire: ArmedFault | None = None
+    with _lock:
+        for h in _registry.get(site, []):
+            if not _eligible(h.spec, ctx):
+                continue
+            # EVERY eligible spec counts the hit, even when an earlier
+            # spec fires on it — otherwise a multi-spec plan's after=N
+            # counting shifts by one per prior firing.  Only the first
+            # due spec (arm order) actually fires.
+            h.hits += 1
+            if to_fire is not None or h.hits <= h.spec.after:
+                continue
+            if h.spec.times and h.fired >= h.spec.times:
+                continue
+            h.fired += 1
+            to_fire = h
+    if to_fire is None:
+        return
+    spec = to_fire.spec
+    d_action, d_exc, d_msg = _DEFAULTS[site]
+    action = spec.action or d_action
+    message = (spec.message or d_msg or f"injected fault: {site}").replace(
+        "{hit}", str(to_fire.hits))
+
+    jr = obs_journal.current()
+    jctx = {k: (str(v) if isinstance(v, Path) else v)
+            for k, v in ctx.items()
+            if isinstance(v, (str, int, float, bool, Path)) or v is None}
+    jr.event("fault_injected", site=site, action=action, hit=to_fire.hits,
+             **jctx)
+    jr.metrics.inc("faults_injected", site=site)
+    logger.warning("Fault injection: site=%s action=%s hit=%d (%s)", site,
+                   action, to_fire.hits, message)
+
+    if action == "corrupt":
+        path = ctx.get("path")
+        if path is None:
+            raise RuntimeError(
+                f"fault site {site!r} fired with action='corrupt' but the "
+                "instrumented call passed no path=")
+        _corrupt_file(path)
+        return
+    if action == "preempt":
+        from eegnetreplication_tpu.resil import preempt
+
+        preempt.request(message)
+        return
+    exc_cls = _EXC_TYPES[spec.exc or d_exc or "RuntimeError"]
+    raise exc_cls(message)
+
+
+def parse_plan(text: str) -> list[FaultSpec]:
+    """Parse a ``--chaos`` plan into specs.
+
+    ``text`` is either ``@path/to/plan.json`` (a list of spec dicts) or a
+    comma-separated list of ``site[:key=value]...`` entries.  Integer
+    fields are coerced; unknown sites/keys raise ``ValueError`` with the
+    valid choices (a chaos plan that silently never fires is worse than
+    no plan).
+    """
+    text = text.strip()
+    if not text:
+        return []
+    valid_keys = {f.name for f in fields(FaultSpec)}
+    int_fields = {f.name for f in fields(FaultSpec)
+                  if f.type in ("int", "int | None")}
+
+    def coerce_int(key: str, value):
+        try:
+            return int(value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"Chaos plan option {key!r} must be an integer, got "
+                f"{value!r}") from None
+
+    if text.startswith("@"):
+        raw = json.loads(Path(text[1:]).read_text())
+        if not isinstance(raw, list):
+            raise ValueError(
+                f"Chaos plan file {text[1:]} must hold a JSON list of "
+                "spec objects")
+        specs = []
+        for entry in raw:
+            # Validate shape/keys/types here so a bad plan file surfaces
+            # as the same ValueError the CLI turns into a clean
+            # parser.error, not as FaultSpec's raw TypeError traceback.
+            if not isinstance(entry, dict):
+                raise ValueError(
+                    f"Chaos plan entries must be objects, got {entry!r}")
+            unknown = set(entry) - valid_keys
+            if unknown:
+                raise ValueError(
+                    f"Unknown chaos plan option(s) {sorted(unknown)} in "
+                    f"{entry!r}; valid: {', '.join(sorted(valid_keys))}")
+            kwargs = {}
+            for k, v in entry.items():
+                if k in int_fields:
+                    kwargs[k] = coerce_int(k, v) if v is not None else None
+                elif v is not None and not isinstance(v, str):
+                    # Parse-time failure guarantee: a non-string message/
+                    # exc/action must fail HERE, not minutes later when
+                    # fire() formats it.
+                    raise ValueError(
+                        f"Chaos plan option {k!r} must be a string, got "
+                        f"{v!r}")
+                else:
+                    kwargs[k] = v
+            specs.append(FaultSpec(**kwargs))
+        return specs
+
+    specs = []
+    for chunk in text.split(","):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        site, *opts = chunk.split(":")
+        kwargs: dict = {}
+        for opt in opts:
+            if "=" not in opt:
+                raise ValueError(
+                    f"Chaos plan option {opt!r} in {chunk!r} must be "
+                    "key=value")
+            key, value = opt.split("=", 1)
+            # "site" is the spec's positional head, not an option — letting
+            # it through would hit FaultSpec(site=site, **kwargs) as a
+            # TypeError the CLI's ValueError handling never catches.
+            if key not in valid_keys or key == "site":
+                raise ValueError(
+                    f"Unknown chaos plan option {key!r} in {chunk!r}; "
+                    f"valid: {', '.join(sorted(valid_keys - {'site'}))}")
+            kwargs[key] = coerce_int(key, value) if key in int_fields else value
+        specs.append(FaultSpec(site=site, **kwargs))
+    return specs

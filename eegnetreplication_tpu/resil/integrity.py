@@ -1,0 +1,80 @@
+"""Checkpoint content integrity: embedded sha256 digests.
+
+A run snapshot is the only thing standing between a crashed hours-long
+protocol and epoch 0, and a crash can land mid-``tmp.replace`` or a disk
+can silently truncate — a snapshot that LOADS but carries half a carry is
+worse than a missing one.  Every ``save_checkpoint``/``save_run_snapshot``
+therefore embeds a sha256 of its array payload (one extra npz entry,
+``__sha256__``); loaders verify it and raise :class:`IntegrityError` on
+mismatch, at which point ``training/checkpoint.py`` quarantines the file
+to ``*.corrupt`` and falls back to the newest valid generation.
+
+The digest covers every entry EXCEPT ``__signature__`` and itself: the
+run signature is validated semantically by the resume logic (and is the
+one entry legitimately rewritten in place by migration tooling/tests),
+while the array payload — params, optimizer leaves, metric history — is
+what corruption actually destroys.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Mapping
+
+import numpy as np
+
+DIGEST_KEY = "__sha256__"
+_EXCLUDED = (DIGEST_KEY, "__signature__")
+
+
+class IntegrityError(ValueError):
+    """A checkpoint's content does not match its embedded digest."""
+
+
+def content_digest(flat: Mapping[str, np.ndarray]) -> str:
+    """sha256 over the sorted (key, dtype, shape, bytes) of every entry
+    outside the excluded set — deterministic across save/load round trips
+    and insensitive to npz internal ordering."""
+    h = hashlib.sha256()
+    for key in sorted(flat):
+        if key in _EXCLUDED:
+            continue
+        arr = np.ascontiguousarray(np.asarray(flat[key]))
+        h.update(key.encode())
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def stamp(flat: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+    """Embed the content digest into ``flat`` (in place; returned for
+    chaining)."""
+    flat[DIGEST_KEY] = np.frombuffer(
+        content_digest(flat).encode(), dtype=np.uint8)
+    return flat
+
+
+def stored_digest(flat: Mapping[str, np.ndarray]) -> str | None:
+    """The embedded digest, or ``None`` for pre-integrity legacy files."""
+    if DIGEST_KEY not in flat:
+        return None
+    return bytes(np.asarray(flat[DIGEST_KEY])).decode()
+
+
+def verify(flat: Mapping[str, np.ndarray], what: str = "checkpoint") -> None:
+    """Raise :class:`IntegrityError` when ``flat`` carries a digest that
+    does not match its content.  Digest-less (legacy) files pass — an
+    unverifiable old snapshot is not evidence of corruption, and
+    discarding in-flight runs on the first post-upgrade load would be the
+    worse failure (same policy as the pool-digest resume gate).
+    """
+    stored = stored_digest(flat)
+    if stored is None:
+        return
+    actual = content_digest(flat)
+    if actual != stored:
+        raise IntegrityError(
+            f"{what}: content digest mismatch (stored {stored[:12]}..., "
+            f"recomputed {actual[:12]}...) — the file is corrupt or was "
+            "modified after save")

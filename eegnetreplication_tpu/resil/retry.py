@@ -1,0 +1,141 @@
+"""Retry policies: exponential backoff + jitter, budgets, fault classes.
+
+One classifier and one backoff engine for every recovery decision in the
+framework, replacing three bespoke inline policies (the fold-halving
+loop's ``_is_device_fault`` token match in ``training/protocols.py``, no
+retry at all in the fetch layer, no retry on snapshot IO).  Every retry
+is journaled as a ``retry`` event so a run's recovery history is part of
+its telemetry record, and on budget exhaustion the **original** exception
+propagates — a retry wrapper must never replace the root cause with its
+own bookkeeping error.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.utils.logging import logger
+
+# Accelerator-runtime fault tokens: the measured v5e failure mode is
+# ``UNAVAILABLE: TPU device error`` ~200-260 s into a 30+-fold CS group's
+# compile/run.  Deliberately narrow — Python-level errors (bad arguments,
+# injected ``train.chunk`` crashes) must propagate.  XlaRuntimeError
+# subclasses RuntimeError, so message tokens do the discrimination.
+DEVICE_FAULT_TOKENS = ("UNAVAILABLE", "RESOURCE_EXHAUSTED", "TPU device",
+                       "device error", "DATA_LOSS")
+
+# Classification outcomes (classify() return values).
+DEVICE_FAULT = "device_fault"   # accelerator runtime fault: retryable,
+                                # usually with a SMALLER program
+TRANSIENT = "transient"         # network/IO hiccup: retryable as-is
+FATAL = "fatal"                 # deterministic error: never retry
+
+
+def is_device_fault(exc: BaseException) -> bool:
+    """True for accelerator-runtime faults worth retrying with a smaller
+    program (the fold-halving trigger)."""
+    if not isinstance(exc, RuntimeError):
+        return False
+    msg = str(exc)
+    return any(tok in msg for tok in DEVICE_FAULT_TOKENS)
+
+
+def classify(exc: BaseException) -> str:
+    """Sort an exception into ``device_fault`` / ``transient`` / ``fatal``.
+
+    ``FileNotFoundError``/``PermissionError``-shaped OSErrors are
+    deterministic (the file will not appear because we waited) and stay
+    fatal; other ``OSError``/``ConnectionError``/``TimeoutError`` are
+    treated as transient infrastructure hiccups.
+    """
+    if is_device_fault(exc):
+        return DEVICE_FAULT
+    if isinstance(exc, (FileNotFoundError, NotADirectoryError,
+                        IsADirectoryError, PermissionError)):
+        return FATAL
+    if isinstance(exc, (ConnectionError, TimeoutError, OSError)):
+        return TRANSIENT
+    return FATAL
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Attempt/deadline budgets and the backoff curve.
+
+    ``delay(attempt)`` for attempt = 1, 2, ... is
+    ``base_delay_s * multiplier**(attempt-1)`` capped at ``max_delay_s``,
+    with ``±jitter`` fractional randomization so synchronized clients
+    (multi-host fetches) do not stampede in lockstep.
+    """
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.1
+    multiplier: float = 2.0
+    max_delay_s: float = 30.0
+    jitter: float = 0.1
+    deadline_s: float | None = None
+    retry_on: tuple[str, ...] = (TRANSIENT, DEVICE_FAULT)
+
+    def delay(self, attempt: int) -> float:
+        d = min(self.base_delay_s * self.multiplier ** (attempt - 1),
+                self.max_delay_s)
+        if self.jitter:
+            d *= 1.0 + random.uniform(-self.jitter, self.jitter)
+        return max(d, 0.0)
+
+
+def journal_retry(*, site: str, attempt: int, max_attempts: int,
+                  exc: BaseException, delay_s: float = 0.0,
+                  **extra: Any) -> None:
+    """Emit the shared ``retry`` journal event + metrics for one retried
+    attempt (used by :func:`call` and by the fold-halving loop, which has
+    its own retry shape — shrink the program — but the same record)."""
+    jr = obs_journal.current()
+    jr.event("retry", site=site, attempt=attempt, max_attempts=max_attempts,
+             classification=classify(exc), delay_s=round(delay_s, 3),
+             error=f"{type(exc).__name__}: {exc}"[:300], **extra)
+    jr.metrics.inc("retries_total", site=site)
+
+
+def call(fn: Callable[[], Any], *, policy: RetryPolicy | None = None,
+         site: str = "call", sleep: Callable[[float], None] = time.sleep,
+         on_retry: Callable[[BaseException, int], None] | None = None) -> Any:
+    """Run ``fn()`` under ``policy``; return its result.
+
+    Retries only classifications in ``policy.retry_on``, never past
+    ``max_attempts`` or (when set) ``deadline_s`` of wall.  When the
+    budget is exhausted the ORIGINAL exception is re-raised unchanged so
+    callers and tests see the root cause, not a retry-wrapper error.
+    """
+    policy = policy or RetryPolicy()
+    start = time.monotonic()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn()
+        except Exception as exc:  # noqa: BLE001 — classified below
+            kind = classify(exc)
+            exhausted = (
+                kind not in policy.retry_on
+                or attempt >= policy.max_attempts
+                or (policy.deadline_s is not None
+                    and time.monotonic() - start >= policy.deadline_s))
+            if exhausted:
+                raise
+            delay = policy.delay(attempt)
+            journal_retry(site=site, attempt=attempt,
+                          max_attempts=policy.max_attempts, exc=exc,
+                          delay_s=delay)
+            logger.warning(
+                "Retryable %s fault at %s (attempt %d/%d): %.200s — "
+                "backing off %.2fs", kind, site, attempt,
+                policy.max_attempts, exc, delay)
+            if on_retry is not None:
+                on_retry(exc, attempt)
+            if delay > 0:
+                sleep(delay)

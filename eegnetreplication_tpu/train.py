@@ -109,6 +109,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="Numerics sanitizer: re-run any computation "
                              "that produced a NaN un-jitted and raise with "
                              "the originating op (jax_debug_nans; slower).")
+    parser.add_argument("--chaos", type=str, default=None,
+                        help="Arm deterministic fault injection for this "
+                             "run: comma-separated site specs "
+                             "('train.step:if_folds_over=4,host.preempt:"
+                             "after=2') or @plan.json. Sites: fetch."
+                             "download, data.read, train.step, checkpoint."
+                             "write, host.preempt, train.chunk (see "
+                             "resil/inject.py). Every firing is journaled "
+                             "as a fault_injected event.")
     return parser
 
 
@@ -119,8 +128,15 @@ def main() -> None:
     select_platform()  # honor EEGTPU_PLATFORM; probe accel; else CPU fallback
     parser = build_parser()
     args = parser.parse_args()
+    from eegnetreplication_tpu import resil
     from eegnetreplication_tpu.training.protocols import AUTO_CHUNK_THRESHOLD
 
+    try:
+        # Parse at the CLI boundary: a chaos-plan typo must fail here, not
+        # silently never fire minutes into a run.
+        chaos_specs = resil.parse_plan(args.chaos) if args.chaos else []
+    except (ValueError, OSError) as exc:
+        parser.error(f"--chaos: {exc}")
     if args.checkpointEvery is not None and args.checkpointEvery < 0:
         parser.error("--checkpointEvery must be >= 0")
     if args.resume and args.checkpointEvery == 0:
@@ -183,26 +199,39 @@ def main() -> None:
     paths = Paths.from_here()
     metrics_dir = (Path(args.metricsDir) if args.metricsDir
                    else paths.reports / "obs")
+    if chaos_specs:
+        logger.warning("Chaos plan armed: %s", args.chaos)
     with obs.run(metrics_dir, config=config,
                  mesh_shape=dict(mesh.shape) if mesh is not None else None,
                  tb_dir=args.profileDir,
                  training_type=args.trainingType, model=args.model,
                  epochs=args.epochs, seed=args.seed,
-                 subjects=list(subjects)) as journal:
+                 subjects=list(subjects)) as journal, \
+            resil.preempt.guard(), resil.inject.scoped(*chaos_specs):
         train_fn = (within_subject_training
                     if args.trainingType == "Within-Subject"
                     else cross_subject_training)
         logger.info("Training %s model(s)...", args.trainingType)
-        with trace(args.profileDir):
-            result = train_fn(epochs=args.epochs, config=config,
-                              seed=args.seed, mesh=mesh,
-                              model_name=args.model,
-                              subjects=subjects,
-                              paths=paths,
-                              ckpt_format=args.ckptFormat,
-                              fold_batch=args.maxFoldsPerProgram,
-                              checkpoint_every=args.checkpointEvery,
-                              resume=args.resume)
+        try:
+            with trace(args.profileDir):
+                result = train_fn(epochs=args.epochs, config=config,
+                                  seed=args.seed, mesh=mesh,
+                                  model_name=args.model,
+                                  subjects=subjects,
+                                  paths=paths,
+                                  ckpt_format=args.ckptFormat,
+                                  fold_batch=args.maxFoldsPerProgram,
+                                  checkpoint_every=args.checkpointEvery,
+                                  resume=args.resume)
+        except resil.Preempted as exc:
+            # Graceful stop: the snapshot already landed (Preempted is only
+            # raised at the post-snapshot safe point), so close the journal
+            # as preempted — run_end is once-only, the context manager's
+            # status="error" then no-ops — and exit EX_TEMPFAIL so
+            # schedulers know a rerun with --resume continues the run.
+            journal.run_end(status="preempted", error=str(exc))
+            logger.warning("Preempted: %s", exc)
+            raise SystemExit(75) from exc
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         journal.metrics.set("epoch_throughput", result.epoch_throughput)

@@ -14,18 +14,218 @@ Here:
   ``classifier.*``) so the reference's GUI/visualisation stack can load our
   checkpoints and vice versa.  The classifier input features are permuted
   between flatten orders (NHWC ``w*F2+f`` vs NCHW ``f*T'+w``).
+
+Resilience (``resil/``): every native artifact embeds a sha256 content
+digest (:mod:`~eegnetreplication_tpu.resil.integrity`), verified on load.
+Run snapshots additionally rotate through keep-N generations
+(``snap.npz`` newest, ``snap.npz.gen1`` previous, ...; knob:
+``EEGTPU_SNAPSHOT_KEEP``), and a snapshot whose content fails integrity —
+a crash mid-``tmp.replace``, silent disk truncation, or the armed
+``checkpoint.write`` chaos site — is quarantined to ``*.corrupt`` with a
+``checkpoint_quarantine`` journal event while loading falls back to the
+newest valid generation.
 """
 
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 from typing import Any
 
 import jax
 import numpy as np
 
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import inject, integrity
+from eegnetreplication_tpu.utils.logging import logger
+
 SEP = "/"
+
+# How many run-snapshot generations survive rotation (the newest included).
+# 2 = newest + one fallback: enough to survive any single corrupt write
+# while keeping the disk cost of a large protocol carry bounded.
+DEFAULT_SNAPSHOT_KEEP = 2
+
+
+def snapshot_keep() -> int:
+    """The keep-N rotation depth (``EEGTPU_SNAPSHOT_KEEP``, min 1)."""
+    try:
+        return max(1, int(os.environ.get("EEGTPU_SNAPSHOT_KEEP",
+                                         DEFAULT_SNAPSHOT_KEEP)))
+    except ValueError:
+        return DEFAULT_SNAPSHOT_KEEP
+
+
+def _generation_path(path: Path, gen: int) -> Path:
+    """``snap.npz`` -> ``snap.npz.gen<gen>`` (gen >= 1; 0 is ``path``)."""
+    return path.with_name(f"{path.name}.gen{gen}")
+
+
+def _rotate_generations(path: Path, keep: int) -> None:
+    """Shift ``path`` into the ``.gen*`` chain before a new write replaces
+    it: genN-1 -> dropped, ..., gen1 -> gen2, path -> gen1.  ``keep`` counts
+    generations INCLUDING the about-to-land newest; ``keep=1`` keeps no
+    fallback (plain overwrite, the pre-resil behaviour)."""
+    if keep <= 1 or not path.exists():
+        return
+    _generation_path(path, keep - 1).unlink(missing_ok=True)
+    for gen in range(keep - 2, 0, -1):
+        src = _generation_path(path, gen)
+        if src.exists():
+            src.replace(_generation_path(path, gen + 1))
+    path.replace(_generation_path(path, 1))
+
+
+def _quarantine(path: Path, error: BaseException | str) -> Path:
+    """Move a corrupt artifact aside as ``<name>[.N].corrupt`` (journaled).
+
+    The corpse is preserved for post-mortem rather than deleted; resume
+    logic then falls back to the next generation.  Quarantine itself is
+    best-effort — a rename failure must not mask the original corruption.
+    """
+    target = path.with_name(path.name + ".corrupt")
+    n = 1
+    while target.exists():
+        n += 1
+        target = path.with_name(f"{path.name}.{n}.corrupt")
+    try:
+        path.replace(target)
+    except OSError as exc:
+        logger.warning("Could not quarantine corrupt checkpoint %s: %s",
+                       path, exc)
+        return path
+    logger.warning("Checkpoint %s failed integrity (%s) — quarantined to %s",
+                   path, str(error)[:200], target)
+    jr = obs_journal.current()
+    jr.event("checkpoint_quarantine", path=str(path),
+             quarantined_to=str(target), error=str(error)[:300])
+    jr.metrics.inc("checkpoints_quarantined")
+    return target
+
+
+def _read_flat(path: Path) -> dict[str, np.ndarray]:
+    with np.load(path, allow_pickle=False) as data:
+        return {k: data[k] for k in data.files}
+
+
+def _read_verified(path: Path) -> dict[str, np.ndarray]:
+    """Read a single-file artifact and check its content integrity.
+
+    Both corruption shapes surface as
+    :class:`~eegnetreplication_tpu.resil.integrity.IntegrityError` (a
+    missing file stays ``FileNotFoundError``), but only a digest mismatch
+    quarantines: a mismatched file provably IS a framework checkpoint (it
+    parsed and carries our digest entry) that got damaged, while an
+    unreadable container may be any user-supplied path handed to the
+    public loaders (predict/viz) — destructively renaming a user's
+    mis-formatted file would destroy it.  Framework-owned snapshots get
+    full quarantine-on-any-shape via :func:`_resolve_snapshot` instead.
+    """
+    try:
+        flat = _read_flat(path)
+    except FileNotFoundError:
+        raise
+    except Exception as exc:  # noqa: BLE001 — any unreadable shape
+        raise integrity.IntegrityError(
+            f"{path}: unreadable checkpoint ({exc})") from exc
+    try:
+        integrity.verify(flat, what=str(path))
+    except integrity.IntegrityError:
+        _quarantine(path, "content digest mismatch")
+        raise
+    flat.pop(integrity.DIGEST_KEY, None)
+    return flat
+
+
+def any_snapshot_generation(path: str | Path) -> bool:
+    """True when the primary snapshot or any ``.genN`` rotation generation
+    exists — the cheap existence probe resume gates must use instead of
+    ``path.exists()``, which misses the crash window where rotation already
+    renamed the primary to ``.gen1`` but the new write never landed."""
+    path = Path(path)
+    if path.exists():
+        return True
+    return any(cand.name[len(path.name) + len(".gen"):].isdigit()
+               for cand in path.parent.glob(path.name + ".gen*"))
+
+
+# Single-slot memo for the resolve walk: the resume flow probes the
+# signature (possibly more than once — the grouped path gates and then
+# re-reads) before loading the full carry; without the memo every probe
+# costs a complete decompress+sha256 pass over a potentially
+# hundreds-of-MB snapshot.  One slot only (the resume flow is strictly
+# sequential per path) so at most one snapshot's arrays are ever retained,
+# the mtime check invalidates it if the file changed in between, and the
+# terminal consumer (``load_run_snapshot``) clears it so the arrays are
+# not pinned in this module global for the rest of the run.
+_RESOLVE_MEMO: list[tuple[str, int, Path, dict]] = []
+
+
+def clear_resolve_memo() -> None:
+    """Release the resolve memo's retained snapshot arrays.  Call once a
+    resume decision is final: a probe whose snapshot is then DECLINED
+    (signature-less legacy file, content mismatch, foreign fold grouping)
+    would otherwise leave the full payload pinned in this module global
+    for the rest of the run."""
+    _RESOLVE_MEMO.clear()
+
+
+def _resolve_snapshot(path: str | Path, *,
+                      consume: bool = False) -> tuple[Path, dict] | None:
+    """Newest snapshot generation whose content passes integrity.
+
+    Walks ``path``, ``path.gen1``, ``path.gen2``, ... newest-first; any
+    candidate that cannot be read (truncated zip, garbage bytes) or whose
+    embedded sha256 mismatches is quarantined, and the walk continues to
+    the next generation — resume survives a crash mid-``tmp.replace``.
+    Returns ``(resolved_path, flat_arrays)`` or ``None``.
+    ``consume=True`` marks the flow's final resolve: the memo slot is
+    released instead of (re)populated.
+    """
+    path = Path(path)
+    if _RESOLVE_MEMO:
+        key, mtime_ns, resolved, flat = _RESOLVE_MEMO[-1]
+        hit = False
+        try:
+            hit = (key == str(path) and resolved.exists()
+                   and resolved.stat().st_mtime_ns == mtime_ns)
+        except OSError:
+            pass
+        if hit:
+            if consume:
+                _RESOLVE_MEMO.clear()
+            # Shallow copy: loaders pop entries out of the dict they get
+            # back, which must not hollow out the memo'd one.
+            return resolved, dict(flat)
+        _RESOLVE_MEMO.clear()
+    # Collect generations by globbing rather than walking until the first
+    # missing index: a quarantined generation leaves a hole in the chain
+    # (gen1 renamed to *.corrupt while gen2 survives), and stopping at the
+    # hole would strand a perfectly valid older snapshot.
+    gens = []
+    for cand in path.parent.glob(path.name + ".gen*"):
+        suffix = cand.name[len(path.name) + len(".gen"):]
+        if suffix.isdigit():
+            gens.append((int(suffix), cand))
+    candidates = [path] + [cand for _, cand in sorted(gens)]
+    for cand in candidates:
+        if not cand.exists():
+            continue
+        try:
+            flat = _read_flat(cand)
+            integrity.verify(flat, what=str(cand))
+        except Exception as exc:  # noqa: BLE001 — any unreadable shape
+            _quarantine(cand, exc)
+            continue
+        if not consume:
+            try:
+                _RESOLVE_MEMO[:] = [(str(path), cand.stat().st_mtime_ns,
+                                     cand, dict(flat))]
+            except OSError:
+                pass
+        return cand, flat
+    return None
 
 
 def _flatten(tree: Any, prefix: str) -> dict[str, np.ndarray]:
@@ -72,14 +272,29 @@ def save_checkpoint(path: str | Path, params: Any, batch_stats: Any,
     flat["__metadata__"] = np.frombuffer(
         json.dumps(metadata or {}).encode(), dtype=np.uint8
     )
-    np.savez(path, **flat)
+    integrity.stamp(flat)
+    # Atomic same-directory write: a crash (or the armed checkpoint.write
+    # chaos site, which garbles the staged bytes exactly like one) can only
+    # ever damage the staged file, never a previously valid checkpoint.
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:
+        np.savez(fh, **flat)
+    inject.fire("checkpoint.write", path=tmp, what="checkpoint")
+    tmp.replace(path)
     return path
 
 
 def load_checkpoint(path: str | Path) -> tuple[dict, dict, dict]:
-    """Load a native checkpoint; returns (params, batch_stats, metadata)."""
-    with np.load(Path(path), allow_pickle=False) as data:
-        flat = {k: data[k] for k in data.files}
+    """Load a native checkpoint; returns (params, batch_stats, metadata).
+
+    Content integrity is verified first (the embedded sha256, or plain
+    readability for a garbled container); a corrupt file is quarantined to
+    ``*.corrupt`` and
+    :class:`~eegnetreplication_tpu.resil.integrity.IntegrityError` raised —
+    a checkpoint that loads but carries damaged weights is worse than a
+    missing one.
+    """
+    flat = _read_verified(Path(path))
     metadata = json.loads(bytes(flat.pop("__metadata__")).decode())
     return (_unflatten(flat, "params" + SEP),
             _unflatten(flat, "batch_stats" + SEP), metadata)
@@ -87,7 +302,7 @@ def load_checkpoint(path: str | Path) -> tuple[dict, dict, dict]:
 
 def save_run_snapshot(path: str | Path, carry: Any,
                       metrics: dict[str, np.ndarray], epochs_done: int,
-                      signature: dict) -> Path:
+                      signature: dict, *, keep: int | None = None) -> Path:
     """Persist a mid-protocol training snapshot (all folds' carry + metrics).
 
     ``carry`` is the stacked epoch-scan carry from
@@ -97,7 +312,10 @@ def save_run_snapshot(path: str | Path, carry: Any,
     in :func:`save_checkpoint`).  ``signature`` identifies the run (protocol,
     epochs, seed, ...) so a stale snapshot is never resumed into a different
     run.  Written atomically (tmp file + rename) so a crash mid-save leaves
-    the previous snapshot intact.
+    the previous snapshot intact; the sha256 content digest plus the
+    ``keep``-generation rotation (default :func:`snapshot_keep`) make
+    resume survive even a corrupted *completed* write — the loader
+    quarantines it and falls back to ``path.gen1``.
     """
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -110,25 +328,34 @@ def save_run_snapshot(path: str | Path, carry: Any,
     flat["__epochs_done__"] = np.asarray(epochs_done, np.int64)
     flat["__signature__"] = np.frombuffer(
         json.dumps(signature, sort_keys=True).encode(), dtype=np.uint8)
+    integrity.stamp(flat)
     tmp = path.with_suffix(path.suffix + ".tmp")
     with open(tmp, "wb") as fh:
         np.savez(fh, **flat)
+    inject.fire("checkpoint.write", path=tmp, what="run_snapshot",
+                epochs_done=epochs_done)
+    _rotate_generations(path, keep if keep is not None else snapshot_keep())
     tmp.replace(path)
     return path
 
 
 def read_snapshot_signature(path: str | Path) -> dict | None:
     """Read ONLY the stored run signature from a snapshot, or ``None`` if
-    the file is unreadable / carries none (legacy).  Lets callers decide
-    how to treat a mismatched snapshot (e.g. a fold-group snapshot from a
-    different batching is retrained fresh, not a hard error) without
-    paying a full carry load."""
+    no valid generation exists / the file carries none (legacy).  Lets
+    callers decide how to treat a mismatched snapshot (e.g. a fold-group
+    snapshot from a different batching is retrained fresh, not a hard
+    error).  Corrupt generations encountered on the way are quarantined,
+    so a subsequent :func:`load_run_snapshot` resolves the same survivor.
+    """
+    resolved = _resolve_snapshot(path)
+    if resolved is None:
+        return None
+    _, flat = resolved
+    if "__signature__" not in flat:
+        return None
     try:
-        with np.load(Path(path), allow_pickle=False) as data:
-            if "__signature__" not in data.files:
-                return None
-            return json.loads(bytes(data["__signature__"]).decode())
-    except Exception:  # noqa: BLE001 — corrupt/foreign file = no signature
+        return json.loads(bytes(flat["__signature__"]).decode())
+    except ValueError:
         return None
 
 
@@ -136,12 +363,23 @@ def load_run_snapshot(path: str | Path, carry_template: Any,
                       signature: dict) -> tuple[Any, dict, int]:
     """Restore a run snapshot; returns ``(carry, metrics, epochs_done)``.
 
-    Raises ``ValueError`` if the stored signature does not match — resuming
-    into a different protocol/epoch-count/seed would silently corrupt the
-    science.
+    Resolves the newest generation that passes content integrity
+    (quarantining corrupt ones — see :func:`_resolve_snapshot`).  Raises
+    ``ValueError`` if the stored signature does not match — resuming into
+    a different protocol/epoch-count/seed would silently corrupt the
+    science — and ``FileNotFoundError`` when no valid generation survives.
     """
-    with np.load(Path(path), allow_pickle=False) as data:
-        flat = {k: data[k] for k in data.files}
+    resolved = _resolve_snapshot(path, consume=True)
+    if resolved is None:
+        raise FileNotFoundError(
+            f"No valid run snapshot at {path} (all generations corrupt or "
+            "missing)")
+    resolved_path, flat = resolved
+    if resolved_path != Path(path):
+        logger.warning(
+            "Resume: snapshot %s was corrupt — falling back to previous "
+            "generation %s", path, resolved_path)
+    flat.pop(integrity.DIGEST_KEY, None)
     stored = json.loads(bytes(flat.pop("__signature__")).decode())
     # No backfilling of missing keys: "maxnorm_mode"'s flag predates its
     # signature key, so a legacy snapshot may have run in either mode —
@@ -172,8 +410,7 @@ def load_train_state(path: str | Path, tx) -> tuple[Any, int, dict]:
     """
     from eegnetreplication_tpu.training.steps import TrainState
 
-    with np.load(Path(path), allow_pickle=False) as data:
-        flat = {k: data[k] for k in data.files}
+    flat = _read_verified(Path(path))
     metadata = json.loads(bytes(flat.pop("__metadata__")).decode())
     step = int(flat.pop("__step__", 0))
     params = _unflatten(flat, "params" + SEP)

@@ -30,8 +30,26 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from eegnetreplication_tpu.resil import inject
 from eegnetreplication_tpu.training import steps as steps_lib
 from eegnetreplication_tpu.training.steps import TrainState
+
+
+def _armed_dispatch(jitted, site: str = "train.step"):
+    """Wrap a jitted multi-fold runner so each compiled-program dispatch
+    probes the ``train.step`` fault-injection site (a dict lookup when
+    nothing is armed).  This is where a real accelerator fault surfaces on
+    the host — the ``jax.block_until_ready`` after dispatch — so chaos
+    plans (``--chaos train.step:if_folds_over=N``) raise the device-fault-
+    shaped error at exactly the point the fold-halving retry guards.
+    ``n_folds`` (the stacked leading axis, mesh padding included) feeds the
+    ``if_folds_over`` eligibility predicate.
+    """
+    def dispatch(pool_x, pool_y, specs, carry_or_states, keys):
+        inject.fire(site, n_folds=int(keys.shape[0]))
+        return jitted(pool_x, pool_y, specs, carry_or_states, keys)
+
+    return dispatch
 
 
 @flax.struct.dataclass
@@ -366,9 +384,9 @@ def make_multi_fold_trainer(model, tx, *, batch_size: int, epochs: int,
     vmapped = jax.vmap(fold_trainer, in_axes=(None, None, 0, 0, 0))
 
     if mesh is None:
-        return jax.jit(vmapped)
-    return jax.jit(shard_over_fold_axis(
-        vmapped, mesh, fold_axis, mapped=(False, False, True, True, True)))
+        return _armed_dispatch(jax.jit(vmapped))
+    return _armed_dispatch(jax.jit(shard_over_fold_axis(
+        vmapped, mesh, fold_axis, mapped=(False, False, True, True, True))))
 
 
 def make_multi_fold_segment(model, tx, *, batch_size: int,
@@ -394,9 +412,9 @@ def make_multi_fold_segment(model, tx, *, batch_size: int,
                                  data_axis=data_axis, data_shards=data_shards)
     vmapped = jax.vmap(segment, in_axes=(None, None, 0, 0, 0))
     if mesh is None:
-        return jax.jit(vmapped)
-    return jax.jit(shard_over_fold_axis(
-        vmapped, mesh, fold_axis, mapped=(False, False, True, True, True)))
+        return _armed_dispatch(jax.jit(vmapped))
+    return _armed_dispatch(jax.jit(shard_over_fold_axis(
+        vmapped, mesh, fold_axis, mapped=(False, False, True, True, True))))
 
 
 def make_multi_fold_evaluator(model, *, batch_size: int):

@@ -24,6 +24,7 @@ Protocol-defining details reproduced exactly:
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import hashlib
 import json
@@ -58,6 +59,8 @@ from eegnetreplication_tpu.training.loop import (
     make_multi_fold_trainer,
 )
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import inject, preempt
+from eegnetreplication_tpu.resil import retry as resil_retry
 from eegnetreplication_tpu.training.steps import make_optimizer
 from eegnetreplication_tpu.utils.logging import logger
 from eegnetreplication_tpu.utils.profiling import StepTimer
@@ -216,15 +219,40 @@ def _model_kwargs_for_bn(config: TrainingConfig) -> dict:
     loudly otherwise."""
     return {} if config.bn_mode == "flax" else {"bn_mode": config.bn_mode}
 
+@contextlib.contextmanager
+def _fault_shims(crash_after_chunk: int | None,
+                 fault_if_folds_over: int | None):
+    """Back-compat: the pre-resil test-only fault hooks, now thin shims
+    over the fault-injection registry (``resil.inject``).
+
+    ``_fault_if_folds_over=N`` arms ``train.step`` to raise the device-
+    fault-shaped error for every compiled program over N folds (the
+    adaptive-halving exercise); ``_crash_after_chunk=N`` arms
+    ``train.chunk`` to raise a plain RuntimeError after the Nth completed
+    chunk (NOT device-fault shaped — it must propagate, not halve).  New
+    code should arm sites directly or pass a ``--chaos`` plan.
+    """
+    specs = []
+    if fault_if_folds_over is not None:
+        specs.append(inject.FaultSpec(site="train.step", times=0,
+                                      if_folds_over=fault_if_folds_over))
+    if crash_after_chunk is not None:
+        # Legacy gate was ``chunk_no >= N`` with chunk_no starting at 1,
+        # so 0 and 1 both meant "crash after the first chunk" — clamp.
+        specs.append(inject.FaultSpec(site="train.chunk",
+                                      after=max(0, crash_after_chunk - 1),
+                                      times=1))
+    with inject.scoped(*specs):
+        yield
+
+
 def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                config: TrainingConfig, epochs: int, seed: int, mesh=None,
                checkpoint_every: int | None = None,
                checkpoint_path=None, resume: bool = False,
                signature: dict | None = None,
                fold_batch: int | None = None,
-               _states=None, _keys=None, _keep_snapshot: bool = False,
-               _crash_after_chunk: int | None = None,
-               _fault_if_folds_over: int | None = None):
+               _states=None, _keys=None, _keep_snapshot: bool = False):
     """Train all folds fused; returns ``(results, wall, fold_epochs,
     fault_retry_wall_s)`` with ``results`` a stacked FoldResult.
 
@@ -250,9 +278,12 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     the 90-fold cross-subject segment faults a v5e chip that handles 36
     comfortably).  Ignored under a mesh (shard folds across devices
     instead).  ``_states``/``_keys``/``_keep_snapshot`` are internal to
-    that grouping; ``_crash_after_chunk`` and ``_fault_if_folds_over``
-    (raise a synthetic accelerator fault for any program over N folds —
-    exercises the adaptive halving) are test-only fault-injection hooks.
+    that grouping.  Fault injection goes through the ``resil.inject``
+    registry (sites ``train.step`` at program dispatch, ``train.chunk``
+    after each snapshot, ``checkpoint.write`` inside the snapshot save,
+    ``host.preempt`` at the chunk boundary); arm sites directly, via a
+    ``--chaos`` plan, or through the legacy :func:`_fault_shims` kwargs on
+    the protocol entry points.
     """
     # The protocol programs use the algebraically fused jnp eval path only;
     # the Pallas kernel stays out of these large scanned programs (it
@@ -334,6 +365,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
         # crashed-then-halved resume retrains the reshaped groups fresh).
         gi, lo, cur_batch = 0, 0, fold_batch
         halved = False  # a fault shrank cur_batch; record it once PROVEN
+        attempt_no = 1  # attempts at the CURRENT group (resets on advance)
         while lo < n_folds:
             hi = min(lo + cur_batch, n_folds)
             logger.info("Training fold group %d: folds %d-%d of %d",
@@ -346,8 +378,12 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                         fold_range=[lo, hi])
             # A group the crashed run never reached has no snapshot; that
             # is the expected state of a batched resume, not a user error —
-            # train it fresh without the missing-snapshot warning.
-            gresume = bool(resume and gpath is not None and gpath.exists())
+            # train it fresh without the missing-snapshot warning.  The
+            # probe counts rotation generations too: a crash between
+            # rotation and the new write leaves only ``.gen1``, which is
+            # still a valid resume seed.
+            gresume = bool(resume and gpath is not None
+                           and ckpt_lib.any_snapshot_generation(gpath))
             if gresume:
                 stored = ckpt_lib.read_snapshot_signature(gpath)
                 if stored is None:
@@ -379,11 +415,13 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                     resume=gresume, signature=gsig,
                     _states=jax.tree_util.tree_map(
                         lambda l: l[lo:hi], states),
-                    _keys=keys[lo:hi], _keep_snapshot=True,
-                    _crash_after_chunk=_crash_after_chunk,
-                    _fault_if_folds_over=_fault_if_folds_over)
-            except Exception as exc:  # noqa: BLE001 — gated below
-                if cur_batch <= 1 or not _is_device_fault(exc):
+                    _keys=keys[lo:hi], _keep_snapshot=True)
+            except Exception as exc:  # noqa: BLE001 — classified below
+                # The shared resil classifier decides retryability: only
+                # accelerator-runtime faults are worth a smaller program;
+                # Python-level errors (injected train.chunk crashes,
+                # Preempted, bad arguments) must propagate.
+                if cur_batch <= 1 or not resil_retry.is_device_fault(exc):
                     raise
                 # The faulted attempt burned real wall: fold it into the
                 # protocol wall so a halved run's wall_seconds and
@@ -399,6 +437,15 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                          fold_lo=lo, fold_hi=hi,
                          retry_fold_batch=cur_batch,
                          elapsed_s=round(elapsed, 3))
+                # The fold-halving loop is a retry policy whose backoff is
+                # "shrink the program", not "wait" — journal it through the
+                # same shared record as every other retry so a run's
+                # recovery history reads uniformly.
+                resil_retry.journal_retry(
+                    site="train.step", attempt=attempt_no, max_attempts=0,
+                    exc=exc, fold_lo=lo, fold_hi=hi,
+                    retry_fold_batch=cur_batch)
+                attempt_no += 1
                 jr.metrics.inc("device_fault_retries")
                 jr.metrics.inc("fault_retry_wall_s", elapsed)
                 logger.warning(
@@ -410,7 +457,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             group_results.append(r)
             wall += w
             fold_epochs += fe
-            lo, gi = hi, gi + 1
+            lo, gi, attempt_no = hi, gi + 1, 1
             if halved:
                 # Only a size that COMPLETED a group is worth remembering
                 # (recording at fault time would let a transient
@@ -432,12 +479,6 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                         f"{n_folds} folds x {epochs} epochs in "
                         f"{len(group_results)} groups")
         return results, wall, fold_epochs, fault_wall
-
-    if _fault_if_folds_over is not None and n_folds > _fault_if_folds_over:
-        # Shaped like the measured v5e failure (UNAVAILABLE mid-group).
-        raise RuntimeError(
-            f"UNAVAILABLE: TPU device error (injected test fault: "
-            f"{n_folds} folds > {_fault_if_folds_over})")
 
     stacked = _stack_specs(specs)
 
@@ -478,6 +519,13 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             "auto default with epochs > "
             f"{AUTO_CHUNK_THRESHOLD}); this run is a single fused program")
     if not checkpoint_every:
+        # Last safe point before a fused program that cannot be interrupted
+        # mid-flight: a pending SIGTERM/SIGINT stops HERE (nothing trained
+        # yet, nothing lost) instead of being silently swallowed for the
+        # whole program — a fused run has no chunk boundaries to honor it
+        # at, and burning the preemption grace window to then die under
+        # SIGKILL with nothing journaled is the worst outcome.
+        preempt.check(n_folds=n_folds, what="fused_dispatch")
         trainer = make_multi_fold_trainer(
             model, tx, batch_size=config.batch_size, epochs=epochs,
             train_pad=train_pad, val_pad=val_pad, test_pad=test_pad,
@@ -545,8 +593,23 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     start_epoch = 0
 
     if resume and checkpoint_path is not None:
-        if Path(checkpoint_path).exists():
-            stored_sig = ckpt_lib.read_snapshot_signature(checkpoint_path)
+        # The signature read resolves through the keep-N generation chain:
+        # a corrupt newest snapshot is quarantined there and the previous
+        # generation answers instead, so this branch must NOT gate on the
+        # primary file still existing.
+        stored_sig = ckpt_lib.read_snapshot_signature(checkpoint_path)
+        if stored_sig is None and not Path(checkpoint_path).exists():
+            logger.warning(
+                "--resume requested but no snapshot at %s; training from "
+                "scratch (check the model/protocol names match the crashed "
+                "run)", checkpoint_path)
+        elif stored_sig is None:
+            # Exists but signature-less (legacy format, foreign file):
+            # not resumable — retrain fresh rather than crash in the loader.
+            logger.warning(
+                "Resume: snapshot %s is unreadable — training from "
+                "scratch", checkpoint_path)
+        else:
 
             def _sans_digest(sig):
                 return {k: v for k, v in (sig or {}).items()
@@ -596,12 +659,11 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                             stored["train_losses"])]
                 logger.info("Resuming from %s at epoch %d", checkpoint_path,
                             start_epoch)
-        else:
-            logger.warning(
-                "--resume requested but no snapshot at %s; training from "
-                "scratch (check the model/protocol names match the crashed "
-                "run)", checkpoint_path)
 
+    # The resume decision is final (loaded or declined): release the
+    # resolve memo so a declined snapshot's arrays are not pinned in the
+    # checkpoint module for the rest of the run.
+    ckpt_lib.clear_resolve_memo()
     timer = StepTimer()
     chunk_no = 0
     for lo in range(start_epoch, epochs, checkpoint_every):
@@ -634,9 +696,18 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                 epochs_done=hi, signature=signature)
             logger.info("Checkpointed %d/%d epochs to %s", hi, epochs,
                         checkpoint_path)
+        # The chunk boundary is the safe point: the snapshot (when this
+        # run keeps one) just landed, so a pending SIGTERM/SIGINT (or the
+        # armed host.preempt chaos site) stops the run HERE, losing
+        # nothing — raises Preempted, which the entrypoint journals as
+        # run_end(status="preempted").  Snapshot-less chunked runs honor
+        # the stop too (no resume seed, but a journaled graceful end
+        # beats burning the grace window to be SIGKILLed mid-flight).
+        preempt.check(chunk=chunk_no, epochs_done=hi, n_folds=n_folds)
         chunk_no += 1
-        if _crash_after_chunk is not None and chunk_no >= _crash_after_chunk:
-            raise RuntimeError(f"injected crash after chunk {chunk_no}")
+        # Legacy _crash_after_chunk shim + chaos plans: a plain (non-
+        # device-fault) crash after a completed chunk, exercising resume.
+        inject.fire("train.chunk", chunk=chunk_no, n_folds=n_folds)
 
     _, best_state, best_acc, min_loss = carry
     evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size)
@@ -697,10 +768,13 @@ def _pool_digest(pool_x, pool_y) -> str:
 
 
 def _clear_run_snapshots(checkpoint_path) -> None:
-    """Delete a completed protocol's run snapshot and any ``.g*`` group
-    snapshots sharing its path (stale leftovers from a differently-batched
-    crashed run included).  Shared by the grouped and ungrouped completion
-    paths so their cleanup policy cannot diverge."""
+    """Delete a completed protocol's run snapshot and every sibling file
+    sharing its path: ``.g*`` group snapshots (stale leftovers from a
+    differently-batched crashed run included), ``.gen*`` rotation
+    generations, and ``*.corrupt`` quarantine corpses — once the protocol
+    COMPLETED, the recovery succeeded and the corpses' diagnostic value is
+    spent.  Shared by the grouped and ungrouped completion paths so their
+    cleanup policy cannot diverge."""
     if checkpoint_path is None:
         return
     cp = Path(checkpoint_path)
@@ -708,8 +782,12 @@ def _clear_run_snapshots(checkpoint_path) -> None:
     # exists()/glob() check and here; a completed hours-long run must not
     # die on its very last filesystem call (ADVICE r3).
     cp.unlink(missing_ok=True)
-    for stale in cp.parent.glob(cp.name + ".g*"):
-        stale.unlink(missing_ok=True)
+    # .g* covers group snapshots AND .gen* rotation files (plus their own
+    # .gen*/.corrupt descendants); the second glob catches the ungrouped
+    # snapshot's quarantined corpses.
+    for pattern in (".g*", "*.corrupt"):
+        for stale in cp.parent.glob(cp.name + pattern):
+            stale.unlink(missing_ok=True)
 
 
 def _log_epoch_cadence(per_epoch, lo: int, hi: int, total_epochs: int,
@@ -908,16 +986,16 @@ def within_subject_training(epochs: int | None = None, *,
     logger.info("Training %d folds (%d subjects x %d) for %d epochs, "
                 "fused+vmapped", len(specs), len(subjects),
                 config.kfold_splits, epochs)
-    results, wall, fold_epochs_trained, fault_wall = _run_folds(
-        model, specs, pool_x, pool_y, config=config, epochs=epochs,
-        seed=seed, mesh=mesh, fold_batch=fold_batch,
-        checkpoint_every=checkpoint_every,
-        checkpoint_path=paths.models / f"within_subject_{model_name}.run.npz",
-        resume=resume,
-        signature={"protocol": "within_subject", "model": model_name,
-                   "subjects": list(subjects)},
-        _crash_after_chunk=_crash_after_chunk,
-        _fault_if_folds_over=_fault_if_folds_over)
+    with _fault_shims(_crash_after_chunk, _fault_if_folds_over):
+        results, wall, fold_epochs_trained, fault_wall = _run_folds(
+            model, specs, pool_x, pool_y, config=config, epochs=epochs,
+            seed=seed, mesh=mesh, fold_batch=fold_batch,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=(paths.models
+                             / f"within_subject_{model_name}.run.npz"),
+            resume=resume,
+            signature={"protocol": "within_subject", "model": model_name,
+                       "subjects": list(subjects)})
 
     fold_test = np.asarray(results.test_accuracy)  # (n_subjects*4,)
     fold_best_val = np.asarray(results.best_val_acc)
@@ -945,22 +1023,6 @@ def within_subject_training(epochs: int | None = None, *,
                                                            len(specs)),
                           fold_min_val_loss=np.asarray(results.min_val_loss),
                           fault_retry_wall_s=fault_wall)
-
-
-def _is_device_fault(exc: BaseException) -> bool:
-    """True for accelerator-runtime faults worth retrying with a smaller
-    program — the measured v5e failure mode is ``UNAVAILABLE: TPU device
-    error`` ~200-260 s into a 30+-fold CS group's compile/run.
-    Deliberately narrow: Python-level errors (bad arguments, the injected
-    ``_crash_after_chunk`` test crash) must propagate.  XlaRuntimeError
-    subclasses RuntimeError, so the message tokens do the discrimination.
-    """
-    if not isinstance(exc, RuntimeError):
-        return False
-    msg = str(exc)
-    return any(tok in msg for tok in
-               ("UNAVAILABLE", "RESOURCE_EXHAUSTED", "TPU device",
-                "device error", "DATA_LOSS"))
 
 
 def _fold_batch_limit_path() -> Path:
@@ -1118,16 +1180,16 @@ def cross_subject_training(epochs: int | None = None, *,
     fold_batch = _cs_auto_fold_batch(len(specs), mesh, fold_batch)
     logger.info("Training %d cross-subject folds for %d epochs, fused+vmapped",
                 len(specs), epochs)
-    results, wall, fold_epochs_trained, fault_wall = _run_folds(
-        model, specs, pool_x, pool_y, config=config, epochs=epochs,
-        seed=seed, mesh=mesh, fold_batch=fold_batch,
-        checkpoint_every=checkpoint_every,
-        checkpoint_path=paths.models / f"cross_subject_{model_name}.run.npz",
-        resume=resume,
-        signature={"protocol": "cross_subject", "model": model_name,
-                   "subjects": list(subjects)},
-        _crash_after_chunk=_crash_after_chunk,
-        _fault_if_folds_over=_fault_if_folds_over)
+    with _fault_shims(_crash_after_chunk, _fault_if_folds_over):
+        results, wall, fold_epochs_trained, fault_wall = _run_folds(
+            model, specs, pool_x, pool_y, config=config, epochs=epochs,
+            seed=seed, mesh=mesh, fold_batch=fold_batch,
+            checkpoint_every=checkpoint_every,
+            checkpoint_path=(paths.models
+                             / f"cross_subject_{model_name}.run.npz"),
+            resume=resume,
+            signature={"protocol": "cross_subject", "model": model_name,
+                       "subjects": list(subjects)})
 
     fold_test = np.asarray(results.test_accuracy)
     min_val_loss = np.asarray(results.min_val_loss)

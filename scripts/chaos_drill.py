@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Chaos drill: exercise every fault-injection site end-to-end on CPU.
+
+Each leg arms one ``resil.inject`` site, runs a tiny synthetic protocol
+(or the relevant IO path) under it, and asserts the run COMPLETES with the
+expected recovery journaled — the executable proof that the framework's
+resilience machinery works as a system, not just as units.  The final
+``combined`` leg is the acceptance drill: ``checkpoint.write`` corruption
++ ``train.step`` device fault + ``host.preempt`` on a 2-subject protocol,
+preempted mid-run, resumed, and finished with a correct final report.
+
+Runs on CPU with no real data and no network (fake fetch backend); wall is
+a few minutes (compile-dominated), so the tier-1 gate invokes it behind
+``pytest -m slow`` only (``tests/test_resilience.py::TestChaosDrill``).
+
+Usage:
+    python scripts/chaos_drill.py [--root DIR] [--legs train.step,combined]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import types
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+import jax  # noqa: E402
+
+# The drill is a CPU exercise by contract (the injected train.step fault
+# IS the accelerator failure, shaped like the measured v5e one).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from eegnetreplication_tpu import obs  # noqa: E402
+from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths  # noqa: E402
+from eegnetreplication_tpu.data.containers import BCICI2ADataset  # noqa: E402
+from eegnetreplication_tpu.obs import schema  # noqa: E402
+from eegnetreplication_tpu.resil import inject, preempt, retry  # noqa: E402
+from eegnetreplication_tpu.training.protocols import (  # noqa: E402
+    within_subject_training,
+)
+from eegnetreplication_tpu.training.report import generate_ws_report  # noqa: E402
+
+CFG = DEFAULT_TRAINING.replace(batch_size=16)
+FAST = retry.RetryPolicy(max_attempts=4, base_delay_s=0.0, jitter=0.0)
+
+
+def synthetic_loader(subject: int, mode: str) -> BCICI2ADataset:
+    """Deterministic tiny per-subject dataset (mirror of tests/synthetic)."""
+    rng = np.random.RandomState(subject * 100 + (0 if mode == "Train" else 1))
+    n_trials, n_channels, n_times = 24, 4, 64
+    t = np.arange(n_times) / 64.0
+    y = rng.randint(0, 4, size=n_trials)
+    X = rng.randn(n_trials, n_channels, n_times).astype(np.float32) * 0.5
+    for k in range(4):
+        sig = 1.5 * np.sin(2 * np.pi * (4.0 + 4.0 * k) * t)
+        X[y == k] += sig[None, None, :].astype(np.float32)
+    return BCICI2ADataset(X=X, y=y.astype(np.int64))
+
+
+def _isolate_fold_batch_record(root: Path) -> None:
+    """Keep the drill's halving discoveries out of the real per-user record."""
+    from eegnetreplication_tpu.training import protocols as P
+
+    P._fold_batch_limit_path = lambda: root / "fold_batch_limit.json"
+
+
+def _events(jr) -> list[dict]:
+    return schema.read_events(jr.events_path, complete=False)
+
+
+def _kinds(events: list[dict]) -> set[str]:
+    return {e["event"] for e in events}
+
+
+def _fresh(root: Path, leg: str) -> Paths:
+    leg_root = root / leg.replace(".", "_")
+    shutil.rmtree(leg_root, ignore_errors=True)
+    return Paths.from_root(leg_root)
+
+
+def _run_ws(paths: Paths, *, subjects=(1,), epochs=6, **kw):
+    return within_subject_training(
+        epochs=epochs, config=CFG, loader=synthetic_loader,
+        subjects=subjects, paths=paths, seed=0, save_models=False, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Legs: one per armed site, plus the combined acceptance drill.
+
+
+def leg_train_step(root: Path) -> None:
+    """Armed device fault at dispatch -> fold-halving completes the run."""
+    paths = _fresh(root, "train.step")
+    with obs.run(root / "obs" / "train_step") as jr:
+        with inject.scoped(inject.FaultSpec(site="train.step", times=0,
+                                            if_folds_over=2)):
+            result = _run_ws(paths, fold_batch=3)
+    kinds = _kinds(_events(jr))
+    assert {"fault_injected", "device_fault", "retry"} <= kinds, kinds
+    assert np.isfinite(result.avg_test_acc)
+
+
+def leg_train_chunk(root: Path) -> None:
+    """Armed plain crash after chunk 1 -> --resume completes the run."""
+    paths = _fresh(root, "train.chunk")
+    baseline = _run_ws(paths, checkpoint_every=2)
+    try:
+        with inject.scoped(inject.FaultSpec(site="train.chunk", times=1)):
+            _run_ws(paths, checkpoint_every=2)
+        raise AssertionError("armed train.chunk did not crash")
+    except RuntimeError as exc:
+        assert "injected crash" in str(exc), exc
+    resumed = _run_ws(paths, checkpoint_every=2, resume=True)
+    np.testing.assert_array_equal(resumed.fold_test_acc,
+                                  baseline.fold_test_acc)
+
+
+def leg_checkpoint_write(root: Path) -> None:
+    """Corrupted snapshot write -> quarantine on resume, run completes."""
+    paths = _fresh(root, "checkpoint.write")
+    try:
+        with inject.scoped(
+                inject.FaultSpec(site="checkpoint.write", times=1),
+                inject.FaultSpec(site="train.chunk", times=1)):
+            _run_ws(paths, checkpoint_every=2)
+        raise AssertionError("armed train.chunk did not crash")
+    except RuntimeError as exc:
+        assert "injected crash" in str(exc), exc
+    # The only snapshot was garbled mid-write: resume must quarantine it
+    # and complete from scratch rather than resuming damaged state.
+    with obs.run(root / "obs" / "checkpoint_write") as jr:
+        result = _run_ws(paths, checkpoint_every=2, resume=True)
+    assert "checkpoint_quarantine" in _kinds(_events(jr))
+    assert np.isfinite(result.avg_test_acc)
+
+
+def leg_host_preempt(root: Path) -> None:
+    """Armed preemption -> snapshot + preempted run_end -> --resume."""
+    paths = _fresh(root, "host.preempt")
+    baseline = _run_ws(paths, checkpoint_every=2)
+    with obs.run(root / "obs" / "host_preempt") as jr:
+        try:
+            with inject.scoped(inject.FaultSpec(site="host.preempt",
+                                                times=1)):
+                _run_ws(paths, checkpoint_every=2)
+            raise AssertionError("armed host.preempt did not stop the run")
+        except preempt.Preempted:
+            jr.run_end(status="preempted", error="drill preemption")
+    events = _events(jr)
+    assert events[-1]["event"] == "run_end", events[-1]
+    assert events[-1]["status"] == "preempted", events[-1]
+    preempt.clear()  # a real rerun is a fresh process
+    resumed = _run_ws(paths, checkpoint_every=2, resume=True)
+    np.testing.assert_array_equal(resumed.fold_test_acc,
+                                  baseline.fold_test_acc)
+
+
+def leg_data_read(root: Path) -> None:
+    """Armed transient read fault -> retry policy completes the load."""
+    from eegnetreplication_tpu.data import io as data_io
+
+    data_io.READ_RETRY = FAST
+    ds = synthetic_loader(1, "Train")
+    p = data_io.save_trials(ds, root / "data_read" / "t.npz")
+    with obs.run(root / "obs" / "data_read") as jr:
+        with inject.scoped(inject.FaultSpec(site="data.read", times=1)):
+            loaded = data_io.load_trials(p)
+    assert loaded.X.shape == ds.X.shape
+    assert "retry" in _kinds(_events(jr))
+
+
+def leg_fetch_download(root: Path) -> None:
+    """Armed download fault -> retry completes the (fake-backend) fetch."""
+    import eegnetreplication_tpu.fetch as fetch
+
+    fetch.DOWNLOAD_RETRY = FAST
+    cache = root / "fetch_cache"
+    cache.mkdir(parents=True, exist_ok=True)
+    (cache / "A01T.gdf").write_bytes(b"gdf-bytes")
+    fake = types.ModuleType("kagglehub")
+    fake.dataset_download = lambda dataset: str(cache)
+    sys.modules["kagglehub"] = fake
+    try:
+        paths = _fresh(root, "fetch.download")
+        with obs.run(root / "obs" / "fetch_download") as jr:
+            with inject.scoped(inject.FaultSpec(site="fetch.download",
+                                                times=2)):
+                out = fetch.fetch_from_kaggle(paths=paths)
+    finally:
+        del sys.modules["kagglehub"]
+    assert (out / "A01T.gdf").read_bytes() == b"gdf-bytes"
+    assert sum(e["event"] == "retry" for e in _events(jr)) == 2
+
+
+def leg_combined(root: Path) -> None:
+    """The acceptance drill: checkpoint.write corruption + train.step
+    device fault + host.preempt on a 2-subject protocol; preempted mid-run,
+    resumed, finished with a correct final report."""
+    paths = _fresh(root, "combined")
+    plan = inject.parse_plan(
+        "train.step:if_folds_over=4:times=0,"
+        "checkpoint.write:after=0:times=1,"
+        "host.preempt:after=1:times=1")
+    with obs.run(root / "obs" / "combined_leg1") as jr1:
+        try:
+            with inject.scoped(*plan):
+                _run_ws(paths, subjects=(1, 2), checkpoint_every=2,
+                        fold_batch=6)
+            raise AssertionError("combined plan did not preempt the run")
+        except preempt.Preempted:
+            jr1.run_end(status="preempted", error="drill preemption")
+    ev1 = _events(jr1)
+    sites_fired = {e["site"] for e in ev1 if e["event"] == "fault_injected"}
+    assert {"train.step", "checkpoint.write", "host.preempt"} <= sites_fired, (
+        sites_fired)
+    kinds = _kinds(ev1)
+    assert {"device_fault", "retry"} <= kinds, kinds
+    assert ev1[-1]["event"] == "run_end" and ev1[-1]["status"] == "preempted"
+    preempt.clear()
+
+    # Rerun with --resume under the same still-hostile device (train.step
+    # keeps faulting programs over 4 folds) and no further chaos.
+    with obs.run(root / "obs" / "combined_leg2") as jr2:
+        with inject.scoped(inject.FaultSpec(site="train.step", times=0,
+                                            if_folds_over=4)):
+            result = _run_ws(paths, subjects=(1, 2), checkpoint_every=2,
+                             fold_batch=6, resume=True)
+    ev2 = _events(jr2)
+    assert ev2[-1]["event"] == "run_end" and ev2[-1]["status"] == "ok", ev2[-1]
+    assert len(result.per_subject_test_acc) == 2
+    assert np.isfinite(result.avg_test_acc)
+
+    generate_ws_report(result.per_subject_test_acc, result.avg_test_acc,
+                       result.best_states, epochs=result.epochs,
+                       subjects=result.subjects, config=CFG, paths=paths)
+    report_path = paths.reports / "latest_within_subject_report.json"
+    report = json.loads(report_path.read_text())
+    assert report["training_type"] == "Within-Subject"
+    assert report["overall_results"]["number_of_subjects"] == 2
+    assert report["overall_results"]["average_test_accuracy"] == round(
+        float(result.avg_test_acc), 2)
+
+
+LEGS = {
+    "train.step": leg_train_step,
+    "train.chunk": leg_train_chunk,
+    "checkpoint.write": leg_checkpoint_write,
+    "host.preempt": leg_host_preempt,
+    "data.read": leg_data_read,
+    "fetch.download": leg_fetch_download,
+    "combined": leg_combined,
+}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="Run the resilience chaos drill.")
+    ap.add_argument("--root", default=None,
+                    help="Scratch directory (default: a fresh temp dir).")
+    ap.add_argument("--legs", default=None,
+                    help="Comma-separated leg names (default: all). "
+                         f"Known: {', '.join(LEGS)}")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else Path(tempfile.mkdtemp(
+        prefix="eegtpu_chaos_"))
+    root.mkdir(parents=True, exist_ok=True)
+    _isolate_fold_batch_record(root)
+    names = ([n.strip() for n in args.legs.split(",") if n.strip()]
+             if args.legs else list(LEGS))
+    unknown = [n for n in names if n not in LEGS]
+    if unknown:
+        ap.error(f"unknown legs {unknown}; known: {', '.join(LEGS)}")
+
+    failures = []
+    for name in names:
+        print(f"[chaos_drill] leg {name} ...", flush=True)
+        try:
+            LEGS[name](root)
+            print(f"[chaos_drill] leg {name}: PASS", flush=True)
+        except Exception as exc:  # noqa: BLE001 — report and continue
+            failures.append((name, exc))
+            print(f"[chaos_drill] leg {name}: FAIL — "
+                  f"{type(exc).__name__}: {exc}", flush=True)
+        finally:
+            inject.disarm_all()
+            preempt.clear()
+    if failures:
+        print(f"[chaos_drill] {len(failures)}/{len(names)} legs FAILED")
+        return 1
+    print(f"[chaos_drill] ALL LEGS PASSED ({len(names)}) — root: {root}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,8 +49,13 @@ def summarize_run(run_dir: Path) -> dict:
     as a row, not a crash — a corrupt run must not hide the healthy ones)."""
     out = {"dir": str(run_dir)}
     try:
-        # complete=False: a live or crashed run is still worth a row.
-        events = schema.read_events(run_dir / "events.jsonl", complete=False)
+        # complete=False: a live, crashed, or preempted run is still worth
+        # a row (event_summary reports a missing run_end as "incomplete" —
+        # live and crashed are indistinguishable from the stream alone);
+        # lenient_tail: a run killed mid-write leaves one truncated final
+        # line, which must not make the whole stream unreadable.
+        events = schema.read_events(run_dir / "events.jsonl", complete=False,
+                                    lenient_tail=True)
         out.update(schema.event_summary(events))
         drift = [e for e in events if "_schema_error" in e]
         if drift:
@@ -84,6 +89,7 @@ _COLUMNS = (
     ("n_folds", "folds"), ("epochs", "epochs"),
     ("wall_s", "wall_s"), ("epoch_throughput", "fold-ep/s"),
     ("device_fault_retries", "faults"),
+    ("faults_injected", "injected"), ("retries", "retries"),
     ("last_train_loss", "train_loss"), ("last_val_acc", "val_acc%"),
     ("last_grad_norm", "grad_norm"),
 )

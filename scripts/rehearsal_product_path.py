@@ -108,7 +108,10 @@ def main(argv=None) -> int:
     from eegnetreplication_tpu.training.protocols import AUTO_CHUNK_THRESHOLD
 
     snap = root / "models" / "within_subject_eegnet.run.npz"
-    sig = read_snapshot_signature(snap) if snap.exists() else None
+    # No exists() gate: the signature read resolves through the keep-N
+    # rotation chain, so a kill between rotation and the new write landing
+    # (only snap.npz.gen1 left) still finds the valid resume seed.
+    sig = read_snapshot_signature(snap)
     if (sig and args.epochs > AUTO_CHUNK_THRESHOLD
             and sig.get("epochs") == args.epochs
             and sig.get("subjects") == list(range(1, args.subjects + 1))

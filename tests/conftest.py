@@ -23,3 +23,17 @@ if not os.environ.get("EEGTPU_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _resil_state_isolated():
+    """The fault-injection registry and preemption flag are process-global;
+    a test that arms a site or requests a stop must never leak it into the
+    next test."""
+    yield
+    from eegnetreplication_tpu.resil import inject, preempt
+
+    inject.disarm_all()
+    preempt.clear()

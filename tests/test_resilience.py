@@ -1,0 +1,783 @@
+"""Resilience subsystem tests (``eegnetreplication_tpu/resil/``).
+
+Covers the failure paths that were untestable before the fault-injection
+registry existed: corrupt/truncated snapshots quarantined with fallback to
+the previous generation, preemption → snapshot → preempted ``run_end`` →
+successful ``--resume``, retry budget exhaustion surfacing the original
+exception, and the staged fetch mirror never leaving a half-mirrored tree.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import types
+from pathlib import Path
+from unittest import mock
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu import obs
+from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.resil import inject, integrity, preempt, retry
+from eegnetreplication_tpu.training import checkpoint as ckpt
+from eegnetreplication_tpu.training.protocols import within_subject_training
+from synthetic import make_loader
+
+REPO = Path(__file__).resolve().parent.parent
+CFG = DEFAULT_TRAINING.replace(batch_size=16)
+
+# Zero-delay policy so retry-path tests pay no wall for backoff.
+FAST = retry.RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0)
+
+
+class TestInjectRegistry:
+    def test_unarmed_site_is_noop(self):
+        inject.fire("data.read", path="x")  # nothing armed: no raise
+
+    def test_after_times_counting_is_deterministic(self):
+        handle = inject.arm("data.read", after=2, times=2)
+        outcomes = []
+        for _ in range(6):
+            try:
+                inject.fire("data.read")
+                outcomes.append("ok")
+            except OSError:
+                outcomes.append("raised")
+        assert outcomes == ["ok", "ok", "raised", "raised", "ok", "ok"]
+        assert handle.hits == 6 and handle.fired == 2
+
+    def test_times_zero_fires_every_hit(self):
+        inject.arm("fetch.download", times=0)
+        for _ in range(3):
+            with pytest.raises(ConnectionError):
+                inject.fire("fetch.download")
+
+    def test_multi_spec_same_site_counting_stays_deterministic(self):
+        # Both armed specs count every eligible hit even when the other
+        # one fires on it: after=1 means "skip hit 1" regardless of what
+        # the first spec did with that hit.
+        inject.arm("checkpoint.write", action="raise", exc="OSError",
+                   times=1)
+        inject.arm("checkpoint.write", action="raise", exc="ValueError",
+                   after=1, times=1)
+        with pytest.raises(OSError):
+            inject.fire("checkpoint.write")  # hit 1: spec A fires
+        with pytest.raises(ValueError):
+            inject.fire("checkpoint.write")  # hit 2: spec B (after=1) due
+        inject.fire("checkpoint.write")  # both exhausted: no-op
+
+    def test_if_folds_over_gates_eligibility(self):
+        handle = inject.arm("train.step", if_folds_over=4, times=0)
+        inject.fire("train.step", n_folds=3)  # too small: not eligible
+        assert handle.hits == 0
+        with pytest.raises(RuntimeError, match="UNAVAILABLE"):
+            inject.fire("train.step", n_folds=8)
+        assert retry.is_device_fault(_raises("train.step", n_folds=8))
+
+    def test_scoped_disarms_even_when_fault_propagates(self):
+        with pytest.raises(OSError):
+            with inject.scoped(inject.FaultSpec(site="data.read")):
+                inject.fire("data.read")
+        assert inject.armed() == []
+        inject.fire("data.read")  # disarmed: no raise
+
+    def test_unknown_site_rejected_at_arm_time(self):
+        with pytest.raises(ValueError, match="Unknown fault-injection site"):
+            inject.arm("train.stpe")
+
+    def test_corrupt_action_garbles_file(self, tmp_path):
+        target = tmp_path / "blob.bin"
+        target.write_bytes(b"A" * 100)
+        inject.arm("checkpoint.write")
+        inject.fire("checkpoint.write", path=target)
+        assert target.read_bytes() != b"A" * 100
+
+    def test_firing_is_journaled(self, tmp_path):
+        with obs.run(tmp_path / "obs") as jr:
+            inject.arm("data.read", times=1)
+            with pytest.raises(OSError):
+                inject.fire("data.read", path="/some/file")
+        events = schema.read_events(jr.events_path)
+        fired = [e for e in events if e["event"] == "fault_injected"]
+        assert len(fired) == 1
+        assert fired[0]["site"] == "data.read"
+        assert fired[0]["action"] == "raise" and fired[0]["hit"] == 1
+        assert not any("_schema_error" in e for e in events)
+
+    def test_parse_plan_string(self):
+        specs = inject.parse_plan(
+            "train.step:if_folds_over=4:times=0,"
+            "checkpoint.write:action=corrupt,host.preempt:after=2")
+        assert [s.site for s in specs] == ["train.step", "checkpoint.write",
+                                          "host.preempt"]
+        assert specs[0].if_folds_over == 4 and specs[0].times == 0
+        assert specs[1].action == "corrupt"
+        assert specs[2].after == 2
+
+    def test_parse_plan_file(self, tmp_path):
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps([{"site": "data.read", "times": 3}]))
+        (spec,) = inject.parse_plan(f"@{plan}")
+        assert spec.site == "data.read" and spec.times == 3
+
+    def test_parse_plan_rejects_typos(self):
+        with pytest.raises(ValueError, match="Unknown fault-injection site"):
+            inject.parse_plan("train.stpe:times=1")
+        with pytest.raises(ValueError, match="Unknown chaos plan option"):
+            inject.parse_plan("train.step:tmies=1")
+        # "site" is the positional head, not an option: must be the same
+        # clean ValueError, not a TypeError the CLI handler misses.
+        with pytest.raises(ValueError, match="Unknown chaos plan option"):
+            inject.parse_plan("train.step:site=train.step")
+
+    def test_parse_plan_file_rejects_bad_entries_as_valueerror(self, tmp_path):
+        # The CLI catches ValueError for a clean parser.error; a plan-file
+        # typo must not surface as FaultSpec's raw TypeError.
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps([{"site": "train.step", "tmies": 1}]))
+        with pytest.raises(ValueError, match="Unknown chaos plan option"):
+            inject.parse_plan(f"@{plan}")
+        plan.write_text(json.dumps(["train.step"]))
+        with pytest.raises(ValueError, match="must be objects"):
+            inject.parse_plan(f"@{plan}")
+
+    def test_parse_plan_file_rejects_non_string_fields(self, tmp_path):
+        # A non-string message must fail at parse time, not as an
+        # AttributeError when fire() formats it minutes into the run.
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps(
+            [{"site": "train.chunk", "message": 5}]))
+        with pytest.raises(ValueError, match="must be a string"):
+            inject.parse_plan(f"@{plan}")
+
+    def test_parse_plan_file_coerces_int_fields(self, tmp_path):
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps([{"site": "host.preempt", "after": "2"}]))
+        (spec,) = inject.parse_plan(f"@{plan}")
+        assert spec.after == 2
+        plan.write_text(json.dumps([{"site": "host.preempt", "after": "x"}]))
+        with pytest.raises(ValueError, match="must be an integer"):
+            inject.parse_plan(f"@{plan}")
+
+
+def _raises(site, **ctx):
+    """fire() the armed site and hand back the exception it raised."""
+    try:
+        inject.fire(site, **ctx)
+    except Exception as exc:  # noqa: BLE001 — the test inspects it
+        return exc
+    raise AssertionError(f"{site} did not fire")
+
+
+class TestRetryPolicy:
+    def test_classify(self):
+        assert retry.classify(
+            RuntimeError("UNAVAILABLE: TPU device error")) == "device_fault"
+        assert retry.classify(ConnectionError("reset")) == "transient"
+        assert retry.classify(TimeoutError()) == "transient"
+        assert retry.classify(OSError("I/O error")) == "transient"
+        assert retry.classify(FileNotFoundError("gone")) == "fatal"
+        assert retry.classify(ValueError("bad")) == "fatal"
+        assert retry.classify(RuntimeError("plain crash")) == "fatal"
+        # Preempted must never be retried/halved: it is a graceful stop.
+        assert retry.classify(preempt.Preempted("stop")) == "fatal"
+
+    def test_is_device_fault_requires_runtimeerror(self):
+        assert not retry.is_device_fault(OSError("UNAVAILABLE"))
+        assert retry.is_device_fault(RuntimeError("DATA_LOSS on core 0"))
+
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise ConnectionError("reset")
+            return 42
+
+        assert retry.call(flaky, policy=FAST, site="test") == 42
+        assert len(calls) == 3
+
+    def test_budget_exhaustion_surfaces_original_exception(self):
+        sentinel = ConnectionError("the root cause")
+
+        def always_fails():
+            raise sentinel
+
+        with pytest.raises(ConnectionError) as ei:
+            retry.call(always_fails, policy=FAST, site="test")
+        assert ei.value is sentinel  # the ORIGINAL instance, not a wrapper
+
+    def test_fatal_classification_not_retried(self):
+        calls = []
+
+        def fatal():
+            calls.append(1)
+            raise ValueError("deterministic")
+
+        with pytest.raises(ValueError):
+            retry.call(fatal, policy=FAST, site="test")
+        assert len(calls) == 1
+
+    def test_deadline_budget(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            raise ConnectionError("reset")
+
+        policy = retry.RetryPolicy(max_attempts=100, base_delay_s=0.0,
+                                   jitter=0.0, deadline_s=0.0)
+        with pytest.raises(ConnectionError):
+            retry.call(flaky, policy=policy, site="test")
+        assert len(calls) == 1  # deadline already spent after attempt 1
+
+    def test_backoff_curve_and_cap(self):
+        policy = retry.RetryPolicy(base_delay_s=1.0, multiplier=2.0,
+                                   max_delay_s=5.0, jitter=0.0)
+        assert [policy.delay(a) for a in (1, 2, 3, 4)] == [1.0, 2.0, 4.0, 5.0]
+
+    def test_retries_are_journaled(self, tmp_path):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise ConnectionError("reset")
+            return "ok"
+
+        with obs.run(tmp_path / "obs") as jr:
+            retry.call(flaky, policy=FAST, site="fetch.download")
+        events = schema.read_events(jr.events_path)
+        retries = [e for e in events if e["event"] == "retry"]
+        assert len(retries) == 1
+        assert retries[0]["site"] == "fetch.download"
+        assert retries[0]["attempt"] == 1
+        assert retries[0]["classification"] == "transient"
+        assert "ConnectionError" in retries[0]["error"]
+
+
+class TestIntegrity:
+    def _flat(self):
+        return {"params/w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "params/b": np.zeros(3, dtype=np.float32)}
+
+    def test_stamp_verify_roundtrip(self):
+        flat = integrity.stamp(self._flat())
+        integrity.verify(flat)  # no raise
+
+    def test_tampered_payload_detected(self):
+        flat = integrity.stamp(self._flat())
+        flat["params/w"] = flat["params/w"] + 1
+        with pytest.raises(integrity.IntegrityError, match="digest mismatch"):
+            integrity.verify(flat)
+
+    def test_signature_rewrite_does_not_invalidate(self):
+        # __signature__ is excluded: resume logic validates it semantically,
+        # and migration tooling legitimately rewrites it in place.
+        flat = self._flat()
+        flat["__signature__"] = np.frombuffer(b'{"a":1}', dtype=np.uint8)
+        integrity.stamp(flat)
+        flat["__signature__"] = np.frombuffer(b'{"a":2}', dtype=np.uint8)
+        integrity.verify(flat)
+
+    def test_legacy_digestless_passes(self):
+        integrity.verify(self._flat())  # no digest entry: not corruption
+
+
+class TestCheckpointIntegrity:
+    def test_tampered_checkpoint_quarantined_on_load(self, tmp_path):
+        p = ckpt.save_checkpoint(
+            tmp_path / "ck.npz", {"w": np.ones((2, 2), np.float32)},
+            {"mean": np.zeros(2, np.float32)}, {"model": "t"})
+        with np.load(p, allow_pickle=False) as data:
+            flat = {k: data[k] for k in data.files}
+        flat["params/w"] = flat["params/w"] + 1  # damaged weights
+        with open(p, "wb") as fh:
+            np.savez(fh, **flat)
+        with pytest.raises(integrity.IntegrityError):
+            ckpt.load_checkpoint(p)
+        assert not p.exists()  # moved aside, not left in place
+        assert p.with_name(p.name + ".corrupt").exists()
+
+    def _snap(self, path, epochs_done, fill, **kw):
+        carry = {"w": np.full((2, 3), fill, np.float32)}
+        return ckpt.save_run_snapshot(path, carry, {"loss": np.ones(2)},
+                                      epochs_done, {"run": "t"}, **kw)
+
+    def test_rotation_keeps_n_generations(self, tmp_path):
+        p = tmp_path / "snap.npz"
+        for n in (1, 2, 3):
+            self._snap(p, epochs_done=n, fill=float(n), keep=2)
+        gen1 = p.with_name(p.name + ".gen1")
+        assert p.exists() and gen1.exists()
+        assert not p.with_name(p.name + ".gen2").exists()
+        template = {"w": np.zeros((2, 3), np.float32)}
+        _, _, newest = ckpt.load_run_snapshot(p, template, {"run": "t"})
+        assert newest == 3
+
+    def test_corrupt_newest_falls_back_to_previous_generation(self, tmp_path):
+        p = tmp_path / "snap.npz"
+        self._snap(p, epochs_done=2, fill=2.0, keep=2)
+        self._snap(p, epochs_done=4, fill=4.0, keep=2)
+        p.write_bytes(p.read_bytes()[: p.stat().st_size // 2])  # truncated
+        template = {"w": np.zeros((2, 3), np.float32)}
+        with obs.run(tmp_path / "obs") as jr:
+            carry, _, epochs_done = ckpt.load_run_snapshot(p, template,
+                                                           {"run": "t"})
+        assert epochs_done == 2  # the previous generation answered
+        np.testing.assert_array_equal(carry["w"],
+                                      np.full((2, 3), 2.0, np.float32))
+        assert p.with_name(p.name + ".corrupt").exists()
+        events = schema.read_events(jr.events_path)
+        quarantines = [e for e in events
+                       if e["event"] == "checkpoint_quarantine"]
+        assert len(quarantines) == 1
+
+    def test_quarantine_hole_does_not_strand_older_generation(self, tmp_path,
+                                                              monkeypatch):
+        # keep=3: newest and gen1 corrupt, gen2 valid.  The signature read
+        # quarantines the two corpses (leaving holes in the .genN chain);
+        # the subsequent full load must still resolve gen2 — the chain walk
+        # may not stop at a hole.
+        monkeypatch.setenv("EEGTPU_SNAPSHOT_KEEP", "3")
+        p = tmp_path / "snap.npz"
+        for n in (2, 4, 6):
+            self._snap(p, epochs_done=n, fill=float(n), keep=3)
+        p.write_bytes(b"junk")
+        p.with_name(p.name + ".gen1").write_bytes(b"junk")
+        assert ckpt.read_snapshot_signature(p) == {"run": "t"}
+        template = {"w": np.zeros((2, 3), np.float32)}
+        carry, _, epochs_done = ckpt.load_run_snapshot(p, template,
+                                                       {"run": "t"})
+        assert epochs_done == 2  # gen2 (the oldest) survived and answered
+        np.testing.assert_array_equal(carry["w"],
+                                      np.full((2, 3), 2.0, np.float32))
+
+    def test_all_generations_corrupt_raises_filenotfound(self, tmp_path):
+        p = tmp_path / "snap.npz"
+        self._snap(p, epochs_done=2, fill=2.0, keep=2)
+        self._snap(p, epochs_done=4, fill=4.0, keep=2)
+        p.write_bytes(b"junk")
+        p.with_name(p.name + ".gen1").write_bytes(b"junk")
+        with pytest.raises(FileNotFoundError, match="all generations"):
+            ckpt.load_run_snapshot(p, {"w": np.zeros((2, 3), np.float32)},
+                                   {"run": "t"})
+
+    def test_missing_primary_resolves_gen1(self, tmp_path):
+        # The crash window between rotation and the new write landing:
+        # primary gone, gen1 holds the previous valid snapshot.
+        p = tmp_path / "snap.npz"
+        self._snap(p, epochs_done=2, fill=2.0, keep=2)
+        self._snap(p, epochs_done=4, fill=4.0, keep=2)
+        p.unlink()
+        assert ckpt.any_snapshot_generation(p)
+        assert ckpt.read_snapshot_signature(p) == {"run": "t"}
+        template = {"w": np.zeros((2, 3), np.float32)}
+        _, _, epochs_done = ckpt.load_run_snapshot(p, template, {"run": "t"})
+        assert epochs_done == 2
+        assert not ckpt.any_snapshot_generation(tmp_path / "nothing.npz")
+
+    def test_repeated_loads_stable(self, tmp_path):
+        # The resolve memo (signature read -> load fast path) must not
+        # hand a second load a hollowed-out dict.
+        p = tmp_path / "snap.npz"
+        self._snap(p, epochs_done=3, fill=3.0, keep=2)
+        template = {"w": np.zeros((2, 3), np.float32)}
+        for _ in range(2):
+            carry, _, epochs_done = ckpt.load_run_snapshot(p, template,
+                                                           {"run": "t"})
+            assert epochs_done == 3
+            np.testing.assert_array_equal(
+                carry["w"], np.full((2, 3), 3.0, np.float32))
+
+    def test_unreadable_checkpoint_raises_integrity_error(self, tmp_path):
+        # Corruption that breaks the zip container itself (the usual
+        # crash-mid-write shape) must surface as IntegrityError, not leak
+        # a raw BadZipFile — but WITHOUT quarantining: an unreadable file
+        # cannot be proven framework-owned, and predict/viz hand these
+        # loaders arbitrary user paths that must not be renamed away.
+        p = ckpt.save_checkpoint(
+            tmp_path / "ck.npz", {"w": np.ones((2, 2), np.float32)},
+            {"mean": np.zeros(2, np.float32)}, {"model": "t"})
+        p.write_bytes(p.read_bytes()[: p.stat().st_size // 2])
+        with pytest.raises(integrity.IntegrityError, match="unreadable"):
+            ckpt.load_checkpoint(p)
+        assert p.exists()  # the user's file stays in place
+
+    def test_resolve_memo_reused_and_released(self, tmp_path, monkeypatch):
+        # The grouped resume flow probes the signature twice before the
+        # full load: the decompress+sha256 walk must hit disk once for all
+        # three resolves, and the terminal load must release the memo so
+        # the snapshot's arrays are not pinned for the rest of the run.
+        p = tmp_path / "snap.npz"
+        self._snap(p, epochs_done=3, fill=3.0, keep=2)
+        reads = []
+        real = ckpt._read_flat
+        monkeypatch.setattr(ckpt, "_read_flat",
+                            lambda path: reads.append(path) or real(path))
+        assert ckpt.read_snapshot_signature(p) == {"run": "t"}
+        assert ckpt.read_snapshot_signature(p) == {"run": "t"}
+        template = {"w": np.zeros((2, 3), np.float32)}
+        _, _, epochs_done = ckpt.load_run_snapshot(p, template, {"run": "t"})
+        assert epochs_done == 3
+        assert len(reads) == 1
+        assert not ckpt._RESOLVE_MEMO
+
+    def test_armed_checkpoint_write_caught_by_loader(self, tmp_path):
+        # The chaos site garbles the STAGED bytes (crash-mid-replace shape);
+        # the loader must refuse the landed file.
+        inject.arm("checkpoint.write", times=1)
+        p = ckpt.save_checkpoint(
+            tmp_path / "ck.npz", {"w": np.ones((4, 4), np.float32)},
+            {"m": np.zeros(4, np.float32)}, {})
+        with pytest.raises(Exception):  # zip damage or digest mismatch
+            ckpt.load_checkpoint(p)
+
+    def test_snapshot_keep_env_knob(self, monkeypatch):
+        monkeypatch.setenv("EEGTPU_SNAPSHOT_KEEP", "5")
+        assert ckpt.snapshot_keep() == 5
+        monkeypatch.setenv("EEGTPU_SNAPSHOT_KEEP", "0")
+        assert ckpt.snapshot_keep() == 1  # clamped: newest always kept
+        monkeypatch.setenv("EEGTPU_SNAPSHOT_KEEP", "bogus")
+        assert ckpt.snapshot_keep() == ckpt.DEFAULT_SNAPSHOT_KEEP
+
+
+class TestProtocolResilience:
+    """End-to-end recovery through the protocol layer (synthetic data)."""
+
+    def _run(self, tmp_paths, **kw):
+        loader = make_loader(n_trials=24, n_channels=4, n_times=64)
+        return within_subject_training(
+            epochs=6, config=CFG, loader=loader, subjects=(1,),
+            paths=tmp_paths, seed=0, save_models=False, **kw)
+
+    @pytest.fixture
+    def tmp_paths(self, tmp_path):
+        return Paths.from_root(tmp_path)
+
+    def test_corrupt_snapshot_falls_back_to_previous_generation(
+            self, tmp_paths, caplog):
+        import logging
+
+        uninterrupted = self._run(tmp_paths, checkpoint_every=2)
+        # Crash after the SECOND chunk: snapshots for epochs 2 (gen1) and 4
+        # (newest) both exist.
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=2)
+        snap = tmp_paths.models / "within_subject_eegnet.run.npz"
+        gen1 = snap.with_name(snap.name + ".gen1")
+        assert snap.exists() and gen1.exists()
+        # The newest generation is truncated (crash mid-replace shape).
+        snap.write_bytes(snap.read_bytes()[: snap.stat().st_size // 2])
+        with caplog.at_level(logging.WARNING):
+            resumed = self._run(tmp_paths, checkpoint_every=2, resume=True)
+        assert any("falling back to previous generation" in r.getMessage()
+                   for r in caplog.records)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+        # Completion cleans up snapshot, generations, and corpses alike.
+        assert not snap.exists() and not gen1.exists()
+        assert not list(tmp_paths.models.glob("*.corrupt"))
+
+    def test_preempt_snapshots_and_resumes(self, tmp_paths, tmp_path):
+        uninterrupted = self._run(tmp_paths, checkpoint_every=2)
+        with obs.run(tmp_path / "obs") as jr:
+            try:
+                with inject.scoped(
+                        inject.FaultSpec(site="host.preempt", times=1)):
+                    with pytest.raises(preempt.Preempted):
+                        self._run(tmp_paths, checkpoint_every=2)
+            finally:
+                # What train.py's entrypoint does on Preempted.
+                jr.run_end(status="preempted", error="preempted in test")
+        events = schema.read_events(jr.events_path)
+        assert events[-1]["event"] == "run_end"
+        assert events[-1]["status"] == "preempted"
+        assert any(e["event"] == "fault_injected"
+                   and e["site"] == "host.preempt" for e in events)
+        snap = tmp_paths.models / "within_subject_eegnet.run.npz"
+        assert snap.exists()  # the stop happened AFTER the snapshot landed
+        preempt.clear()  # a real rerun is a fresh process
+        resumed = self._run(tmp_paths, checkpoint_every=2, resume=True)
+        np.testing.assert_array_equal(resumed.fold_test_acc,
+                                      uninterrupted.fold_test_acc)
+        assert not snap.exists()
+
+    def test_sigterm_style_request_honored_at_chunk_boundary(self, tmp_paths):
+        # Request the stop BEFORE training: the first snapshot boundary
+        # must honor it (the signal handler path sets the same flag).
+        preempt.request("test-SIGTERM")
+        with pytest.raises(preempt.Preempted, match="--resume"):
+            self._run(tmp_paths, checkpoint_every=2)
+        assert (tmp_paths.models / "within_subject_eegnet.run.npz").exists()
+
+    def test_registry_armed_device_fault_halves_and_journals(
+            self, tmp_paths, tmp_path, monkeypatch):
+        from eegnetreplication_tpu.training import protocols as P
+
+        monkeypatch.setattr(P, "_fold_batch_limit_path",
+                            lambda: tmp_path / "limits.json")
+        with obs.run(tmp_path / "obs") as jr:
+            with inject.scoped(inject.FaultSpec(site="train.step", times=0,
+                                                if_folds_over=2)):
+                result = self._run(tmp_paths, fold_batch=3)
+        assert len(result.per_subject_test_acc) == 1
+        events = schema.read_events(jr.events_path)
+        kinds = [e["event"] for e in events]
+        assert "fault_injected" in kinds  # the armed site fired
+        assert "device_fault" in kinds    # the halving loop classified it
+        assert "retry" in kinds           # ...and journaled the shared record
+        assert result.fault_retry_wall_s >= 0.0
+
+    def test_shim_kwargs_leave_registry_clean(self, tmp_paths):
+        with pytest.raises(RuntimeError, match="injected crash"):
+            self._run(tmp_paths, checkpoint_every=2, _crash_after_chunk=1)
+        assert inject.armed() == []  # the shim's scoped arm was released
+
+
+class TestFetchResilience:
+    def _install_kagglehub(self, cache: Path, calls: list):
+        mod = types.ModuleType("kagglehub")
+
+        def dataset_download(dataset):
+            calls.append(dataset)
+            return str(cache)
+
+        mod.dataset_download = dataset_download
+        return mock.patch.dict(sys.modules, {"kagglehub": mod})
+
+    def test_download_retries_injected_fault(self, tmp_path, monkeypatch):
+        import eegnetreplication_tpu.fetch as fetch
+
+        monkeypatch.setattr(fetch, "DOWNLOAD_RETRY", FAST)
+        cache = tmp_path / "cache"
+        cache.mkdir()
+        (cache / "A01T.gdf").write_bytes(b"gdf")
+        paths = Paths.from_root(tmp_path / "proj")
+        calls: list = []
+        inject.arm("fetch.download", times=2)
+        with self._install_kagglehub(cache, calls), \
+                obs.run(tmp_path / "obs") as jr:
+            out = fetch.fetch_from_kaggle(paths=paths)
+        assert calls == [fetch.KAGGLE_DATASET]  # 2 injected, 3rd real
+        assert (out / "A01T.gdf").read_bytes() == b"gdf"
+        events = schema.read_events(jr.events_path)
+        assert sum(e["event"] == "retry" for e in events) == 2
+        assert sum(e["event"] == "fault_injected" for e in events) == 2
+
+    def test_download_budget_exhaustion_surfaces_original(self, tmp_path,
+                                                          monkeypatch):
+        import eegnetreplication_tpu.fetch as fetch
+
+        monkeypatch.setattr(fetch, "DOWNLOAD_RETRY", FAST)
+        paths = Paths.from_root(tmp_path / "proj")
+        inject.arm("fetch.download", times=0)  # never stops failing
+        with self._install_kagglehub(tmp_path, []):
+            with pytest.raises(ConnectionError,
+                               match="injected fault: fetch.download"):
+                fetch.fetch_from_kaggle(paths=paths)
+        assert not paths.data_raw.exists()  # nothing half-mirrored
+
+    def test_interrupted_mirror_leaves_dest_intact(self, tmp_path):
+        from eegnetreplication_tpu.fetch import _mirror_into
+
+        cache = tmp_path / "cache"
+        cache.mkdir()
+        (cache / "a.gdf").write_bytes(b"new-a")
+        (cache / "b.gdf").write_bytes(b"new-b")
+        dest = tmp_path / "data_raw"
+        dest.mkdir()
+        (dest / "a.gdf").write_bytes(b"old-a")
+
+        import shutil as shutil_mod
+        real_copy2 = shutil_mod.copy2
+
+        def failing_copy2(src, dst, **kw):
+            if str(src).startswith(str(cache)):
+                raise OSError("disk full mid-copy")
+            return real_copy2(src, dst, **kw)
+
+        with mock.patch.object(shutil_mod, "copy2", failing_copy2):
+            with pytest.raises(OSError, match="disk full"):
+                _mirror_into(cache, dest)
+        # The interrupted fetch changed NOTHING: old content intact, no
+        # partial new files, no staging litter.
+        assert sorted(p.name for p in dest.iterdir()) == ["a.gdf"]
+        assert (dest / "a.gdf").read_bytes() == b"old-a"
+        assert not list(tmp_path.glob(".data_raw.staging*"))
+
+    def test_mirror_swap_replaces_stale_entries(self, tmp_path):
+        from eegnetreplication_tpu.fetch import _mirror_into
+
+        cache = tmp_path / "cache"
+        (cache / "Train").mkdir(parents=True)
+        (cache / "Train" / "fresh.gdf").write_bytes(b"fresh")
+        dest = tmp_path / "data_raw"
+        (dest / "Train").mkdir(parents=True)
+        (dest / "Train" / "orphan.gdf").write_bytes(b"old")
+        (dest / "keep.txt").write_bytes(b"keep")  # not in cache: preserved
+        keep_ino = (dest / "keep.txt").stat().st_ino
+        _mirror_into(cache, dest)
+        assert (dest / "Train" / "fresh.gdf").read_bytes() == b"fresh"
+        assert not (dest / "Train" / "orphan.gdf").exists()
+        assert (dest / "keep.txt").read_bytes() == b"keep"
+        # Preserved entries ride through by hardlink, not a byte copy.
+        assert (dest / "keep.txt").stat().st_ino == keep_ino
+
+    def test_mirror_restores_dest_when_swap_fails(self, tmp_path):
+        from eegnetreplication_tpu.fetch import _mirror_into
+
+        cache = tmp_path / "cache"
+        cache.mkdir()
+        (cache / "a.gdf").write_bytes(b"new-a")
+        dest = tmp_path / "data_raw"
+        dest.mkdir()
+        (dest / "a.gdf").write_bytes(b"old-a")
+
+        real_replace = Path.replace
+
+        def failing_replace(self, target):
+            if ".staging" in self.name:  # the staging -> dest rename only
+                raise OSError("simulated rename failure")
+            return real_replace(self, target)
+
+        with mock.patch.object(Path, "replace", failing_replace):
+            with pytest.raises(OSError, match="simulated rename"):
+                _mirror_into(cache, dest)
+        # dest was already retired when the swap failed: the old complete
+        # tree must come back, not sit stranded in a hidden .old dir.
+        assert (dest / "a.gdf").read_bytes() == b"old-a"
+        assert not list(tmp_path.glob(".data_raw.old*"))
+        assert not list(tmp_path.glob(".data_raw.staging*"))
+
+    def test_mirror_recovers_leftovers_from_crashed_prior_run(self, tmp_path):
+        import subprocess
+
+        from eegnetreplication_tpu.fetch import _mirror_into
+
+        # A genuinely dead pid: a reaped child (immediate reuse of a just
+        # freed pid is effectively impossible).
+        child = subprocess.Popen(["true"])
+        child.wait()
+        dead_pid = child.pid
+
+        cache = tmp_path / "cache"
+        cache.mkdir()
+        (cache / "a.gdf").write_bytes(b"new-a")
+        # A prior fetch (the dead pid) was SIGKILLed inside the rename
+        # window: dest is gone, its complete old tree sits retired, and an
+        # orphaned staging tree litters the parent.
+        retired = tmp_path / f".data_raw.old.{dead_pid}"
+        retired.mkdir()
+        (retired / "prev.gdf").write_bytes(b"prev")
+        orphan = tmp_path / f".data_raw.staging.{dead_pid}"
+        orphan.mkdir()
+        (orphan / "half.gdf").write_bytes(b"half")
+        dest = tmp_path / "data_raw"
+        _mirror_into(cache, dest)
+        # The retired tree came back as dest (prev.gdf preserved) before
+        # the cache was overlaid, and no orphaned litter survives.
+        assert (dest / "prev.gdf").read_bytes() == b"prev"
+        assert (dest / "a.gdf").read_bytes() == b"new-a"
+        assert not list(tmp_path.glob(".data_raw.*"))
+
+    def test_mirror_preserves_concurrent_fetch_trees(self, tmp_path):
+        from eegnetreplication_tpu import fetch as fetch_mod
+
+        cache = tmp_path / "cache"
+        cache.mkdir()
+        (cache / "a.gdf").write_bytes(b"new-a")
+        # Another fetch (live owner) is mid-swap on the SAME dest: its
+        # retired tree is its rollback copy and must survive our cleanup.
+        live_retired = tmp_path / ".data_raw.old.424242"
+        live_retired.mkdir()
+        (live_retired / "rollback.gdf").write_bytes(b"rb")
+        dest = tmp_path / "data_raw"
+        dest.mkdir()
+        (dest / "a.gdf").write_bytes(b"old-a")
+        with mock.patch.object(fetch_mod, "_pid_alive", lambda pid: True):
+            fetch_mod._mirror_into(cache, dest)
+        assert (dest / "a.gdf").read_bytes() == b"new-a"
+        assert (live_retired / "rollback.gdf").read_bytes() == b"rb"
+
+    def test_data_read_retries_injected_fault(self, tmp_path, monkeypatch):
+        from eegnetreplication_tpu.data import io as data_io
+        from eegnetreplication_tpu.data.containers import BCICI2ADataset
+
+        monkeypatch.setattr(data_io, "READ_RETRY", FAST)
+        ds = BCICI2ADataset(X=np.zeros((4, 2, 8), np.float32),
+                            y=np.zeros(4, np.int64))
+        p = data_io.save_trials(ds, tmp_path / "t.npz")
+        inject.arm("data.read", times=1)
+        loaded = data_io.load_trials(p)
+        assert loaded.X.shape == (4, 2, 8)
+
+
+class TestObsReportCrashedRuns:
+    def _report(self, *args):
+        return subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "obs_report.py"),
+             "--json", *map(str, args)],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1"))
+
+    def _run_start(self, run_id="r1"):
+        return {"event": "run_start", "t": 1.0, "run_id": run_id,
+                "schema_version": 1, "git_sha": "abc", "platform": "cpu",
+                "device_kind": "cpu", "n_devices": 1, "config": {}}
+
+    def test_crashed_run_with_truncated_tail_renders(self, tmp_path):
+        run_dir = tmp_path / "r1"
+        run_dir.mkdir()
+        lines = [json.dumps(self._run_start()),
+                 '{"event": "epoch", "t": 2.0, "run_id": "r1", "epo']  # cut
+        (run_dir / "events.jsonl").write_text("\n".join(lines) + "\n")
+        proc = self._report(run_dir)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        summary = json.loads(proc.stdout.strip())
+        # Live and crashed are indistinguishable without a terminal event;
+        # the honest shared label renders instead of raising.
+        assert summary["status"] == "incomplete"
+        assert "error" not in summary
+
+    def test_preempted_run_renders(self, tmp_path):
+        run_dir = tmp_path / "r2"
+        run_dir.mkdir()
+        lines = [json.dumps(self._run_start("r2")),
+                 json.dumps({"event": "run_end", "t": 3.0, "run_id": "r2",
+                             "status": "preempted", "wall_s": 2.0})]
+        (run_dir / "events.jsonl").write_text("\n".join(lines) + "\n")
+        proc = self._report(run_dir)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        summary = json.loads(proc.stdout.strip())
+        assert summary["status"] == "preempted"
+
+
+class TestTrainCLIChaosFlag:
+    def test_bad_plan_fails_at_parse_time(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "eegnetreplication_tpu.train",
+             "--chaos", "train.stpe:times=1"],
+            capture_output=True, text=True, timeout=120, cwd=REPO,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu"))
+        assert proc.returncode == 2  # argparse error, not a traceback
+        assert "Unknown fault-injection site" in proc.stderr
+
+
+@pytest.mark.slow
+class TestChaosDrill:
+    def test_drill_completes_all_legs(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "chaos_drill.py"),
+             "--root", str(tmp_path)],
+            capture_output=True, text=True, timeout=1200,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu"))
+        assert proc.returncode == 0, (proc.stdout[-3000:]
+                                      + proc.stderr[-3000:])
+        assert "ALL LEGS PASSED" in proc.stdout
