@@ -33,6 +33,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 from pathlib import Path
 from typing import Any, Iterator
@@ -111,6 +112,9 @@ class RunJournal:
         self._t0 = time.perf_counter()
         self._ended = False
         self._tb = TensorBoardMirror(tb_dir) if tb_dir else None
+        # Serving journals from HTTP-handler and batcher threads
+        # concurrently; one lock keeps every events.jsonl line whole.
+        self._write_lock = threading.Lock()
 
     # -- event emission ---------------------------------------------------
     @property
@@ -139,7 +143,7 @@ class RunJournal:
                                or v is None else repr(v)
                                for k, v in record.items()})
         try:
-            with open(self.events_path, "a") as fh:
+            with self._write_lock, open(self.events_path, "a") as fh:
                 fh.write(line + "\n")
                 fh.flush()
         except OSError as exc:

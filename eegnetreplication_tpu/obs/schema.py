@@ -51,6 +51,12 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "fault_injected": ("site", "action", "hit"),
     "retry": ("site", "attempt", "max_attempts", "classification", "error"),
     "checkpoint_quarantine": ("path", "quarantined_to"),
+    # serve/: the online inference service journals its lifecycle and
+    # every request through these (rendered by scripts/obs_report.py).
+    "serve_start": ("checkpoint", "buckets", "max_batch", "max_wait_ms"),
+    "request": ("n_trials", "latency_ms", "status"),
+    "model_swap": ("checkpoint", "digest"),
+    "serve_end": ("n_requests", "rejected", "wall_s"),
     "run_end": ("status", "wall_s"),
 }
 
@@ -247,8 +253,27 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
             out.update(status=ev.get("status"), wall_s=ev.get("wall_s"))
             if ev.get("error"):
                 out["error_message"] = ev["error"]
+    requests = [e for e in events if e["event"] == "request"]
+    swaps = [e for e in events if e["event"] == "model_swap"]
     out["n_epoch_events"] = len(epochs)
     out["device_fault_retries"] = len(faults)
+    if requests or swaps or any(e["event"] == "serve_start" for e in events):
+        # Serving run: request count, tail latency, rejected/error split.
+        # p95 comes from the per-request journal events (the metrics
+        # histogram keeps only count/sum/min/max/mean by design).
+        out["n_requests"] = len(requests)
+        out["rejected"] = sum(1 for e in requests
+                              if e.get("status") == "rejected")
+        out["request_errors"] = sum(1 for e in requests
+                                    if e.get("status") not in ("ok",
+                                                               "rejected"))
+        out["model_swaps"] = len(swaps)
+        lat = sorted(e["latency_ms"] for e in requests
+                     if e.get("status") == "ok"
+                     and isinstance(e.get("latency_ms"), numbers.Real))
+        if lat:
+            out["latency_p50_ms"] = round(lat[int(0.50 * (len(lat) - 1))], 3)
+            out["latency_p95_ms"] = round(lat[int(0.95 * (len(lat) - 1))], 3)
     if injected:
         out["faults_injected"] = len(injected)
     if retries:

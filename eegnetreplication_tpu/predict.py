@@ -23,77 +23,36 @@ Examples:
 from __future__ import annotations
 
 import argparse
-from pathlib import Path
 
 import numpy as np
 
+# The checkpoint loader and class labels live with the serving engine now
+# (one loader, one label set — the CLI and the server cannot drift);
+# re-exported here for back-compat.
+from eegnetreplication_tpu.serve.engine import (  # noqa: F401
+    CLASS_NAMES,
+    load_model_from_checkpoint,
+)
 from eegnetreplication_tpu.utils.logging import logger
-
-CLASS_NAMES = ("left hand", "right hand", "feet", "tongue")
-
-
-def load_model_from_checkpoint(path: str | Path):
-    """(model, params, batch_stats) from a native .npz, an Orbax checkpoint
-    directory, or a reference .pth."""
-    from eegnetreplication_tpu.models import EEGNet
-    from eegnetreplication_tpu.training import checkpoint as ckpt_lib
-
-    path = Path(path)
-    if path.suffix == ".pth":
-        # Reference-format checkpoint; geometry inferred from tensor shapes
-        # (handles eegnet_wide exports too).
-        params, batch_stats, meta = ckpt_lib.load_pth_auto(path)
-        model = EEGNet(n_channels=meta["n_channels"],
-                       n_times=meta["n_times"], F1=meta["F1"], D=meta["D"])
-        return model, params, batch_stats
-    if path.is_dir():
-        from eegnetreplication_tpu.training import orbax_io
-
-        params, batch_stats, meta = orbax_io.load_orbax_checkpoint(path)
-    else:
-        params, batch_stats, meta = ckpt_lib.load_checkpoint(path)
-    kwargs = {k: meta[k] for k in ("n_channels", "n_times", "F1", "D")
-              if k in meta}
-    if meta.get("model", "eegnet") != "eegnet":
-        from eegnetreplication_tpu.models import get_model
-
-        return (get_model(meta["model"], **{k: v for k, v in kwargs.items()
-                                            if k in ("n_channels", "n_times")}),
-                params, batch_stats)
-    return EEGNet(**kwargs), params, batch_stats
 
 
 def predict_trials(model, params, batch_stats, X: np.ndarray,
                    batch_size: int = 256) -> np.ndarray:
-    """Class predictions for ``(n, C, T)`` trials (Pallas-fused on TPU)."""
-    import jax
-    import jax.numpy as jnp
+    """Class predictions for ``(n, C, T)`` trials (Pallas-fused on TPU).
 
-    from eegnetreplication_tpu.ops.fused_eegnet import (
-        probe_pallas,
-        supports_fused_eval,
+    A thin wrapper over :class:`~eegnetreplication_tpu.serve.engine.InferenceEngine`
+    — the same bucketed padded forward the online service runs, capped at
+    ``batch_size``, so a CLI prediction and a served prediction are the
+    same computation by construction (``scripts/serve_smoke.py`` pins it).
+    """
+    from eegnetreplication_tpu.serve.engine import (
+        InferenceEngine,
+        bucket_ladder,
     )
-    from eegnetreplication_tpu.training.steps import eval_forward
 
-    if supports_fused_eval(model):
-        probe_pallas(model)  # validate/enable the TPU kernel eagerly
-
-    n = len(X)
-    if n == 0:
-        return np.zeros(0, np.int64)
-    fwd = jax.jit(lambda xx: jnp.argmax(
-        eval_forward(model, params, batch_stats, xx, allow_pallas=True),
-        axis=-1))
-    out = []
-    # One padded batch shape -> one compilation.
-    for start in range(0, n, batch_size):
-        batch = X[start:start + batch_size]
-        pad = batch_size - len(batch)
-        if pad:
-            batch = np.concatenate([batch, batch[-1:].repeat(pad, axis=0)])
-        out.append(np.asarray(fwd(jnp.asarray(batch)))[: batch_size - pad
-                                                       if pad else None])
-    return np.concatenate(out)[:n]
+    engine = InferenceEngine(model, params, batch_stats,
+                             bucket_ladder(batch_size))
+    return engine.infer(np.asarray(X, np.float32))
 
 
 def _log_inference_throughput(model, n_trials: int, wall: float,

@@ -32,6 +32,11 @@ site                  action     effect
 ``train.chunk``       raise      plain ``RuntimeError`` after an epoch
                                  chunk (NOT device-fault shaped — the
                                  ``_crash_after_chunk`` back-compat shim)
+``serve.forward``     raise      device-fault-shaped ``RuntimeError`` at
+                                 the serving batcher's inference dispatch
+                                 (retried under ``serve.service``'s
+                                 policy; a ``fatal``-classified override
+                                 fails exactly that coalesced batch)
 ====================  =========  ==========================================
 
 Chaos plans (the ``--chaos`` flag) are comma-separated site specs with
@@ -58,7 +63,7 @@ from eegnetreplication_tpu.utils.logging import logger
 # rejects names outside this set so a chaos-plan typo fails loudly
 # instead of silently never firing.
 SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
-         "host.preempt", "train.chunk")
+         "host.preempt", "train.chunk", "serve.forward")
 
 ACTIONS = ("raise", "corrupt", "preempt")
 
@@ -87,6 +92,9 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
     "host.preempt": ("preempt", None, "injected host.preempt (hit {hit})"),
     "train.chunk": ("raise", "RuntimeError",
                     "injected crash after chunk {hit}"),
+    "serve.forward": ("raise", "RuntimeError",
+                      "UNAVAILABLE: device error (injected fault: "
+                      "serve.forward, hit {hit})"),
 }
 
 

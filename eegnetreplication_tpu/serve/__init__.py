@@ -1,0 +1,49 @@
+"""Online inference serving subsystem: the first long-lived consumer of
+the training stack's checkpoints.
+
+The ROADMAP north star is serving heavy online traffic; until this
+package the only inference surface was the one-shot ``predict`` CLI,
+which re-loads the checkpoint and re-traces the forward every invocation.
+Serving decomposes into four pieces, each independently testable:
+
+- :mod:`~eegnetreplication_tpu.serve.engine` — load a checkpoint once
+  (npz/Orbax/pth via the shared loader), pre-compile the fused forward
+  for a ladder of padded batch buckets (1/8/32/128), thread-safe
+  ``infer``; the ``predict`` CLI routes through the same engine so CLI
+  and server cannot drift.
+- :mod:`~eegnetreplication_tpu.serve.batcher` — dynamic micro-batching:
+  a bounded FIFO coalesced up to ``max_batch`` trials or ``max_wait_ms``,
+  one forward per coalesced batch, results scattered back to per-request
+  futures, explicit 429-shaped backpressure when the queue is full.
+- :mod:`~eegnetreplication_tpu.serve.registry` — integrity-verified model
+  hot-reload: the incoming engine is loaded, digest-checked and warmed
+  off to the side, then swapped in atomically with zero dropped in-flight
+  requests.
+- :mod:`~eegnetreplication_tpu.serve.service` — the stdlib HTTP wiring
+  (``POST /predict``, ``POST /reload``, ``GET /healthz``,
+  ``GET /metrics``), graceful SIGTERM drain via ``resil.preempt``, and
+  the ``serve.forward`` chaos site under the shared retry policy.
+
+Every request flows through obs (latency/queue-depth/bucket-occupancy
+metrics, ``serve_start``/``request``/``model_swap``/``serve_end`` journal
+events).  ``scripts/serve_bench.py`` measures it; ``scripts/serve_smoke.py``
+pins server-vs-CLI prediction equality.
+"""
+
+from eegnetreplication_tpu.serve.batcher import MicroBatcher, Rejected
+from eegnetreplication_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    InferenceEngine,
+    bucket_ladder,
+    load_model_from_checkpoint,
+    variables_digest,
+)
+from eegnetreplication_tpu.serve.registry import ModelRegistry
+from eegnetreplication_tpu.serve.service import ServeApp, serve_until_preempted
+
+__all__ = [
+    "DEFAULT_BUCKETS", "InferenceEngine", "bucket_ladder",
+    "load_model_from_checkpoint", "variables_digest",
+    "MicroBatcher", "Rejected", "ModelRegistry",
+    "ServeApp", "serve_until_preempted",
+]

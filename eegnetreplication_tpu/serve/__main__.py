@@ -1,0 +1,6 @@
+"""``python -m eegnetreplication_tpu.serve`` — the serving entry point."""
+
+from eegnetreplication_tpu.serve.service import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

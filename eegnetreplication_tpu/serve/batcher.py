@@ -1,0 +1,193 @@
+"""Dynamic micro-batching: coalesce concurrent requests into one forward.
+
+An online classifier the size of EEGNet is dispatch-bound: a batch-1
+forward and a batch-32 forward cost nearly the same wall, so serving each
+request alone wastes ~97% of the device.  The batcher keeps a bounded
+FIFO of in-flight requests; a single worker thread coalesces whatever is
+queued — up to ``max_batch`` trials, waiting at most ``max_wait_ms`` from
+the *first* queued request so a lone request is never parked — runs ONE
+inference over the concatenation, and scatters the result rows back to
+per-request futures in arrival order.
+
+Backpressure is explicit: when accepting a request would push the queue
+past ``max_queue_trials``, ``submit`` raises :class:`Rejected` immediately
+(the HTTP layer maps it to 429) instead of letting latency grow without
+bound — a full queue means the service is already saturated and queueing
+deeper only converts overload into timeout errors later.
+
+The worker runs in the submitting thread's :mod:`contextvars` context
+(captured at construction), so the active obs run journal — and the
+``serve.forward`` fault-injection/retry instrumentation wrapped around
+``infer_fn`` by the service — journal into the serving run exactly as
+they would on the main thread.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Callable
+
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.utils.logging import logger
+
+
+class Rejected(RuntimeError):
+    """The request was refused without being enqueued (backpressure or
+    shutdown) — the 429-shaped signal, distinct from an inference error."""
+
+
+class MicroBatcher:
+    """Bounded request queue + one coalescing inference worker.
+
+    ``infer_fn(trials) -> predictions`` is called with the concatenated
+    ``(n, C, T)`` batch from the worker thread only; an exception from it
+    fails exactly the requests in that batch (later arrivals are
+    unaffected).
+    """
+
+    def __init__(self, infer_fn: Callable[[np.ndarray], np.ndarray], *,
+                 max_batch: int = 128, max_wait_ms: float = 5.0,
+                 max_queue_trials: int = 512, journal=None):
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if max_queue_trials < max_batch:
+            raise ValueError(
+                f"max_queue_trials ({max_queue_trials}) must be >= "
+                f"max_batch ({max_batch})")
+        self._infer_fn = infer_fn
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1000.0
+        self.max_queue_trials = int(max_queue_trials)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._cv = threading.Condition()
+        self._pending: deque[tuple[np.ndarray, Future, float]] = deque()
+        self._pending_trials = 0
+        self._closed = False
+        # Run the worker inside a copy of the constructing thread's
+        # context so journal.current() (and inject/retry's journaling)
+        # resolve to the serving run from the worker too — plain threads
+        # do NOT inherit contextvars.
+        ctx = contextvars.copy_context()
+        self._worker = threading.Thread(target=ctx.run, args=(self._run,),
+                                        name="serve-batcher", daemon=True)
+        self._worker.start()
+
+    # -- client side ------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        """Trials currently enqueued (not yet handed to the worker)."""
+        with self._cv:
+            return self._pending_trials
+
+    def submit(self, trials: np.ndarray) -> Future:
+        """Enqueue ``(n, C, T)`` trials; the future resolves to their
+        ``(n,)`` predictions.  Raises :class:`Rejected` when the queue is
+        full or the batcher is shut down."""
+        x = np.asarray(trials, np.float32)
+        if x.ndim == 2:
+            x = x[None]
+        n = len(x)
+        if n == 0:
+            fut: Future = Future()
+            fut.set_result(np.zeros(0, np.int64))
+            return fut
+        fut = Future()
+        with self._cv:
+            if self._closed:
+                raise Rejected("serving is shutting down")
+            if self._pending_trials + n > self.max_queue_trials:
+                self._journal.metrics.inc("requests_rejected")
+                raise Rejected(
+                    f"queue full ({self._pending_trials} trials pending, "
+                    f"limit {self.max_queue_trials})")
+            self._pending.append((x, fut, time.perf_counter()))
+            self._pending_trials += n
+            self._journal.metrics.set("queue_depth_trials",
+                                      self._pending_trials)
+            self._cv.notify_all()
+        return fut
+
+    def close(self, drain: bool = True, timeout: float = 30.0) -> None:
+        """Stop accepting; drain (default) or fail what is queued, then
+        join the worker.  Idempotent."""
+        with self._cv:
+            self._closed = True
+            if not drain:
+                while self._pending:
+                    _, fut, _ = self._pending.popleft()
+                    fut.set_exception(Rejected("serving is shutting down"))
+                self._pending_trials = 0
+            self._cv.notify_all()
+        if self._worker is not threading.current_thread():
+            self._worker.join(timeout)
+            if self._worker.is_alive():
+                logger.warning("Batcher worker did not drain within %.1fs",
+                               timeout)
+
+    # -- worker side ------------------------------------------------------
+    def _take_batch(self) -> list[tuple[np.ndarray, Future, float]] | None:
+        """Block for work, honor the coalescing window, pop one batch.
+        Returns ``None`` when closed and fully drained."""
+        with self._cv:
+            while not self._pending:
+                if self._closed:
+                    return None
+                self._cv.wait(0.05)
+            # Coalesce: wait until max_batch trials are queued or
+            # max_wait has elapsed since the FIRST pending request —
+            # bounded added latency, never an idle park.
+            deadline = self._pending[0][2] + self.max_wait_s
+            while (self._pending_trials < self.max_batch
+                   and not self._closed):
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                self._cv.wait(remaining)
+            batch = []
+            n = 0
+            while self._pending:
+                req_n = len(self._pending[0][0])
+                if batch and n + req_n > self.max_batch:
+                    break  # keep FIFO order; the tail waits for the next batch
+                x, fut, t_enq = self._pending.popleft()
+                batch.append((x, fut, t_enq))
+                n += req_n
+            self._pending_trials -= n
+            self._journal.metrics.set("queue_depth_trials",
+                                      self._pending_trials)
+            return batch
+
+    def _run(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            xs = [x for x, _, _ in batch]
+            x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+            now = time.perf_counter()
+            try:
+                preds = np.asarray(self._infer_fn(x))
+            except BaseException as exc:  # noqa: BLE001 — routed to futures
+                for _, fut, _ in batch:
+                    if not fut.cancelled():
+                        fut.set_exception(exc)
+                continue
+            # Scatter rows back in arrival order: request i owns
+            # preds[off : off + len(request i)].
+            off = 0
+            for bx, fut, t_enq in batch:
+                k = len(bx)
+                if not fut.cancelled():
+                    fut.set_result(preds[off:off + k])
+                off += k
+                self._journal.metrics.observe(
+                    "queue_wait_ms", (now - t_enq) * 1000.0)
+            self._journal.metrics.observe("batch_trials", len(x))
+            self._journal.metrics.observe("batch_requests", len(batch))

@@ -1,0 +1,244 @@
+"""Warm-compiled inference engine: one checkpoint load, bucketed forwards.
+
+The one-shot ``predict`` CLI re-loads the checkpoint and re-traces the
+forward on every invocation — fine for a batch job, fatal for an online
+service where the first request must not pay a multi-second compile.  The
+engine loads a checkpoint ONCE (native ``.npz``, an Orbax directory, or a
+reference ``.pth`` via the existing loaders), folds it into a single jitted
+``argmax(eval_forward(...))`` program, and pre-compiles that program for a
+fixed ladder of padded batch **buckets** (default 1/8/32/128) so every
+request shape an online batcher can produce hits a warm XLA executable.
+
+On a TPU backend the forward routes through the Pallas fused block-1
+kernel when :func:`~eegnetreplication_tpu.ops.fused_eegnet.probe_pallas`
+validates it (same product path as the CLI); elsewhere the XLA-compiled
+jnp twin runs.  Padding rows are replicated from the last real trial and
+dropped after ``argmax`` — eval-mode EEGNet is row-independent, so bucket
+padding can never change a real trial's prediction (the property the
+serve-vs-CLI byte-match smoke in ``scripts/serve_smoke.py`` pins).
+
+``infer`` is thread-safe: a lock serializes device dispatch so the engine
+can be shared by a batcher worker, health probes, and direct callers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.utils.logging import logger
+
+# The padded-batch compilation ladder.  Small enough that warmup stays
+# cheap (4 compiles), dense enough that occupancy (real/padded trials)
+# never drops below 50% once two requests coalesce.
+DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+# BCI-IV-2a class labels, index-aligned with the model's logits.  Defined
+# here (the module both the predict CLI and the HTTP service already
+# import) so the two response surfaces cannot drift.
+CLASS_NAMES = ("left hand", "right hand", "feet", "tongue")
+
+
+def bucket_ladder(max_batch: int,
+                  base: tuple[int, ...] = DEFAULT_BUCKETS) -> tuple[int, ...]:
+    """The default ladder capped at (and including) ``max_batch``."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    return tuple(sorted({b for b in base if b < max_batch} | {max_batch}))
+
+
+def load_model_from_checkpoint(path: str | Path):
+    """(model, params, batch_stats) from a native .npz, an Orbax checkpoint
+    directory, or a reference .pth.
+
+    The single checkpoint-loading path shared by the ``predict`` CLI and
+    the serving engine (it lived in ``predict.py`` until the serve
+    subsystem landed — one loader, so CLI and server cannot drift).
+    Native/Orbax content integrity is verified by the underlying loaders
+    (:mod:`~eegnetreplication_tpu.resil.integrity`).
+    """
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.training import checkpoint as ckpt_lib
+
+    path = Path(path)
+    if path.suffix == ".pth":
+        # Reference-format checkpoint; geometry inferred from tensor shapes
+        # (handles eegnet_wide exports too).
+        params, batch_stats, meta = ckpt_lib.load_pth_auto(path)
+        model = EEGNet(n_channels=meta["n_channels"],
+                       n_times=meta["n_times"], F1=meta["F1"], D=meta["D"])
+        return model, params, batch_stats
+    if path.is_dir():
+        from eegnetreplication_tpu.training import orbax_io
+
+        params, batch_stats, meta = orbax_io.load_orbax_checkpoint(path)
+    else:
+        params, batch_stats, meta = ckpt_lib.load_checkpoint(path)
+    kwargs = {k: meta[k] for k in ("n_channels", "n_times", "F1", "D")
+              if k in meta}
+    if meta.get("model", "eegnet") != "eegnet":
+        from eegnetreplication_tpu.models import get_model
+
+        return (get_model(meta["model"], **{k: v for k, v in kwargs.items()
+                                            if k in ("n_channels", "n_times")}),
+                params, batch_stats)
+    return EEGNet(**kwargs), params, batch_stats
+
+
+def variables_digest(params, batch_stats) -> str:
+    """sha256 content digest of the SERVED variables (params + BN stats).
+
+    Deliberately computed over the in-memory tree rather than the
+    checkpoint file: it identifies what the engine actually serves, and it
+    exists for every source format (.npz, Orbax directory, .pth) — the
+    registry journals it on every ``model_swap`` and ``/healthz`` reports
+    it so a client can tell which weights answered.
+    """
+    import jax
+
+    from eegnetreplication_tpu.resil import integrity
+
+    flat = {}
+    for prefix, tree in (("params/", params), ("batch_stats/", batch_stats)):
+        for path, leaf in jax.tree_util.tree_leaves_with_path(tree):
+            flat[prefix + "/".join(str(getattr(p, "key", p)) for p in path)] \
+                = np.asarray(leaf)
+    return integrity.content_digest(flat)
+
+
+class InferenceEngine:
+    """A loaded model pre-compiled for a ladder of padded batch buckets.
+
+    ``infer(trials)`` pads each chunk to the smallest bucket that fits
+    (chunking by the largest bucket first), runs the warm jitted forward,
+    and returns int64 class predictions for the real rows only.
+    """
+
+    def __init__(self, model, params, batch_stats,
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                 digest: str | None = None, source: str | None = None,
+                 journal=None):
+        import jax
+        import jax.numpy as jnp
+
+        from eegnetreplication_tpu.ops.fused_eegnet import (
+            probe_pallas,
+            supports_fused_eval,
+        )
+        from eegnetreplication_tpu.training.steps import eval_forward
+
+        if not buckets or list(buckets) != sorted(set(buckets)) \
+                or buckets[0] < 1:
+            raise ValueError(
+                f"buckets must be strictly increasing positive ints, got "
+                f"{buckets!r}")
+        self.model = model
+        self.params = params
+        self.batch_stats = batch_stats
+        self.buckets = tuple(int(b) for b in buckets)
+        self.source = source
+        self.digest = digest or variables_digest(params, batch_stats)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._lock = threading.Lock()
+        self._jnp = jnp
+        if supports_fused_eval(model):
+            probe_pallas(model)  # validate/enable the TPU kernel eagerly
+        self._fwd = jax.jit(lambda xx: jnp.argmax(
+            eval_forward(model, params, batch_stats, xx, allow_pallas=True),
+            axis=-1))
+        self._warmed = False
+
+    @classmethod
+    def from_checkpoint(cls, path: str | Path,
+                        buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                        warm: bool = True, journal=None) -> "InferenceEngine":
+        """Load ``path`` (integrity-verified by the loaders) and optionally
+        pre-compile every bucket before the engine is handed out."""
+        model, params, batch_stats = load_model_from_checkpoint(path)
+        engine = cls(model, params, batch_stats, buckets,
+                     source=str(path), journal=journal)
+        if warm:
+            engine.warmup()
+        return engine
+
+    @property
+    def geometry(self) -> tuple[int, int]:
+        """(n_channels, n_times) the engine accepts."""
+        return self.model.n_channels, self.model.n_times
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest bucket >= n (the largest bucket for oversize chunks)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def warmup(self) -> dict[int, float]:
+        """Compile the forward for every bucket; returns bucket -> seconds.
+
+        Journals ``compile_begin``/``compile_end`` per bucket so a serving
+        run's startup cost is part of its telemetry record.  Idempotent —
+        a hot-reload that warms the incoming engine off to the side costs
+        the compiles once, before the atomic swap.
+        """
+        import jax
+
+        c, t = self.geometry
+        walls: dict[int, float] = {}
+        with self._lock:
+            if self._warmed:
+                return walls
+            for b in self.buckets:
+                what = f"serve_forward_b{b}"
+                self._journal.event("compile_begin", what=what)
+                t0 = time.perf_counter()
+                jax.block_until_ready(
+                    self._fwd(self._jnp.zeros((b, c, t), self._jnp.float32)))
+                wall = time.perf_counter() - t0
+                walls[b] = wall
+                self._journal.event("compile_end", what=what,
+                                    elapsed_s=round(wall, 3),
+                                    includes_execution=True)
+                self._journal.metrics.observe("compile_seconds", wall,
+                                              what=what)
+            self._warmed = True
+        logger.info("Engine warm: buckets %s compiled in %.2fs total (%s)",
+                    self.buckets, sum(walls.values()), self.digest[:12])
+        return walls
+
+    def infer(self, trials: np.ndarray) -> np.ndarray:
+        """Class predictions for ``(n, C, T)`` trials (thread-safe)."""
+        x = np.asarray(trials, np.float32)
+        if x.ndim == 2:
+            x = x[None]
+        c, t = self.geometry
+        if x.ndim != 3 or x.shape[1:] != (c, t):
+            raise ValueError(
+                f"expected trials shaped (n, {c}, {t}), got {x.shape}")
+        n = len(x)
+        if n == 0:
+            return np.zeros(0, np.int64)
+        out = np.empty(n, np.int64)
+        top = self.buckets[-1]
+        with self._lock:
+            for start in range(0, n, top):
+                chunk = x[start:start + top]
+                k = len(chunk)
+                b = self.bucket_for(k)
+                if k < b:
+                    # Replicate the last real row: eval mode is
+                    # row-independent, so padding content is irrelevant —
+                    # but a real trial keeps the compiler's value profile
+                    # honest (no denormal/zero fast paths).
+                    chunk = np.concatenate(
+                        [chunk, np.repeat(chunk[-1:], b - k, axis=0)])
+                preds = np.asarray(self._fwd(self._jnp.asarray(chunk)))
+                out[start:start + k] = preds[:k]
+                self._journal.metrics.observe("bucket_fill", k / b,
+                                              bucket=str(b))
+        return out

@@ -1,0 +1,116 @@
+"""Model registry: integrity-verified hot-reload with atomic engine swap.
+
+A long-lived serving process outlives any single checkpoint: training
+produces a better model, the server must pick it up WITHOUT dropping the
+requests already in flight and without a cold-compile gap.  The registry
+owns the current :class:`~eegnetreplication_tpu.serve.engine.InferenceEngine`
+behind a lock; ``reload`` builds the incoming engine entirely off to the
+side — checkpoint load (content digest verified by the loaders /
+:mod:`~eegnetreplication_tpu.resil.integrity`), Pallas probe, warmup of
+every bucket — and only then swaps the reference.  Callers that grabbed
+the old engine keep using it until their forward returns (the object stays
+alive; nothing is torn down), so a swap under load drops zero requests.
+
+A reload of a corrupt/missing checkpoint raises and leaves the current
+engine serving — a bad push must degrade to "nothing changed", never to
+an outage.  Every successful swap is journaled as a ``model_swap`` event
+with the old and new content digests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.serve.engine import DEFAULT_BUCKETS, InferenceEngine
+from eegnetreplication_tpu.utils.logging import logger
+
+
+class ModelRegistry:
+    """Holds the live engine; ``load`` once at startup, ``reload`` to swap."""
+
+    def __init__(self, buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                 journal=None):
+        self.buckets = tuple(buckets)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._lock = threading.Lock()
+        self._engine: InferenceEngine | None = None
+        self._swaps = 0
+        # Serializes reloads: two concurrent /reload posts must not
+        # interleave their warmups and race the swap order.
+        self._reload_lock = threading.Lock()
+
+    @property
+    def engine(self) -> InferenceEngine:
+        with self._lock:
+            if self._engine is None:
+                raise RuntimeError("registry has no model loaded yet")
+            return self._engine
+
+    @property
+    def swaps(self) -> int:
+        with self._lock:
+            return self._swaps
+
+    def load(self, checkpoint: str | Path, *, warm: bool = True
+             ) -> InferenceEngine:
+        """Initial load (no swap event); returns the live engine."""
+        engine = InferenceEngine.from_checkpoint(
+            checkpoint, self.buckets, warm=warm, journal=self._journal)
+        with self._lock:
+            self._engine = engine
+        logger.info("Registry serving %s (digest %s)", checkpoint,
+                    engine.digest[:12])
+        return engine
+
+    def reload(self, checkpoint: str | Path, *, warm: bool = True
+               ) -> InferenceEngine:
+        """Build + warm a new engine from ``checkpoint``, then atomically
+        swap it in.  Raises (IntegrityError, FileNotFoundError, geometry
+        ValueError, ...) WITHOUT touching the current engine on any
+        failure."""
+        with self._reload_lock:
+            t0 = time.perf_counter()
+            engine = InferenceEngine.from_checkpoint(
+                checkpoint, self.buckets, warm=warm, journal=self._journal)
+            old = None
+            with self._lock:
+                # Geometry gate: requests already validated (and queued)
+                # against the live engine's (C, T) must still be servable
+                # after the swap — a different-geometry push would fail
+                # every in-flight batch, the exact outage hot-reload
+                # promises not to cause.  Such a change needs a restart.
+                if (self._engine is not None
+                        and engine.geometry != self._engine.geometry):
+                    raise ValueError(
+                        f"hot-reload geometry mismatch: serving "
+                        f"{self._engine.geometry}, checkpoint {checkpoint} "
+                        f"is {engine.geometry}; restart the service to "
+                        "change model geometry")
+                old, self._engine = self._engine, engine
+                self._swaps += 1
+            wall = time.perf_counter() - t0
+            self._journal.event(
+                "model_swap", checkpoint=str(checkpoint),
+                digest=engine.digest,
+                previous_digest=old.digest if old is not None else None,
+                elapsed_s=round(wall, 3))
+            self._journal.metrics.inc("model_swaps")
+            logger.info("Model swapped in %.2fs: %s -> %s", wall,
+                        old.digest[:12] if old is not None else "none",
+                        engine.digest[:12])
+            return engine
+
+    def infer(self, trials: np.ndarray) -> np.ndarray:
+        """Route one batch through the CURRENT engine.
+
+        The engine reference is captured under the lock, then the forward
+        runs outside it — a swap landing mid-forward leaves this batch on
+        the old (still-alive) engine and routes the next one to the new.
+        """
+        return self.engine.infer(trials)

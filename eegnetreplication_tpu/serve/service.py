@@ -1,0 +1,401 @@
+"""Online inference HTTP service: ``python -m eegnetreplication_tpu.serve``.
+
+Dependency-free serving (stdlib ``http.server`` + threads) wiring the
+subsystem together: the :class:`~eegnetreplication_tpu.serve.registry.ModelRegistry`
+holds the warm-compiled engine, every ``POST /predict`` flows through the
+:class:`~eegnetreplication_tpu.serve.batcher.MicroBatcher`, and the whole
+run is observable (obs) and survivable (resil):
+
+- ``POST /predict`` — trials as JSON (``{"trials": [[[...]]]}``) or raw
+  ``-trials.npz`` bytes; returns predictions.  A full queue answers 429.
+- ``POST /reload`` — ``{"checkpoint": path}``: integrity-verified hot
+  swap with zero dropped in-flight requests.
+- ``GET /healthz`` — liveness + the serving digest and queue depth.
+- ``GET /metrics`` — the run's metrics-registry snapshot (schema-valid).
+
+Each inference dispatch probes the ``serve.forward`` fault-injection site
+and runs under the shared retry policy: a transient/device-fault-shaped
+failure is retried with backoff (journaled), a fatal one fails exactly the
+coalesced batch that hit it.  SIGTERM/SIGINT (via ``resil.preempt``) stop
+the listener, drain the queue, and close the journal with ``serve_end`` —
+a preempted serving host finishes the work it accepted.
+
+Request telemetry: every request is journaled as a ``request`` event
+(n_trials, latency_ms, status) with latency/queue-depth/bucket-occupancy
+metrics aggregated in ``metrics.json``; ``scripts/obs_report.py`` renders
+serving runs (request count, p95, rejected) from exactly these events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import inject, preempt
+from eegnetreplication_tpu.resil import retry as resil_retry
+from eegnetreplication_tpu.serve.batcher import MicroBatcher, Rejected
+from eegnetreplication_tpu.serve.engine import CLASS_NAMES, DEFAULT_BUCKETS
+from eegnetreplication_tpu.serve.registry import ModelRegistry
+from eegnetreplication_tpu.utils.logging import logger
+
+# Short in-process budget: a device hiccup is worth two spaced re-runs of
+# the same small batch; anything deterministic fails the batch fast.
+SERVE_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                                      max_delay_s=1.0)
+
+
+def make_infer_fn(registry: ModelRegistry):
+    """The batcher's inference callable: chaos site + retry + registry.
+
+    ``serve.forward`` fires per dispatch attempt (so ``times=1`` faults
+    exactly one attempt and the retry succeeds); classification and
+    backoff are the shared ``resil.retry`` policy.
+    """
+    def dispatch(x: np.ndarray) -> np.ndarray:
+        inject.fire("serve.forward", n_trials=len(x))
+        return registry.infer(x)
+
+    def infer_fn(x: np.ndarray) -> np.ndarray:
+        return resil_retry.call(lambda: dispatch(x), policy=SERVE_RETRY,
+                                site="serve.forward")
+
+    return infer_fn
+
+
+class ServeApp:
+    """The assembled service: registry + batcher + HTTP listener.
+
+    Construction loads and warms the checkpoint (so the listener never
+    accepts a request it would answer cold); ``start`` binds the socket,
+    ``stop(drain=True)`` stops accepting, drains the queue, and journals
+    ``serve_end``.
+    """
+
+    def __init__(self, checkpoint: str | Path, *, host: str = "127.0.0.1",
+                 port: int = 0, buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+                 max_batch: int | None = None, max_wait_ms: float = 5.0,
+                 max_queue_trials: int = 512,
+                 request_timeout_s: float = 30.0, journal=None):
+        self.journal = journal if journal is not None \
+            else obs_journal.current()
+        self.checkpoint = str(checkpoint)
+        self.registry = ModelRegistry(tuple(buckets), journal=self.journal)
+        self.registry.load(checkpoint)
+        self.batcher = MicroBatcher(
+            make_infer_fn(self.registry),
+            max_batch=max_batch if max_batch is not None else buckets[-1],
+            max_wait_ms=max_wait_ms, max_queue_trials=max_queue_trials,
+            journal=self.journal)
+        self.request_timeout_s = float(request_timeout_s)
+        self._host, self._port = host, int(port)
+        self._httpd: ThreadingHTTPServer | None = None
+        self._listener: threading.Thread | None = None
+        self._stopped = False
+        self._stats_lock = threading.Lock()
+        self._n_requests = 0
+        self._n_rejected = 0
+        self._n_errors = 0
+        self._inflight = 0
+        self._idle = threading.Condition(self._stats_lock)
+        self._t_start = time.perf_counter()
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._httpd is None:
+            raise RuntimeError("server not started")
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServeApp":
+        app = self
+
+        class Handler(_ServeHandler):
+            pass
+
+        Handler.app = app
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._listener = threading.Thread(target=self._httpd.serve_forever,
+                                          name="serve-http", daemon=True)
+        self._listener.start()
+        self.journal.event(
+            "serve_start", checkpoint=self.checkpoint,
+            buckets=list(self.registry.buckets),
+            max_batch=self.batcher.max_batch,
+            max_wait_ms=self.batcher.max_wait_s * 1000.0,
+            max_queue_trials=self.batcher.max_queue_trials,
+            digest=self.registry.engine.digest,
+            host=self.address[0], port=self.address[1])
+        logger.info("Serving %s at %s (buckets %s)", self.checkpoint,
+                    self.url, self.registry.buckets)
+        return self
+
+    def stop(self, drain: bool = True, handler_timeout_s: float = 15.0
+             ) -> None:
+        """Stop the listener, drain (default) or fail queued requests,
+        wait for in-flight handler threads, journal ``serve_end``.
+        Idempotent.
+
+        The handler wait matters for journal integrity: draining the
+        batcher resolves futures that woken handler threads then journal
+        as ``request`` events — emitting ``serve_end`` (and letting the
+        run context write ``run_end``) before those threads finish would
+        put events after the stream's terminal record and undercount the
+        drained requests.
+        """
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.batcher.close(drain=drain)
+        with self._idle:
+            if not self._idle.wait_for(lambda: self._inflight == 0,
+                                       timeout=handler_timeout_s):
+                logger.warning("%d in-flight request handler(s) did not "
+                               "finish within %.1fs", self._inflight,
+                               handler_timeout_s)
+            n_req, n_rej, n_err = (self._n_requests, self._n_rejected,
+                                   self._n_errors)
+        self.journal.event("serve_end", n_requests=n_req, rejected=n_rej,
+                           errors=n_err,
+                           wall_s=round(time.perf_counter() - self._t_start,
+                                        3),
+                           model_swaps=self.registry.swaps)
+        logger.info("Serve drained and stopped: %d requests "
+                    "(%d rejected, %d errors), %d model swap(s)",
+                    n_req, n_rej, n_err, self.registry.swaps)
+
+    # -- request accounting (called from handler threads) -----------------
+    def begin_request(self) -> None:
+        with self._idle:
+            self._inflight += 1
+
+    def end_request(self) -> None:
+        with self._idle:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.notify_all()
+
+    def record_request(self, n_trials: int, latency_ms: float,
+                       status: str) -> None:
+        with self._stats_lock:
+            self._n_requests += 1
+            if status == "rejected":
+                self._n_rejected += 1
+            elif status != "ok":
+                self._n_errors += 1
+        self.journal.event("request", n_trials=n_trials,
+                           latency_ms=round(latency_ms, 3), status=status)
+        self.journal.metrics.inc("requests_total", status=status)
+        if status == "ok":
+            self.journal.metrics.observe("request_latency_ms", latency_ms)
+
+
+class _ServeHandler(BaseHTTPRequestHandler):
+    """One request; instances live on the ThreadingHTTPServer's threads.
+
+    Handler threads do not inherit the main thread's contextvars, so all
+    journaling goes through ``self.app.journal`` explicitly (the batcher
+    worker, by contrast, carries the context — see batcher.py).
+    """
+
+    app: ServeApp = None  # bound by ServeApp.start()
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ---------------------------------------------------------
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        logger.debug("serve http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _parse_trials(self, body: bytes) -> np.ndarray:
+        """Trials from a JSON object or raw ``.npz`` bytes (the native
+        ``-trials.npz`` layout: ``X`` holds the (n, C, T) array)."""
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == "application/json":
+            payload = json.loads(body.decode())
+            if not isinstance(payload, dict) or "trials" not in payload:
+                raise ValueError('JSON body must be {"trials": [...]}')
+            return np.asarray(payload["trials"], np.float32)
+        with np.load(io.BytesIO(body)) as data:
+            if "X" in getattr(data, "files", ()):
+                return np.asarray(data["X"], np.float32)
+            raise ValueError("npz body carries no 'X' trials array")
+
+    # -- routes -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — stdlib naming
+        app = self.app
+        if self.path == "/healthz":
+            engine = app.registry.engine
+            c, t = engine.geometry
+            self._reply(200, {
+                "status": "ok", "checkpoint": app.checkpoint,
+                "model_digest": engine.digest,
+                "geometry": {"n_channels": c, "n_times": t},
+                "buckets": list(engine.buckets),
+                "queue_depth_trials": app.batcher.queue_depth,
+                "model_swaps": app.registry.swaps})
+            return
+        if self.path == "/metrics":
+            self._reply(200, app.journal.metrics.snapshot(
+                run_id=app.journal.run_id))
+            return
+        self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 — stdlib naming
+        app = self.app
+        # In-flight tracking brackets everything that journals, so
+        # ServeApp.stop() can hold serve_end until these threads finish.
+        app.begin_request()
+        try:
+            if self.path == "/predict":
+                self._predict(app)
+                return
+            if self.path == "/reload":
+                self._reload(app)
+                return
+            self._reply(404, {"error": f"unknown path {self.path}"})
+        finally:
+            app.end_request()
+
+    def _predict(self, app: ServeApp) -> None:
+        t0 = time.perf_counter()
+        try:
+            x = self._parse_trials(self._read_body())
+            if x.ndim == 2:
+                x = x[None]
+            c, t = app.registry.engine.geometry
+            if x.ndim != 3 or x.shape[1:] != (c, t):
+                raise ValueError(
+                    f"expected trials shaped (n, {c}, {t}), got "
+                    f"{tuple(x.shape)}")
+        except Exception as exc:  # noqa: BLE001 — client error
+            app.record_request(0, (time.perf_counter() - t0) * 1000.0,
+                               "bad_request")
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        try:
+            fut = app.batcher.submit(x)
+            preds = fut.result(timeout=app.request_timeout_s)
+        except Rejected as exc:
+            app.record_request(len(x), (time.perf_counter() - t0) * 1000.0,
+                               "rejected")
+            self._reply(429, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 — inference/timeout failure
+            app.record_request(len(x), (time.perf_counter() - t0) * 1000.0,
+                               "error")
+            self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        latency_ms = (time.perf_counter() - t0) * 1000.0
+        app.record_request(len(x), latency_ms, "ok")
+        self._reply(200, {
+            "predictions": [int(p) for p in preds],
+            "class_names": list(CLASS_NAMES), "n": len(x),
+            "latency_ms": round(latency_ms, 3),
+            "model_digest": app.registry.engine.digest})
+
+    def _reload(self, app: ServeApp) -> None:
+        try:
+            payload = json.loads(self._read_body().decode() or "{}")
+            checkpoint = payload.get("checkpoint") or app.checkpoint
+            engine = app.registry.reload(checkpoint)
+        except Exception as exc:  # noqa: BLE001 — reload must not kill serving
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        app.checkpoint = str(checkpoint)
+        self._reply(200, {"status": "ok", "checkpoint": str(checkpoint),
+                          "model_digest": engine.digest,
+                          "model_swaps": app.registry.swaps})
+
+
+def serve_until_preempted(app: ServeApp, poll_s: float = 0.2) -> None:
+    """Block until a graceful-stop request (SIGTERM/SIGINT under
+    ``preempt.guard``, or the armed ``host.preempt`` chaos site), then
+    drain and stop.  Factored out of ``main`` so tests drive the exact
+    drain path without real signals."""
+    try:
+        while not preempt.requested():
+            inject.fire("host.preempt")
+            time.sleep(poll_s)
+    finally:
+        logger.info("Stop requested — draining the request queue")
+        app.stop(drain=True)
+
+
+def main(argv=None) -> int:
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+    parser = argparse.ArgumentParser(
+        description="Online EEG inference service (warm-compiled engine, "
+                    "dynamic micro-batching, model hot-reload).")
+    parser.add_argument("--checkpoint", required=True,
+                        help=".npz (native), an Orbax checkpoint directory, "
+                             "or .pth (reference format).")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8790,
+                        help="Listen port (0 = ephemeral).")
+    parser.add_argument("--buckets", default=None,
+                        help="Comma-separated padded-batch compile ladder "
+                             f"(default {','.join(map(str, DEFAULT_BUCKETS))}).")
+    parser.add_argument("--maxWaitMs", type=float, default=5.0,
+                        help="Micro-batch coalescing window from the first "
+                             "queued request.")
+    parser.add_argument("--maxQueue", type=int, default=512,
+                        help="Queue bound in trials; beyond it requests "
+                             "are rejected with 429.")
+    parser.add_argument("--metricsDir", type=str, default=None,
+                        help="Run-journal root (default reports/obs).")
+    args = parser.parse_args(argv)
+
+    try:
+        buckets = (tuple(sorted({int(b) for b in args.buckets.split(",")}))
+                   if args.buckets else DEFAULT_BUCKETS)
+        if not buckets or buckets[0] < 1:
+            raise ValueError("buckets must be positive integers")
+    except ValueError as exc:
+        parser.error(f"--buckets: {exc}")
+
+    from eegnetreplication_tpu.config import Paths
+
+    metrics_dir = (Path(args.metricsDir) if args.metricsDir
+                   else Paths.from_here().reports / "obs")
+    with obs_journal.run(metrics_dir, config=vars(args)) as journal, \
+            preempt.guard():
+        app = ServeApp(args.checkpoint, host=args.host, port=args.port,
+                       buckets=buckets, max_wait_ms=args.maxWaitMs,
+                       max_queue_trials=args.maxQueue, journal=journal)
+        app.start()
+        print(f"serving at {app.url}", flush=True)
+        serve_until_preempted(app)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -92,6 +92,10 @@ _COLUMNS = (
     ("faults_injected", "injected"), ("retries", "retries"),
     ("last_train_loss", "train_loss"), ("last_val_acc", "val_acc%"),
     ("last_grad_norm", "grad_norm"),
+    # Serving runs (serve_start/request/model_swap/serve_end streams);
+    # training rows show "-" here and vice versa.
+    ("n_requests", "reqs"), ("latency_p95_ms", "p95_ms"),
+    ("rejected", "rejected"), ("model_swaps", "swaps"),
 )
 
 

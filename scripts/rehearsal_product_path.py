@@ -10,7 +10,9 @@ end-to-end instead, timing every stage and leaving the artifacts on disk:
   4. ``python -m eegnetreplication_tpu.train --trainingType Within-Subject
      --epochs 500``  (all flags at reference defaults)
   5. ``python -m eegnetreplication_tpu.predict`` on subject 1's Eval set
-  6. viz figures (temporal/spatial/PSD) saved from the trained checkpoint
+  6. ``scripts/serve_smoke.py``: the online serving subsystem answers the
+     same trials file over HTTP and must byte-match the predict CLI
+  7. viz figures (temporal/spatial/PSD) saved from the trained checkpoint
 
 Stage walls and exit codes land in ``<root>/rehearsal.json``.  Run on the
 chip (ambient axon pin, no EEGTPU_PLATFORM override) or force
@@ -131,6 +133,15 @@ def main(argv=None) -> int:
                     "--checkpoint",
                     str(root / "models" / "subject_01_best_model.npz"),
                     "--subject", "1", "--mode", "Eval"],
+        root, record, platform=args.platform)
+    # Serve smoke: the online service answers subject 1's Eval file over
+    # HTTP; predictions must byte-match the predict CLI (shared engine).
+    ok = ok and run_stage(
+        "serve-smoke",
+        [py, str(REPO / "scripts" / "serve_smoke.py"),
+         "--checkpoint", str(root / "models" / "subject_01_best_model.npz"),
+         "--trials",
+         str(root / "data" / "processed" / "Eval" / "A01E-trials.npz")],
         root, record, platform=args.platform)
     if ok:
         viz_src = (

@@ -1,0 +1,433 @@
+#!/usr/bin/env python
+"""Serving load generator: measure dynamic micro-batching, write BENCH_SERVE.json.
+
+Five legs over one warm engine (synthetic checkpoint by default, or
+``--checkpoint``):
+
+1. **sequential** — closed-loop batch-1 requests straight into the engine
+   (one trial per forward: what a no-batching server does per request,
+   and the denominator of the acceptance claim);
+2. **bucket-32** — the warm padded bucket-32 forward driven flat out;
+   its trials/s against leg 1's request rate is the acceptance ratio
+   (``bucket32_speedup``) — the device-level win dynamic batching
+   converts into served throughput;
+3. **open-loop** — submitters push batch-1 requests through the
+   :class:`~eegnetreplication_tpu.serve.batcher.MicroBatcher` as fast as
+   backpressure admits them (no waiting for responses), keeping the
+   queue saturated so the worker coalesces full buckets: the pipeline
+   throughput dynamic batching delivers end-to-end
+   (``batching_speedup`` = its rps over leg 1's, also asserted >= 3x);
+4. **closed-loop** — ``--concurrency`` clients that each wait for their
+   response before submitting again: the per-request latency picture
+   (p50/p95/p99) under interactive load.  Its rps is reported but not
+   asserted — closed-loop throughput is bounded by client round-trip
+   (GIL + futures), not by the batcher;
+5. **hot-reload under load** — a smaller closed-loop run with one
+   integrity-verified ``registry.reload`` at the halfway mark; every
+   request must complete (zero failures — the atomic-swap claim);
+6. **http smoke** — a real :class:`~eegnetreplication_tpu.serve.service.ServeApp`
+   on an ephemeral port answers ``/predict``/``/healthz``/``/metrics``
+   and its prediction must equal the engine's.
+
+The artifact lands atomically through ``obs.schema.write_json_artifact``
+(field definitions: BENCH_NOTES.md).  ``--selftest`` runs a seconds-sized
+version (tiny geometry, few hundred requests), asserts the acceptance
+floor — bucket-32 and open-loop throughput >= 3x the sequential request
+rate, zero failed requests across the swap, HTTP smoke green — and is
+tier-1 (tests/test_serve.py invokes it); the full run is the slow-marked
+leg.
+
+Usage:
+    python scripts/serve_bench.py --out BENCH_SERVE.json
+    python scripts/serve_bench.py --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+SPEEDUP_FLOOR = 3.0  # ISSUE 3 acceptance: bucket-32 vs sequential batch-1
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    return sorted_vals[int(q * (len(sorted_vals) - 1))]
+
+
+def make_synthetic_checkpoint(root: Path, n_channels: int, n_times: int,
+                              seed: int = 0) -> Path:
+    """A freshly initialized EEGNet checkpoint (weights don't matter for a
+    throughput bench; the forward cost is architecture-shaped)."""
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.training.checkpoint import save_checkpoint
+
+    model = EEGNet(n_channels=n_channels, n_times=n_times)
+    variables = model.init(jax.random.PRNGKey(seed),
+                           jnp.zeros((1, n_channels, n_times)), train=False)
+    return save_checkpoint(
+        root / "serve_bench_model.npz", variables["params"],
+        variables["batch_stats"],
+        metadata={"model": "eegnet", "n_channels": n_channels,
+                  "n_times": n_times, "F1": model.F1, "D": model.D})
+
+
+def run_bucket32(engine, trials: np.ndarray, bucket: int,
+                 n_forwards: int) -> dict:
+    """The warm padded-bucket forward driven flat out: trials/s."""
+    batch = np.ascontiguousarray(
+        np.resize(trials, (bucket,) + trials.shape[1:]))
+    t0 = time.perf_counter()
+    for _ in range(n_forwards):
+        engine.infer(batch)
+    wall = time.perf_counter() - t0
+    return {"bucket": bucket, "n_forwards": n_forwards,
+            "wall_s": round(wall, 3),
+            "trials_per_s": round(n_forwards * bucket / max(wall, 1e-9), 2)}
+
+
+def run_sequential(engine, trials: np.ndarray, n_requests: int) -> dict:
+    """Closed-loop batch-1 against the bare engine."""
+    lat: list[float] = []
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        t = time.perf_counter()
+        engine.infer(trials[i % len(trials)][None])
+        lat.append((time.perf_counter() - t) * 1000.0)
+    wall = time.perf_counter() - t0
+    lat.sort()
+    return {"n_requests": n_requests, "wall_s": round(wall, 3),
+            "rps": round(n_requests / max(wall, 1e-9), 2),
+            "p50_ms": round(_percentile(lat, 0.50), 3),
+            "p95_ms": round(_percentile(lat, 0.95), 3),
+            "p99_ms": round(_percentile(lat, 0.99), 3)}
+
+
+def run_open_loop(batcher, trials: np.ndarray, n_requests: int,
+                  submitters: int = 2) -> dict:
+    """Submit batch-1 requests as fast as backpressure admits (no waiting
+    for responses): the batcher stays saturated and coalesces full
+    buckets — pipeline throughput, the number batching exists for."""
+    futures: list = []
+    rejected_retries = [0]
+    lock = threading.Lock()
+    counter = [0]
+
+    def submitter():
+        while True:
+            with lock:
+                if counter[0] >= n_requests:
+                    return
+                i = counter[0]
+                counter[0] += 1
+            while True:
+                try:
+                    fut = batcher.submit(trials[i % len(trials)][None])
+                    break
+                except Exception:  # noqa: BLE001 — backpressure pacing
+                    with lock:
+                        rejected_retries[0] += 1
+                    time.sleep(0.0005)
+            with lock:
+                futures.append(fut)
+
+    threads = [threading.Thread(target=submitter, daemon=True)
+               for _ in range(submitters)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    failures = 0
+    for fut in futures:
+        try:
+            fut.result(timeout=120)
+        except Exception:  # noqa: BLE001 — tallied
+            failures += 1
+    wall = time.perf_counter() - t0
+    ok = len(futures) - failures
+    return {"n_requests": n_requests, "submitters": submitters,
+            "completed": ok, "failures": failures,
+            "backpressure_retries": rejected_retries[0],
+            "wall_s": round(wall, 3),
+            "rps": round(ok / max(wall, 1e-9), 2)}
+
+
+def run_batched(batcher, trials: np.ndarray, n_requests: int,
+                concurrency: int, swap_fn=None) -> dict:
+    """``concurrency`` closed-loop clients through the micro-batcher.
+
+    ``swap_fn`` (when given) performs one hot-reload at the halfway mark
+    while the load runs — the zero-failed-requests claim under swap.
+    """
+    lat: list[float] = []
+    failures: list[str] = []
+    rejected = [0]
+    lock = threading.Lock()
+    counter = [0]
+
+    def client():
+        while True:
+            with lock:
+                if counter[0] >= n_requests:
+                    return
+                i = counter[0]
+                counter[0] += 1
+            t = time.perf_counter()
+            try:
+                fut = batcher.submit(trials[i % len(trials)][None])
+                fut.result(timeout=60)
+            except Exception as exc:  # noqa: BLE001 — tallied, not fatal
+                with lock:
+                    if "queue full" in str(exc):
+                        rejected[0] += 1
+                    else:
+                        failures.append(f"{type(exc).__name__}: {exc}")
+                continue
+            with lock:
+                lat.append((time.perf_counter() - t) * 1000.0)
+
+    threads = [threading.Thread(target=client, daemon=True)
+               for _ in range(concurrency)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    swapped = False
+    if swap_fn is not None:
+        while counter[0] < n_requests // 2:
+            time.sleep(0.005)
+        swap_fn()
+        swapped = True
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    lat.sort()
+    ok = len(lat)
+    return {"n_requests": n_requests, "concurrency": concurrency,
+            "completed": ok, "rejected": rejected[0],
+            "failures": len(failures),
+            "failure_samples": failures[:3],
+            "swap_during_load": swapped,
+            "wall_s": round(wall, 3),
+            "rps": round(ok / max(wall, 1e-9), 2),
+            "p50_ms": round(_percentile(lat, 0.50), 3),
+            "p95_ms": round(_percentile(lat, 0.95), 3),
+            "p99_ms": round(_percentile(lat, 0.99), 3)}
+
+
+def http_smoke(checkpoint: Path, buckets: tuple[int, ...],
+               trials: np.ndarray, expected: np.ndarray, journal) -> dict:
+    """Start the real HTTP service, round-trip one request, compare."""
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    app = ServeApp(checkpoint, port=0, buckets=buckets, max_wait_ms=2.0,
+                   journal=journal).start()
+    try:
+        body = json.dumps({"trials": trials.tolist()}).encode()
+        req = urllib.request.Request(
+            app.url + "/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=30).read())
+        health = json.loads(urllib.request.urlopen(
+            app.url + "/healthz", timeout=10).read())
+        metrics = json.loads(urllib.request.urlopen(
+            app.url + "/metrics", timeout=10).read())
+        ok = (resp["predictions"] == [int(p) for p in expected]
+              and health["status"] == "ok"
+              and "histograms" in metrics)
+        return {"ok": bool(ok), "latency_ms": resp.get("latency_ms"),
+                "model_digest": resp.get("model_digest")}
+    finally:
+        app.stop()
+
+
+def bucket_occupancy(registry_snapshot: dict) -> dict[str, float]:
+    """Mean fill fraction per bucket from the ``bucket_fill`` histogram."""
+    out = {}
+    for entry in registry_snapshot["histograms"].get("bucket_fill", []):
+        out[entry["labels"].get("bucket", "?")] = entry["mean"]
+    return dict(sorted(out.items(), key=lambda kv: int(kv[0])))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Benchmark the online serving subsystem.")
+    parser.add_argument("--checkpoint", default=None,
+                        help="Serve this checkpoint (default: synthesize "
+                             "a fresh EEGNet).")
+    parser.add_argument("--out", default=None,
+                        help="Artifact path (default BENCH_SERVE.json at "
+                             "the repo root; selftest defaults to a temp "
+                             "file so CI never clobbers the committed "
+                             "record).")
+    parser.add_argument("--channels", type=int, default=22)
+    parser.add_argument("--times", type=int, default=257)
+    parser.add_argument("--seqRequests", type=int, default=200)
+    parser.add_argument("--requests", type=int, default=2000)
+    parser.add_argument("--concurrency", type=int, default=24)
+    parser.add_argument("--maxBatch", type=int, default=32,
+                        help="Batcher coalescing cap (the acceptance "
+                             "claim is stated at bucket 32).")
+    parser.add_argument("--maxWaitMs", type=float, default=2.0)
+    parser.add_argument("--selftest", action="store_true",
+                        help="Seconds-sized run + assertions (tier-1).")
+    args = parser.parse_args(argv)
+
+    if args.selftest:
+        args.channels, args.times = 4, 64
+        args.seqRequests, args.requests = 40, 320
+        args.concurrency = 16
+
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+
+    import jax
+
+    from eegnetreplication_tpu.obs.journal import NullJournal
+    from eegnetreplication_tpu.obs.schema import write_json_artifact
+    from eegnetreplication_tpu.serve.batcher import MicroBatcher
+    from eegnetreplication_tpu.serve.engine import DEFAULT_BUCKETS
+    from eegnetreplication_tpu.serve.registry import ModelRegistry
+    from eegnetreplication_tpu.serve.service import make_infer_fn
+
+    tmp = Path(tempfile.mkdtemp(prefix="serve_bench_"))
+    checkpoint = (Path(args.checkpoint) if args.checkpoint
+                  else make_synthetic_checkpoint(tmp, args.channels,
+                                                 args.times))
+    buckets = tuple(b for b in DEFAULT_BUCKETS if b <= max(args.maxBatch, 1))
+    if buckets[-1] != args.maxBatch:
+        buckets = tuple(sorted(set(buckets) | {args.maxBatch}))
+
+    # One shared (inert) journal so engine/batcher metrics aggregate into
+    # a single registry we can snapshot for occupancy — no run dir needed.
+    journal = NullJournal()
+    registry = ModelRegistry(buckets, journal=journal)
+    t0 = time.perf_counter()
+    engine = registry.load(checkpoint)
+    warm_s = time.perf_counter() - t0
+
+    rng = np.random.RandomState(0)
+    trials = rng.randn(64, args.channels, args.times).astype(np.float32)
+    expected = engine.infer(trials[:4])
+
+    print(f"--- sequential: {args.seqRequests} batch-1 requests", flush=True)
+    seq = run_sequential(engine, trials, args.seqRequests)
+    print(f"    {seq['rps']} req/s (p50 {seq['p50_ms']} ms)", flush=True)
+
+    n_fwd = max(10, args.seqRequests // 2)
+    print(f"--- bucket-{args.maxBatch}: {n_fwd} warm forwards", flush=True)
+    b32 = run_bucket32(engine, trials, args.maxBatch, n_fwd)
+    print(f"    {b32['trials_per_s']} trials/s", flush=True)
+
+    batcher = MicroBatcher(make_infer_fn(registry),
+                           max_batch=args.maxBatch,
+                           max_wait_ms=args.maxWaitMs,
+                           max_queue_trials=max(512, 4 * args.maxBatch),
+                           journal=journal)
+    print(f"--- open-loop: {args.requests} requests (max_batch "
+          f"{args.maxBatch})", flush=True)
+    open_loop = run_open_loop(batcher, trials, args.requests)
+    print(f"    {open_loop['rps']} req/s ({open_loop['failures']} failures, "
+          f"{open_loop['backpressure_retries']} backpressure retries)",
+          flush=True)
+
+    print(f"--- closed-loop: {args.requests} requests x {args.concurrency} "
+          f"clients (wait {args.maxWaitMs} ms)", flush=True)
+    batched = run_batched(batcher, trials, args.requests, args.concurrency)
+    print(f"    {batched['rps']} req/s (p50 {batched['p50_ms']} ms, "
+          f"p95 {batched['p95_ms']} ms, {batched['failures']} failures)",
+          flush=True)
+
+    n_swap = max(64, args.requests // 4)
+    print(f"--- hot-reload under load: {n_swap} requests, one swap",
+          flush=True)
+    swap_leg = run_batched(batcher, trials, n_swap,
+                           max(4, args.concurrency // 2),
+                           swap_fn=lambda: registry.reload(checkpoint))
+    batcher.close()
+    print(f"    {swap_leg['completed']}/{n_swap} completed, "
+          f"{swap_leg['failures']} failures, swaps={registry.swaps}",
+          flush=True)
+
+    print("--- http smoke", flush=True)
+    http = http_smoke(checkpoint, buckets, trials[:3], expected[:3], journal)
+    print(f"    ok={http['ok']} latency {http.get('latency_ms')} ms",
+          flush=True)
+
+    e2e_speedup = (open_loop["rps"] / seq["rps"]) if seq["rps"] else 0.0
+    b32_speedup = (b32["trials_per_s"] / seq["rps"]) if seq["rps"] else 0.0
+    record = {
+        "platform": jax.default_backend(),
+        "checkpoint": str(checkpoint),
+        "geometry": {"n_channels": args.channels, "n_times": args.times},
+        "buckets": list(buckets),
+        "max_batch": args.maxBatch,
+        "max_wait_ms": args.maxWaitMs,
+        "warmup_s": round(warm_s, 3),
+        "sequential": seq,
+        "bucket32": b32,
+        "open_loop": open_loop,
+        "closed_loop": batched,
+        "swap_leg": swap_leg,
+        "bucket32_speedup": round(b32_speedup, 2),
+        "batching_speedup": round(e2e_speedup, 2),
+        "bucket_occupancy": bucket_occupancy(journal.metrics.snapshot()),
+        "model_swaps": registry.swaps,
+        "http_smoke": http,
+        "selftest": bool(args.selftest),
+    }
+    out = Path(args.out) if args.out else (
+        Path(tempfile.mkstemp(suffix=".json", prefix="BENCH_SERVE_")[1])
+        if args.selftest else REPO / "BENCH_SERVE.json")
+    write_json_artifact(out, record, indent=1)
+    print(f"wrote {out}")
+    print(json.dumps({k: record[k] for k in
+                      ("bucket32_speedup", "batching_speedup",
+                       "bucket_occupancy", "model_swaps")}))
+
+    if args.selftest:
+        problems = []
+        if b32_speedup < SPEEDUP_FLOOR:
+            problems.append(f"bucket-{args.maxBatch} speedup "
+                            f"{b32_speedup:.2f} < {SPEEDUP_FLOOR}")
+        if e2e_speedup < SPEEDUP_FLOOR:
+            problems.append(f"open-loop speedup {e2e_speedup:.2f} < "
+                            f"{SPEEDUP_FLOOR}")
+        if open_loop["failures"]:
+            problems.append(f"{open_loop['failures']} failed open-loop "
+                            "requests")
+        for name, leg in (("closed-loop", batched), ("swap", swap_leg)):
+            if leg["failures"]:
+                problems.append(f"{leg['failures']} failed {name} requests "
+                                f"({leg['failure_samples']})")
+            if leg["completed"] + leg["rejected"] != leg["n_requests"]:
+                problems.append(f"{name} request accounting mismatch")
+        if not http["ok"]:
+            problems.append("http smoke failed")
+        if registry.swaps < 1:
+            problems.append("hot-reload did not run")
+        if problems:
+            print("SELFTEST FAIL: " + "; ".join(problems))
+            return 1
+        print("SELFTEST PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Serve-vs-CLI smoke: served predictions must byte-match the predict CLI.
+
+The serving engine and the ``predict`` CLI share one loader and one
+bucketed padded forward by construction (``serve/engine.py``); this smoke
+pins that contract at the PRODUCT boundary, end to end:
+
+1. start the real HTTP service on an ephemeral port for ``--checkpoint``;
+2. ``POST`` the raw ``-trials.npz`` file bytes to ``/predict``;
+3. assert the served predictions equal ``predict_trials`` (the exact
+   function the CLI calls) on the same arrays;
+4. run the actual ``python -m eegnetreplication_tpu.predict`` subprocess
+   and assert its final stdout line byte-matches the line recomputed from
+   the SERVED predictions (accuracy line when the file carries labels,
+   class-count line otherwise).
+
+Exit 0 on PASS.  Wired as the ``serve-smoke`` leg of
+``scripts/rehearsal_product_path.py`` and exercised CI-sized by
+``tests/test_serve.py``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def served_predictions(checkpoint: str, trials_path: Path) -> list[int]:
+    """Round-trip the trials file through a live service instance."""
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    app = ServeApp(checkpoint, port=0).start()
+    try:
+        req = urllib.request.Request(
+            app.url + "/predict", data=trials_path.read_bytes(),
+            headers={"Content-Type": "application/octet-stream"})
+        resp = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        return resp["predictions"]
+    finally:
+        app.stop()
+
+
+def cli_stdout_line(checkpoint: str, trials_path: Path) -> str:
+    """Last stdout line of the real predict CLI subprocess."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "eegnetreplication_tpu.predict",
+         "--checkpoint", checkpoint, "--input", str(trials_path)],
+        cwd=REPO, capture_output=True, text=True, timeout=600,
+        env={**os.environ,
+             "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"})
+    if proc.returncode != 0:
+        raise RuntimeError(f"predict CLI failed rc={proc.returncode}:\n"
+                           f"{proc.stderr[-1500:]}")
+    lines = [ln for ln in proc.stdout.strip().splitlines() if ln.strip()]
+    return lines[-1]
+
+
+def expected_line(pred: np.ndarray, y: np.ndarray | None) -> str:
+    """The line the CLI prints, recomputed from the served predictions
+    (must mirror ``predict.main`` exactly)."""
+    from eegnetreplication_tpu.predict import CLASS_NAMES
+
+    if y is not None and len(y):
+        acc = 100.0 * float(np.mean(pred == y))
+        return f"accuracy: {acc:.2f}%"
+    counts = np.bincount(pred, minlength=len(CLASS_NAMES))
+    return (f"predicted {len(pred)} trials: "
+            + ", ".join(f"{n}={c}" for n, c in zip(CLASS_NAMES, counts)))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Assert server and predict-CLI agree on a trials file.")
+    parser.add_argument("--checkpoint", required=True)
+    parser.add_argument("--trials", required=True,
+                        help="A -trials.npz file (X, optionally y).")
+    parser.add_argument("--skip-cli", action="store_true",
+                        help="Skip the subprocess leg (CI-sized runs).")
+    args = parser.parse_args(argv)
+
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+
+    trials_path = Path(args.trials)
+    with np.load(trials_path) as data:
+        x = np.asarray(data["X"], np.float32)
+        y = np.asarray(data["y"]) if "y" in data.files else None
+
+    served = np.asarray(served_predictions(args.checkpoint, trials_path),
+                        np.int64)
+    print(f"served {len(served)} predictions", flush=True)
+
+    from eegnetreplication_tpu.predict import predict_trials
+    from eegnetreplication_tpu.serve.engine import load_model_from_checkpoint
+
+    model, params, batch_stats = load_model_from_checkpoint(args.checkpoint)
+    cli_pred = predict_trials(model, params, batch_stats, x)
+    if not np.array_equal(served, cli_pred):
+        diff = int(np.sum(served != cli_pred))
+        print(f"FAIL: served predictions differ from predict_trials on "
+              f"{diff}/{len(x)} trials")
+        return 1
+
+    if not args.skip_cli:
+        got = cli_stdout_line(args.checkpoint, trials_path)
+        want = expected_line(served, y)
+        if got != want:
+            print(f"FAIL: CLI stdout {got!r} != served-derived {want!r}")
+            return 1
+        print(f"CLI line byte-match: {got!r}")
+
+    print("SERVE SMOKE PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
