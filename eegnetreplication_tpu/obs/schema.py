@@ -57,6 +57,20 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "request": ("n_trials", "latency_ms", "status"),
     "model_swap": ("checkpoint", "digest"),
     "serve_end": ("n_requests", "rejected", "wall_s"),
+    # Liveness (resil/heartbeat.py): throttled beats from long-lived
+    # loops, and the circuit breaker's state machine (resil/breaker.py).
+    "heartbeat": ("phase", "beat"),
+    "circuit_state": ("state", "previous", "reason"),
+    # Supervision (resil/supervise.py): every launch/exit/restart/kill
+    # decision the out-of-process supervisor makes.
+    "supervisor_start": ("cmd",),
+    "supervisor_launch": ("attempt", "cmd", "resume"),
+    "supervisor_exit": ("attempt", "exit_code", "classification"),
+    "supervisor_hang": ("attempt", "age_s", "threshold_s", "phase"),
+    "supervisor_escalate": ("attempt", "signal"),
+    "supervisor_restart": ("attempt", "reason", "delay_s", "resume"),
+    "supervisor_giveup": ("restarts", "window_s"),
+    "supervisor_end": ("status",),
     "run_end": ("status", "wall_s"),
 }
 
@@ -264,9 +278,16 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         out["n_requests"] = len(requests)
         out["rejected"] = sum(1 for e in requests
                               if e.get("status") == "rejected")
-        out["request_errors"] = sum(1 for e in requests
-                                    if e.get("status") not in ("ok",
-                                                               "rejected"))
+        # Deadline drops and open-circuit refusals are their own buckets:
+        # they are load-shedding decisions, not inference errors.
+        out["expired"] = sum(1 for e in requests
+                             if e.get("status") == "expired")
+        out["circuit_refusals"] = sum(1 for e in requests
+                                      if e.get("status") == "circuit_open")
+        out["request_errors"] = sum(
+            1 for e in requests
+            if e.get("status") not in ("ok", "rejected", "expired",
+                                       "circuit_open"))
         out["model_swaps"] = len(swaps)
         lat = sorted(e["latency_ms"] for e in requests
                      if e.get("status") == "ok"
@@ -278,6 +299,25 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         out["faults_injected"] = len(injected)
     if retries:
         out["retries"] = len(retries)
+    # Supervision & liveness (PR 5): restarts/hangs from a supervisor
+    # stream, breaker trips from a serving stream — only reported when
+    # present so training rows stay compact.
+    restarts = [e for e in events if e["event"] == "supervisor_restart"]
+    hangs = [e for e in events if e["event"] == "supervisor_hang"]
+    trips = [e for e in events if e["event"] == "circuit_state"
+             and e.get("state") == "open"]
+    if any(e["event"] == "supervisor_start" for e in events) or restarts \
+            or hangs:
+        out["supervisor_restarts"] = len(restarts)
+        out["hang_detections"] = len(hangs)
+        giveup = [e for e in events if e["event"] == "supervisor_giveup"]
+        ends = [e for e in events if e["event"] == "supervisor_end"]
+        if ends:
+            out["supervisor_status"] = ends[-1].get("status")
+        if giveup:
+            out["supervisor_status"] = "crash_loop"
+    if trips:
+        out["breaker_trips"] = len(trips)
     out["compile_s"] = round(sum(e.get("elapsed_s", 0.0) for e in compiles), 2)
     if epochs:
         last = epochs[-1]

@@ -26,19 +26,45 @@ through one subsystem and is journaled:
   raises :class:`~eegnetreplication_tpu.resil.preempt.Preempted` at the
   next snapshot boundary, the journal records
   ``run_end(status="preempted")``, and ``--resume`` continues from the
-  snapshot.
+  snapshot (exit code :data:`~eegnetreplication_tpu.resil.preempt.EX_PREEMPTED`).
+- :mod:`~eegnetreplication_tpu.resil.heartbeat` — liveness beats from
+  every long-lived loop (training chunks, fetch, the serve worker) plus a
+  per-phase staleness :class:`~eegnetreplication_tpu.resil.heartbeat.Watchdog`;
+  the exceptions above cover *raised* failures, beats cover the silent
+  ones (stuck compile, wedged worker).
+- :mod:`~eegnetreplication_tpu.resil.supervise` — the out-of-process
+  half: ``eegtpu-supervise`` runs train/serve as a child, enforces the
+  watchdog (SIGTERM → SIGKILL escalation), maps exit codes to a restart
+  policy, and trips a crash-loop breaker instead of restarting forever.
+- :mod:`~eegnetreplication_tpu.resil.breaker` — a consecutive-failure
+  circuit breaker (open → fast refusals → half-open probes → closed)
+  wrapped around the serving forward.
 
 Exercise everything end-to-end with ``scripts/chaos_drill.py``.
 """
 
-from eegnetreplication_tpu.resil import inject, integrity, preempt, retry
+from eegnetreplication_tpu.resil import (
+    breaker,
+    heartbeat,
+    inject,
+    integrity,
+    preempt,
+    retry,
+    supervise,
+)
+from eegnetreplication_tpu.resil.breaker import CircuitBreaker, CircuitOpen
+from eegnetreplication_tpu.resil.heartbeat import Heartbeat, Watchdog
 from eegnetreplication_tpu.resil.inject import FaultSpec, parse_plan
 from eegnetreplication_tpu.resil.integrity import IntegrityError
-from eegnetreplication_tpu.resil.preempt import Preempted
+from eegnetreplication_tpu.resil.preempt import EX_PREEMPTED, Preempted
 from eegnetreplication_tpu.resil.retry import RetryPolicy, is_device_fault
+from eegnetreplication_tpu.resil.supervise import Supervisor, SupervisorPolicy
 
 __all__ = [
-    "inject", "integrity", "preempt", "retry",
-    "FaultSpec", "parse_plan", "IntegrityError", "Preempted",
-    "RetryPolicy", "is_device_fault",
+    "breaker", "heartbeat", "inject", "integrity", "preempt", "retry",
+    "supervise",
+    "CircuitBreaker", "CircuitOpen", "Heartbeat", "Watchdog",
+    "FaultSpec", "parse_plan", "IntegrityError", "EX_PREEMPTED",
+    "Preempted", "RetryPolicy", "is_device_fault", "Supervisor",
+    "SupervisorPolicy",
 ]

@@ -37,6 +37,13 @@ site                  action     effect
                                  (retried under ``serve.service``'s
                                  policy; a ``fatal``-classified override
                                  fails exactly that coalesced batch)
+``train.hang``        sleep      silent stall (``sleep=SECONDS``) at the
+                                 training chunk boundary — no exception,
+                                 just no progress; what the heartbeat
+                                 watchdog/supervisor exist to catch
+``serve.hang``        sleep      same stall in the serve batcher worker
+                                 before its inference dispatch (wedges
+                                 the worker; ``/healthz`` degrades)
 ====================  =========  ==========================================
 
 Chaos plans (the ``--chaos`` flag) are comma-separated site specs with
@@ -63,9 +70,15 @@ from eegnetreplication_tpu.utils.logging import logger
 # rejects names outside this set so a chaos-plan typo fails loudly
 # instead of silently never firing.
 SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
-         "host.preempt", "train.chunk", "serve.forward")
+         "host.preempt", "train.chunk", "serve.forward", "train.hang",
+         "serve.hang")
 
-ACTIONS = ("raise", "corrupt", "preempt")
+ACTIONS = ("raise", "corrupt", "preempt", "sleep")
+
+# Default hang duration for action="sleep" when the spec sets none: long
+# enough that any sane watchdog budget expires first, short enough that a
+# plan armed without a watchdog eventually releases the process.
+DEFAULT_HANG_S = 60.0
 
 _EXC_TYPES: dict[str, type[Exception]] = {
     "RuntimeError": RuntimeError,
@@ -95,6 +108,8 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
     "serve.forward": ("raise", "RuntimeError",
                       "UNAVAILABLE: device error (injected fault: "
                       "serve.forward, hit {hit})"),
+    "train.hang": ("sleep", None, "injected hang: train.hang (hit {hit})"),
+    "serve.hang": ("sleep", None, "injected hang: serve.hang (hit {hit})"),
 }
 
 
@@ -114,6 +129,7 @@ class FaultSpec:
     exc: str | None = None      # exception class name for action="raise"
     message: str | None = None  # may contain "{hit}"
     if_folds_over: int | None = None  # train.step: only programs > N folds
+    sleep: float | None = None  # action="sleep": hang duration in seconds
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -132,6 +148,15 @@ class FaultSpec:
             raise ValueError(
                 f"after/times must be >= 0, got after={self.after} "
                 f"times={self.times}")
+        if self.sleep is not None:
+            try:
+                self.sleep = float(self.sleep)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"sleep must be a number of seconds, got "
+                    f"{self.sleep!r}") from None
+            if self.sleep < 0:
+                raise ValueError(f"sleep must be >= 0, got {self.sleep}")
 
 
 class ArmedFault:
@@ -275,6 +300,18 @@ def fire(site: str, **ctx) -> None:
 
         preempt.request(message)
         return
+    if action == "sleep":
+        # A silent stall, not an exception: the instrumented call simply
+        # stops making progress for the duration — exactly what a stuck
+        # compile or wedged worker looks like from outside, which is what
+        # the heartbeat watchdog and supervisor exist to catch.  The
+        # sleep is signal-interruptible-and-resumed (PEP 475), so a
+        # supervisor's SIGTERM runs the graceful handler but the hang
+        # persists until SIGKILL — the escalation path under test.
+        import time as _time
+
+        _time.sleep(spec.sleep if spec.sleep is not None else DEFAULT_HANG_S)
+        return
     exc_cls = _EXC_TYPES[spec.exc or d_exc or "RuntimeError"]
     raise exc_cls(message)
 
@@ -294,6 +331,8 @@ def parse_plan(text: str) -> list[FaultSpec]:
     valid_keys = {f.name for f in fields(FaultSpec)}
     int_fields = {f.name for f in fields(FaultSpec)
                   if f.type in ("int", "int | None")}
+    float_fields = {f.name for f in fields(FaultSpec)
+                    if f.type in ("float", "float | None")}
 
     def coerce_int(key: str, value):
         try:
@@ -326,6 +365,10 @@ def parse_plan(text: str) -> list[FaultSpec]:
             for k, v in entry.items():
                 if k in int_fields:
                     kwargs[k] = coerce_int(k, v) if v is not None else None
+                elif k in float_fields:
+                    # Validated/coerced by FaultSpec.__post_init__, which
+                    # raises the same parse-time ValueError contract.
+                    kwargs[k] = v
                 elif v is not None and not isinstance(v, str):
                     # Parse-time failure guarantee: a non-string message/
                     # exc/action must fail HERE, not minutes later when

@@ -24,6 +24,13 @@ from typing import Iterator
 
 from eegnetreplication_tpu.utils.logging import logger
 
+# The process exit code of a gracefully preempted run (BSD EX_TEMPFAIL):
+# schedulers and the supervisor (``resil/supervise.py``) key their
+# relaunch-with---resume policy on exactly this value, so it is defined
+# once here and imported everywhere (``train.py``, ``serve/service.py``)
+# instead of each entry point hard-coding 75.
+EX_PREEMPTED = 75
+
 
 class Preempted(RuntimeError):
     """The run was asked to stop and has snapshotted its state.

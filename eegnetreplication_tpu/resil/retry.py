@@ -79,12 +79,17 @@ class RetryPolicy:
     jitter: float = 0.1
     deadline_s: float | None = None
     retry_on: tuple[str, ...] = (TRANSIENT, DEVICE_FAULT)
+    # Optional seeded jitter source (``random.Random(seed)``): restart/
+    # backoff tests assert EXACT schedules instead of sleeping through
+    # real jitter.  None uses the module-level generator (production).
+    rng: random.Random | None = None
 
     def delay(self, attempt: int) -> float:
         d = min(self.base_delay_s * self.multiplier ** (attempt - 1),
                 self.max_delay_s)
         if self.jitter:
-            d *= 1.0 + random.uniform(-self.jitter, self.jitter)
+            r = self.rng if self.rng is not None else random
+            d *= 1.0 + r.uniform(-self.jitter, self.jitter)
         return max(d, 0.0)
 
 

@@ -13,7 +13,17 @@ Backpressure is explicit: when accepting a request would push the queue
 past ``max_queue_trials``, ``submit`` raises :class:`Rejected` immediately
 (the HTTP layer maps it to 429) instead of letting latency grow without
 bound — a full queue means the service is already saturated and queueing
-deeper only converts overload into timeout errors later.
+deeper only converts overload into timeout errors later.  Deadlines are
+enforced at dequeue: a request whose caller-supplied deadline expired
+while it sat in the queue is dropped with :class:`DeadlineExceeded`
+(504) *before* its forward runs — a client that already gave up must not
+steal device time from ones still waiting.
+
+The worker also emits liveness heartbeats (``serve_idle`` while polling,
+``serve_forward`` around each dispatch) so ``/healthz`` and an external
+supervisor can tell a wedged worker from an idle one
+(``resil/heartbeat.py``), and probes the ``serve.hang`` chaos site so
+that distinction is deterministically testable.
 
 The worker runs in the submitting thread's :mod:`contextvars` context
 (captured at construction), so the active obs run journal — and the
@@ -34,12 +44,21 @@ from typing import Callable
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import heartbeat as hb
+from eegnetreplication_tpu.resil import inject
 from eegnetreplication_tpu.utils.logging import logger
 
 
 class Rejected(RuntimeError):
     """The request was refused without being enqueued (backpressure or
     shutdown) — the 429-shaped signal, distinct from an inference error."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline expired before its forward ran (dropped at
+    dequeue) or before its response could be used — the 504-shaped
+    signal: the client has given up, so spending a forward on it only
+    steals capacity from requests that still have a waiting caller."""
 
 
 class MicroBatcher:
@@ -53,7 +72,8 @@ class MicroBatcher:
 
     def __init__(self, infer_fn: Callable[[np.ndarray], np.ndarray], *,
                  max_batch: int = 128, max_wait_ms: float = 5.0,
-                 max_queue_trials: int = 512, journal=None):
+                 max_queue_trials: int = 512, journal=None,
+                 heartbeat: hb.Heartbeat | None = None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue_trials < max_batch:
@@ -66,8 +86,16 @@ class MicroBatcher:
         self.max_queue_trials = int(max_queue_trials)
         self._journal = journal if journal is not None \
             else obs_journal.current()
+        # Worker liveness: beats phase "serve_idle" while polling and
+        # "serve_forward" around each dispatch, so /healthz (and an
+        # external watchdog via EEGTPU_HEARTBEAT_FILE) can tell a wedged
+        # worker from an idle one.  Default: the process emitter.
+        self.heartbeat = heartbeat if heartbeat is not None else hb.emitter()
         self._cv = threading.Condition()
-        self._pending: deque[tuple[np.ndarray, Future, float]] = deque()
+        # Entries: (trials, future, t_enqueued, deadline-or-None) where
+        # the deadline is a time.monotonic() instant.
+        self._pending: deque[
+            tuple[np.ndarray, Future, float, float | None]] = deque()
         self._pending_trials = 0
         self._closed = False
         # Run the worker inside a copy of the constructing thread's
@@ -86,10 +114,14 @@ class MicroBatcher:
         with self._cv:
             return self._pending_trials
 
-    def submit(self, trials: np.ndarray) -> Future:
+    def submit(self, trials: np.ndarray,
+               deadline: float | None = None) -> Future:
         """Enqueue ``(n, C, T)`` trials; the future resolves to their
         ``(n,)`` predictions.  Raises :class:`Rejected` when the queue is
-        full or the batcher is shut down."""
+        full or the batcher is shut down.  ``deadline`` (a
+        ``time.monotonic()`` instant) marks when the caller stops caring:
+        a request still queued past it is dropped at dequeue with
+        :class:`DeadlineExceeded` instead of wasting a forward."""
         x = np.asarray(trials, np.float32)
         if x.ndim == 2:
             x = x[None]
@@ -107,7 +139,7 @@ class MicroBatcher:
                 raise Rejected(
                     f"queue full ({self._pending_trials} trials pending, "
                     f"limit {self.max_queue_trials})")
-            self._pending.append((x, fut, time.perf_counter()))
+            self._pending.append((x, fut, time.perf_counter(), deadline))
             self._pending_trials += n
             self._journal.metrics.set("queue_depth_trials",
                                       self._pending_trials)
@@ -121,7 +153,7 @@ class MicroBatcher:
             self._closed = True
             if not drain:
                 while self._pending:
-                    _, fut, _ = self._pending.popleft()
+                    _, fut, _, _ = self._pending.popleft()
                     fut.set_exception(Rejected("serving is shutting down"))
                 self._pending_trials = 0
             self._cv.notify_all()
@@ -134,45 +166,91 @@ class MicroBatcher:
     # -- worker side ------------------------------------------------------
     def _take_batch(self) -> list[tuple[np.ndarray, Future, float]] | None:
         """Block for work, honor the coalescing window, pop one batch.
-        Returns ``None`` when closed and fully drained."""
-        with self._cv:
-            while not self._pending:
-                if self._closed:
-                    return None
-                self._cv.wait(0.05)
-            # Coalesce: wait until max_batch trials are queued or
-            # max_wait has elapsed since the FIRST pending request —
-            # bounded added latency, never an idle park.
-            deadline = self._pending[0][2] + self.max_wait_s
-            while (self._pending_trials < self.max_batch
-                   and not self._closed):
-                remaining = deadline - time.perf_counter()
-                if remaining <= 0:
-                    break
-                self._cv.wait(remaining)
-            batch = []
-            n = 0
-            while self._pending:
-                req_n = len(self._pending[0][0])
-                if batch and n + req_n > self.max_batch:
-                    break  # keep FIFO order; the tail waits for the next batch
-                x, fut, t_enq = self._pending.popleft()
-                batch.append((x, fut, t_enq))
-                n += req_n
-            self._pending_trials -= n
-            self._journal.metrics.set("queue_depth_trials",
-                                      self._pending_trials)
-            return batch
+        Returns ``None`` when closed and fully drained.  Requests whose
+        deadline already passed are dropped HERE — before the forward —
+        with :class:`DeadlineExceeded` on their future."""
+        expired: list[Future] = []
+        try:
+            while True:
+                with self._cv:
+                    if self._pending:
+                        return self._coalesce_locked(expired)
+                    if self._closed:
+                        return None
+                    self._cv.wait(0.05)
+                # Idle poll elapsed with no work: beat OUTSIDE the lock —
+                # the beat's throttled file write (supervised serving)
+                # must never add filesystem latency to a concurrent
+                # submit() contending for the condition lock.
+                self.heartbeat.beat("serve_idle")
+        finally:
+            # Resolve expired futures outside the lock: their handler
+            # threads wake straight into journaling.
+            for fut in expired:
+                if not fut.cancelled():
+                    fut.set_exception(DeadlineExceeded(
+                        "request deadline expired while queued; dropped "
+                        "before inference"))
+
+    def _coalesce_locked(self, expired: list[Future]
+                         ) -> list[tuple[np.ndarray, Future, float]]:
+        """Honor the coalescing window and pop one batch (``self._cv``
+        held).  Requests whose deadline passed while queued go onto
+        ``expired`` instead of into the batch."""
+        # Coalesce: wait until max_batch trials are queued or max_wait
+        # has elapsed since the FIRST pending request — bounded added
+        # latency, never an idle park.
+        wait_until = self._pending[0][2] + self.max_wait_s
+        while (self._pending_trials < self.max_batch
+               and not self._closed):
+            remaining = wait_until - time.perf_counter()
+            if remaining <= 0:
+                break
+            self._cv.wait(remaining)
+        batch = []
+        n = 0
+        now = time.monotonic()
+        while self._pending:
+            req_n = len(self._pending[0][0])
+            x, fut, t_enq, deadline = self._pending[0]
+            if deadline is not None and now >= deadline:
+                # Expired while queued: drop before the forward.
+                self._pending.popleft()
+                self._pending_trials -= req_n
+                expired.append(fut)
+                self._journal.metrics.inc("requests_expired")
+                continue
+            if batch and n + req_n > self.max_batch:
+                break  # FIFO: the tail waits for the next batch
+            self._pending.popleft()
+            batch.append((x, fut, t_enq))
+            n += req_n
+        self._pending_trials -= n
+        self._journal.metrics.set("queue_depth_trials",
+                                  self._pending_trials)
+        return batch
 
     def _run(self) -> None:
+        # First beat at thread start: the worker announces itself before
+        # any request exists, so /healthz never reads a "startup" phase
+        # from a batcher whose worker is already alive.
+        self.heartbeat.beat("serve_idle")
         while True:
             batch = self._take_batch()
             if batch is None:
                 return
+            if not batch:  # every queued request expired: nothing to run
+                continue
             xs = [x for x, _, _ in batch]
             x = np.concatenate(xs) if len(xs) > 1 else xs[0]
             now = time.perf_counter()
             try:
+                self.heartbeat.beat("serve_forward", n_trials=len(x))
+                # Chaos hang site (action="sleep"): a silent stall inside
+                # the dispatch — the last beat says "serve_forward" and
+                # then nothing, which is exactly the wedged-worker shape
+                # /healthz staleness and the supervisor watchdog detect.
+                inject.fire("serve.hang", n_trials=len(x))
                 preds = np.asarray(self._infer_fn(x))
             except BaseException as exc:  # noqa: BLE001 — routed to futures
                 for _, fut, _ in batch:
@@ -191,3 +269,4 @@ class MicroBatcher:
                     "queue_wait_ms", (now - t_enq) * 1000.0)
             self._journal.metrics.observe("batch_trials", len(x))
             self._journal.metrics.observe("batch_requests", len(batch))
+            self.heartbeat.beat("serve_idle")

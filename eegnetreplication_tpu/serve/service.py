@@ -8,10 +8,21 @@ run is observable (obs) and survivable (resil):
 
 - ``POST /predict`` — trials as JSON (``{"trials": [[[...]]]}``) or raw
   ``-trials.npz`` bytes; returns predictions.  A full queue answers 429.
+  A per-request deadline (``X-Deadline-Ms`` header or ``deadline_ms``
+  JSON field) is enforced at dequeue (an expired request is dropped
+  before wasting a forward) and at response time — both answer 504.
 - ``POST /reload`` — ``{"checkpoint": path}``: integrity-verified hot
   swap with zero dropped in-flight requests.
-- ``GET /healthz`` — liveness + the serving digest and queue depth.
+- ``GET /healthz`` — liveness + the serving digest and queue depth;
+  degrades to 503 when the circuit breaker is open or the batcher
+  worker's heartbeat is stale, so external orchestrators can act.
 - ``GET /metrics`` — the run's metrics-registry snapshot (schema-valid).
+
+A :class:`~eegnetreplication_tpu.resil.breaker.CircuitBreaker` guards
+``serve.forward``: consecutive post-retry failures open it and /predict
+answers fast 503s without touching the queue or the device; after the
+cooldown, half-open probe requests are admitted and one success closes
+it.  Every transition is a ``circuit_state`` journal event.
 
 Each inference dispatch probes the ``serve.forward`` fault-injection site
 and runs under the shared retry policy: a transient/device-fault-shaped
@@ -31,6 +42,8 @@ from __future__ import annotations
 import argparse
 import io
 import json
+import math
+import os
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -39,9 +52,15 @@ from pathlib import Path
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import heartbeat as hb
 from eegnetreplication_tpu.resil import inject, preempt
 from eegnetreplication_tpu.resil import retry as resil_retry
-from eegnetreplication_tpu.serve.batcher import MicroBatcher, Rejected
+from eegnetreplication_tpu.resil.breaker import CircuitBreaker
+from eegnetreplication_tpu.serve.batcher import (
+    DeadlineExceeded,
+    MicroBatcher,
+    Rejected,
+)
 from eegnetreplication_tpu.serve.engine import CLASS_NAMES, DEFAULT_BUCKETS
 from eegnetreplication_tpu.serve.registry import ModelRegistry
 from eegnetreplication_tpu.utils.logging import logger
@@ -51,21 +70,39 @@ from eegnetreplication_tpu.utils.logging import logger
 SERVE_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.05,
                                       max_delay_s=1.0)
 
+# Worker-liveness budgets for /healthz: the batcher worker beats every
+# poll iteration, so even a few seconds of silence while "idle" means the
+# thread is gone or wedged; a beat parked in "serve_forward" gets a
+# forward-plus-retry-budget allowance.
+SERVE_WATCHDOG_THRESHOLDS = {"serve_idle": 10.0, "serve_forward": 60.0}
 
-def make_infer_fn(registry: ModelRegistry):
-    """The batcher's inference callable: chaos site + retry + registry.
+
+def make_infer_fn(registry: ModelRegistry, breaker: CircuitBreaker | None
+                  = None):
+    """The batcher's inference callable: chaos site + retry + registry,
+    with dispatch outcomes fed to the circuit ``breaker`` (when given).
 
     ``serve.forward`` fires per dispatch attempt (so ``times=1`` faults
     exactly one attempt and the retry succeeds); classification and
-    backoff are the shared ``resil.retry`` policy.
+    backoff are the shared ``resil.retry`` policy.  The breaker sees the
+    POST-retry outcome: a transient blip the retry absorbed is a success,
+    only an exhausted budget counts against the circuit.
     """
     def dispatch(x: np.ndarray) -> np.ndarray:
         inject.fire("serve.forward", n_trials=len(x))
         return registry.infer(x)
 
     def infer_fn(x: np.ndarray) -> np.ndarray:
-        return resil_retry.call(lambda: dispatch(x), policy=SERVE_RETRY,
-                                site="serve.forward")
+        try:
+            out = resil_retry.call(lambda: dispatch(x), policy=SERVE_RETRY,
+                                   site="serve.forward")
+        except Exception:
+            if breaker is not None:
+                breaker.record_failure()
+            raise
+        if breaker is not None:
+            breaker.record_success()
+        return out
 
     return infer_fn
 
@@ -83,17 +120,33 @@ class ServeApp:
                  port: int = 0, buckets: tuple[int, ...] = DEFAULT_BUCKETS,
                  max_batch: int | None = None, max_wait_ms: float = 5.0,
                  max_queue_trials: int = 512,
-                 request_timeout_s: float = 30.0, journal=None):
+                 request_timeout_s: float = 30.0, journal=None,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 30.0,
+                 watchdog_thresholds: dict | None = None):
         self.journal = journal if journal is not None \
             else obs_journal.current()
         self.checkpoint = str(checkpoint)
         self.registry = ModelRegistry(tuple(buckets), journal=self.journal)
         self.registry.load(checkpoint)
+        # Liveness + failure-domain hardening: the worker's heartbeat (an
+        # in-process emitter, plus the EEGTPU_HEARTBEAT_FILE file when a
+        # supervisor configured one) feeds /healthz staleness; the
+        # breaker guards serve.forward so a persistently broken model/
+        # device answers fast 503s instead of queue-deep slow failures.
+        self.heartbeat = hb.Heartbeat(
+            os.environ.get(hb.HEARTBEAT_FILE_ENV) or None)
+        self.watchdog = hb.Watchdog(
+            dict(SERVE_WATCHDOG_THRESHOLDS, **(watchdog_thresholds or {})))
+        self.breaker = CircuitBreaker(
+            failure_threshold=breaker_threshold,
+            reset_after_s=breaker_reset_s, site="serve.forward",
+            journal=self.journal)
         self.batcher = MicroBatcher(
-            make_infer_fn(self.registry),
+            make_infer_fn(self.registry, self.breaker),
             max_batch=max_batch if max_batch is not None else buckets[-1],
             max_wait_ms=max_wait_ms, max_queue_trials=max_queue_trials,
-            journal=self.journal)
+            journal=self.journal, heartbeat=self.heartbeat)
         self.request_timeout_s = float(request_timeout_s)
         self._host, self._port = host, int(port)
         self._httpd: ThreadingHTTPServer | None = None
@@ -103,6 +156,8 @@ class ServeApp:
         self._n_requests = 0
         self._n_rejected = 0
         self._n_errors = 0
+        self._n_expired = 0
+        self._n_circuit_open = 0
         self._inflight = 0
         self._idle = threading.Condition(self._stats_lock)
         self._t_start = time.perf_counter()
@@ -171,14 +226,19 @@ class ServeApp:
                                handler_timeout_s)
             n_req, n_rej, n_err = (self._n_requests, self._n_rejected,
                                    self._n_errors)
+            n_exp, n_open = self._n_expired, self._n_circuit_open
         self.journal.event("serve_end", n_requests=n_req, rejected=n_rej,
-                           errors=n_err,
+                           errors=n_err, expired=n_exp,
+                           circuit_open=n_open,
+                           breaker_trips=self.breaker.trips,
                            wall_s=round(time.perf_counter() - self._t_start,
                                         3),
                            model_swaps=self.registry.swaps)
         logger.info("Serve drained and stopped: %d requests "
-                    "(%d rejected, %d errors), %d model swap(s)",
-                    n_req, n_rej, n_err, self.registry.swaps)
+                    "(%d rejected, %d errors, %d expired, %d refused by "
+                    "the open circuit), %d model swap(s), %d breaker "
+                    "trip(s)", n_req, n_rej, n_err, n_exp, n_open,
+                    self.registry.swaps, self.breaker.trips)
 
     # -- request accounting (called from handler threads) -----------------
     def begin_request(self) -> None:
@@ -197,6 +257,10 @@ class ServeApp:
             self._n_requests += 1
             if status == "rejected":
                 self._n_rejected += 1
+            elif status == "expired":
+                self._n_expired += 1
+            elif status == "circuit_open":
+                self._n_circuit_open += 1
             elif status != "ok":
                 self._n_errors += 1
         self.journal.event("request", n_trials=n_trials,
@@ -253,8 +317,27 @@ class _ServeHandler(BaseHTTPRequestHandler):
         if self.path == "/healthz":
             engine = app.registry.engine
             c, t = engine.geometry
-            self._reply(200, {
-                "status": "ok", "checkpoint": app.checkpoint,
+            # Liveness, not just reachability: an open breaker or a stale
+            # worker heartbeat degrades healthz to 503 so an external
+            # orchestrator (LB health checks, the supervisor) can pull
+            # this replica while it is alive-but-useless.
+            circuit = app.breaker.state
+            verdict = app.watchdog.check_beat(app.heartbeat.last())
+            degraded = []
+            if circuit == "open":
+                degraded.append("circuit_open")
+            if verdict.stale:
+                degraded.append("worker_heartbeat_stale")
+            self._reply(503 if degraded else 200, {
+                "status": "degraded" if degraded else "ok",
+                "degraded": degraded,
+                "circuit": circuit,
+                "worker_heartbeat": {
+                    "phase": verdict.phase,
+                    "age_s": round(verdict.age_s, 3),
+                    "threshold_s": verdict.threshold_s,
+                    "stale": verdict.stale},
+                "checkpoint": app.checkpoint,
                 "model_digest": engine.digest,
                 "geometry": {"n_channels": c, "n_times": t},
                 "buckets": list(engine.buckets),
@@ -283,42 +366,137 @@ class _ServeHandler(BaseHTTPRequestHandler):
         finally:
             app.end_request()
 
+    def _deadline_ms(self, payload_deadline) -> float | None:
+        """The request's deadline budget in ms: ``X-Deadline-Ms`` header
+        wins, else the JSON body's ``deadline_ms`` field."""
+        raw = self.headers.get("X-Deadline-Ms")
+        if raw is None:
+            raw = payload_deadline
+        if raw is None:
+            return None
+        ms = float(raw)
+        # NaN poisons every later comparison into False — the client
+        # would believe a deadline is enforced while none is; reject it
+        # (and inf, which is just "no deadline" misspelled) up front.
+        if not math.isfinite(ms) or ms <= 0:
+            raise ValueError(f"deadline must be a finite number of ms > 0, "
+                             f"got {ms}")
+        return ms
+
     def _predict(self, app: ServeApp) -> None:
         t0 = time.perf_counter()
-        try:
-            x = self._parse_trials(self._read_body())
-            if x.ndim == 2:
-                x = x[None]
-            c, t = app.registry.engine.geometry
-            if x.ndim != 3 or x.shape[1:] != (c, t):
-                raise ValueError(
-                    f"expected trials shaped (n, {c}, {t}), got "
-                    f"{tuple(x.shape)}")
-        except Exception as exc:  # noqa: BLE001 — client error
+        # Circuit gate FIRST: under an open breaker the request must not
+        # parse-validate, enqueue, or touch the forward — the whole point
+        # is a cheap fast-fail while the failure domain recovers.  allow()
+        # claims a probe slot when half-open; cancel it on any path where
+        # the forward never runs.
+        if not app.breaker.allow():
             app.record_request(0, (time.perf_counter() - t0) * 1000.0,
-                               "bad_request")
-            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                               "circuit_open")
+            self._reply(503, {
+                "error": "circuit open: serve.forward is failing; "
+                         "retry after the cooldown",
+                "circuit": app.breaker.state})
             return
+        probe_open = True  # an allow() we may still need to cancel
         try:
-            fut = app.batcher.submit(x)
-            preds = fut.result(timeout=app.request_timeout_s)
-        except Rejected as exc:
-            app.record_request(len(x), (time.perf_counter() - t0) * 1000.0,
-                               "rejected")
-            self._reply(429, {"error": str(exc)})
-            return
-        except Exception as exc:  # noqa: BLE001 — inference/timeout failure
-            app.record_request(len(x), (time.perf_counter() - t0) * 1000.0,
-                               "error")
-            self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
-            return
+            try:
+                body = self._read_body()
+                x = self._parse_trials(body)
+                deadline_ms = self._deadline_ms(self._payload_deadline(body))
+                if x.ndim == 2:
+                    x = x[None]
+                c, t = app.registry.engine.geometry
+                if x.ndim != 3 or x.shape[1:] != (c, t):
+                    raise ValueError(
+                        f"expected trials shaped (n, {c}, {t}), got "
+                        f"{tuple(x.shape)}")
+            except Exception as exc:  # noqa: BLE001 — client error
+                app.record_request(0, (time.perf_counter() - t0) * 1000.0,
+                                   "bad_request")
+                self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            deadline = (None if deadline_ms is None
+                        else time.monotonic() + deadline_ms / 1000.0)
+            try:
+                fut = app.batcher.submit(x, deadline=deadline)
+                # Once enqueued, probe reconciliation moves to the
+                # future's own resolution (not this handler): if the
+                # request is shed before any forward runs — expired at
+                # dequeue, failed by a non-drain shutdown — the breaker
+                # never sees an outcome, and without this callback a
+                # half-open probe slot would leak forever (this handler
+                # cannot do it: its result() wait can time out while the
+                # request is still queued).  Any other resolution means
+                # the worker's infer_fn already fed the breaker.
+                probe_open = False
+                fut.add_done_callback(self._reconcile_probe)
+                preds = fut.result(timeout=app.request_timeout_s)
+            except DeadlineExceeded as exc:
+                # Dropped at dequeue, before any forward ran.
+                app.record_request(len(x),
+                                   (time.perf_counter() - t0) * 1000.0,
+                                   "expired")
+                self._reply(504, {"error": str(exc),
+                                  "deadline_ms": deadline_ms})
+                return
+            except Rejected as exc:
+                app.record_request(len(x),
+                                   (time.perf_counter() - t0) * 1000.0,
+                                   "rejected")
+                self._reply(429, {"error": str(exc)})
+                return
+            except Exception as exc:  # noqa: BLE001 — inference/timeout
+                app.record_request(len(x),
+                                   (time.perf_counter() - t0) * 1000.0,
+                                   "error")
+                self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+        finally:
+            if probe_open:
+                app.breaker.cancel_probe()
         latency_ms = (time.perf_counter() - t0) * 1000.0
+        if deadline is not None and time.monotonic() > deadline:
+            # The forward ran but the answer arrived past the caller's
+            # budget: an expired response is a failure from the client's
+            # point of view, and saying so keeps the SLO accounting honest.
+            app.record_request(len(x), latency_ms, "expired")
+            self._reply(504, {"error": "response ready after the request "
+                                       "deadline expired",
+                              "deadline_ms": deadline_ms,
+                              "latency_ms": round(latency_ms, 3)})
+            return
         app.record_request(len(x), latency_ms, "ok")
         self._reply(200, {
             "predictions": [int(p) for p in preds],
             "class_names": list(CLASS_NAMES), "n": len(x),
             "latency_ms": round(latency_ms, 3),
             "model_digest": app.registry.engine.digest})
+
+    def _reconcile_probe(self, fut) -> None:
+        """Done-callback for submitted predict futures: release the
+        breaker's probe slot when the request was shed WITHOUT a forward
+        (expired at dequeue / shutdown-rejected) — those outcomes never
+        reach the breaker through ``infer_fn``."""
+        if fut.cancelled():
+            self.app.breaker.cancel_probe()
+            return
+        exc = fut.exception()
+        if isinstance(exc, (DeadlineExceeded, Rejected)):
+            self.app.breaker.cancel_probe()
+
+    def _payload_deadline(self, body: bytes):
+        """``deadline_ms`` from a JSON body (None for npz bodies — raw
+        trial uploads carry the deadline in the header)."""
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype != "application/json":
+            return None
+        try:
+            payload = json.loads(body.decode())
+        except (ValueError, UnicodeDecodeError):
+            return None  # _parse_trials already rejected it with a 400
+        return payload.get("deadline_ms") if isinstance(payload, dict) \
+            else None
 
     def _reload(self, app: ServeApp) -> None:
         try:
@@ -370,8 +548,21 @@ def main(argv=None) -> int:
     parser.add_argument("--maxQueue", type=int, default=512,
                         help="Queue bound in trials; beyond it requests "
                              "are rejected with 429.")
+    parser.add_argument("--breakerThreshold", type=int, default=5,
+                        help="Consecutive serve.forward failures that "
+                             "open the circuit breaker (fast 503s until "
+                             "a half-open probe succeeds).")
+    parser.add_argument("--breakerResetS", type=float, default=30.0,
+                        help="Open-circuit cooldown before half-open "
+                             "probe requests are admitted.")
     parser.add_argument("--metricsDir", type=str, default=None,
                         help="Run-journal root (default reports/obs).")
+    parser.add_argument("--resume", action="store_true",
+                        help="Accepted for supervisor compatibility "
+                             "(eegtpu-supervise appends it on relaunch): "
+                             "serving has no snapshot to resume — a "
+                             "relaunch simply serves the checkpoint "
+                             "again.")
     args = parser.parse_args(argv)
 
     try:
@@ -390,11 +581,17 @@ def main(argv=None) -> int:
             preempt.guard():
         app = ServeApp(args.checkpoint, host=args.host, port=args.port,
                        buckets=buckets, max_wait_ms=args.maxWaitMs,
-                       max_queue_trials=args.maxQueue, journal=journal)
+                       max_queue_trials=args.maxQueue,
+                       breaker_threshold=args.breakerThreshold,
+                       breaker_reset_s=args.breakerResetS, journal=journal)
         app.start()
         print(f"serving at {app.url}", flush=True)
         serve_until_preempted(app)
-    return 0
+    # A preempted (SIGTERM-drained) server exits EX_PREEMPTED, the same
+    # single-sourced code as a preempted training run: schedulers and the
+    # supervisor read it as "relaunch me", while a clean 0 means the
+    # service ended on purpose.
+    return preempt.EX_PREEMPTED if preempt.requested() else 0
 
 
 if __name__ == "__main__":

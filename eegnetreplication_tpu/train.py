@@ -115,7 +115,9 @@ def build_parser() -> argparse.ArgumentParser:
                              "('train.step:if_folds_over=4,host.preempt:"
                              "after=2') or @plan.json. Sites: fetch."
                              "download, data.read, train.step, checkpoint."
-                             "write, host.preempt, train.chunk (see "
+                             "write, host.preempt, train.chunk, train.hang"
+                             " (sleep=SECONDS silent stall for watchdog/"
+                             "supervisor drills), serve.hang (see "
                              "resil/inject.py). Every firing is journaled "
                              "as a fault_injected event.")
     return parser
@@ -227,11 +229,12 @@ def main() -> None:
             # Graceful stop: the snapshot already landed (Preempted is only
             # raised at the post-snapshot safe point), so close the journal
             # as preempted — run_end is once-only, the context manager's
-            # status="error" then no-ops — and exit EX_TEMPFAIL so
-            # schedulers know a rerun with --resume continues the run.
+            # status="error" then no-ops — and exit EX_PREEMPTED (75) so
+            # schedulers and the supervisor know a rerun with --resume
+            # continues the run.
             journal.run_end(status="preempted", error=str(exc))
             logger.warning("Preempted: %s", exc)
-            raise SystemExit(75) from exc
+            raise SystemExit(resil.EX_PREEMPTED) from exc
         logger.info("Epoch throughput: %.1f fold-epochs/s",
                     result.epoch_throughput)
         journal.metrics.set("epoch_throughput", result.epoch_throughput)

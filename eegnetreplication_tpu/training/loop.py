@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from eegnetreplication_tpu.resil import inject
+from eegnetreplication_tpu.resil import heartbeat, inject
 from eegnetreplication_tpu.training import steps as steps_lib
 from eegnetreplication_tpu.training.steps import TrainState
 
@@ -44,8 +44,18 @@ def _armed_dispatch(jitted, site: str = "train.step"):
     shaped error at exactly the point the fold-halving retry guards.
     ``n_folds`` (the stacked leading axis, mesh padding included) feeds the
     ``if_folds_over`` eligibility predicate.
+
+    Each dispatch also beats the liveness heartbeat: the FIRST dispatch of
+    a wrapper traces+compiles (minutes of legitimate silence), so it beats
+    phase ``compile`` and later dispatches beat ``step`` — the watchdog
+    budgets the two very differently (``resil/heartbeat.py``).
     """
+    first = [True]
+
     def dispatch(pool_x, pool_y, specs, carry_or_states, keys):
+        heartbeat.beat("compile" if first[0] else "step",
+                       n_folds=int(keys.shape[0]))
+        first[0] = False
         inject.fire(site, n_folds=int(keys.shape[0]))
         return jitted(pool_x, pool_y, specs, carry_or_states, keys)
 

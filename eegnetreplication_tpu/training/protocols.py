@@ -59,7 +59,7 @@ from eegnetreplication_tpu.training.loop import (
     make_multi_fold_trainer,
 )
 from eegnetreplication_tpu.obs import journal as obs_journal
-from eegnetreplication_tpu.resil import inject, preempt
+from eegnetreplication_tpu.resil import heartbeat, inject, preempt
 from eegnetreplication_tpu.resil import retry as resil_retry
 from eegnetreplication_tpu.training.steps import make_optimizer
 from eegnetreplication_tpu.utils.logging import logger
@@ -704,10 +704,18 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
         # the stop too (no resume seed, but a journaled graceful end
         # beats burning the grace window to be SIGKILLed mid-flight).
         preempt.check(chunk=chunk_no, epochs_done=hi, n_folds=n_folds)
+        # The chunk boundary is also the training loop's liveness beat:
+        # a run that stops reaching boundaries (stuck dispatch, wedged
+        # host) goes silent here and the watchdog/supervisor act on it.
+        heartbeat.beat("step", epochs_done=hi, n_folds=n_folds)
         chunk_no += 1
         # Legacy _crash_after_chunk shim + chaos plans: a plain (non-
         # device-fault) crash after a completed chunk, exercising resume.
         inject.fire("train.chunk", chunk=chunk_no, n_folds=n_folds)
+        # Chaos hang site (action="sleep"): a silent stall right after a
+        # completed chunk/snapshot — deterministically testable hang with
+        # a valid resume seed already on disk (the supervisor drill).
+        inject.fire("train.hang", chunk=chunk_no, n_folds=n_folds)
 
     _, best_state, best_acc, min_loss = carry
     evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size)

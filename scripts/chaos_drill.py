@@ -200,6 +200,105 @@ def leg_fetch_download(root: Path) -> None:
     assert sum(e["event"] == "retry" for e in _events(jr)) == 2
 
 
+def child_train(root: Path, *, epochs: int = 6, checkpoint_every: int = 2,
+                chaos: str | None = None, resume: bool = False,
+                subjects=(1,)) -> int:
+    """``--child-train``: the supervised-child entry point.
+
+    Runs the same tiny synthetic within-subject protocol as the in-process
+    legs, but shaped like ``train.py``: ``--chaos`` armed for THIS process,
+    ``preempt.guard()`` installed, ``Preempted`` → journaled preempted
+    ``run_end`` + exit ``EX_PREEMPTED``, success → ``<root>/result.json``
+    with the fold metrics.  The supervisor legs (and the out-of-process
+    resume regression test) launch this as a real child process so the
+    kill→resume→complete path crosses a genuine process boundary.
+    """
+    from eegnetreplication_tpu.resil import preempt as resil_preempt
+
+    _isolate_fold_batch_record(root)
+    specs = inject.parse_plan(chaos) if chaos else []
+    paths = Paths.from_root(root / "work")
+    with obs.run(root / "obs_child", epochs=epochs, resume=resume) as jr, \
+            resil_preempt.guard(), inject.scoped(*specs):
+        try:
+            result = within_subject_training(
+                epochs=epochs, config=CFG, loader=synthetic_loader,
+                subjects=tuple(subjects), paths=paths, seed=0,
+                save_models=False, checkpoint_every=checkpoint_every,
+                resume=resume)
+        except resil_preempt.Preempted as exc:
+            jr.run_end(status="preempted", error=str(exc))
+            return resil_preempt.EX_PREEMPTED
+    (root / "result.json").write_text(json.dumps({
+        "fold_test_acc": np.asarray(result.fold_test_acc).tolist(),
+        "avg_test_acc": float(result.avg_test_acc)}))
+    return 0
+
+
+def _supervise_child(root: Path, jr, *, chaos: str, thresholds: dict,
+                     grace_s: float = 5.0) -> tuple[int, dict]:
+    """Run the child-train entry under a real Supervisor; returns its exit
+    code and the parsed result.json."""
+    from eegnetreplication_tpu.resil import supervise
+
+    cmd = [sys.executable, str(Path(__file__).resolve()), "--child-train",
+           "--root", str(root), "--chaos", chaos]
+    policy = supervise.SupervisorPolicy(
+        grace_s=grace_s, poll_s=0.25, max_restarts=3,
+        restart_window_s=600.0, thresholds=thresholds)
+    sup = supervise.Supervisor(cmd, policy=policy,
+                               heartbeat_file=root / "heartbeat.json",
+                               journal=jr)
+    code = sup.run()
+    result = (json.loads((root / "result.json").read_text())
+              if (root / "result.json").exists() else {})
+    return code, result
+
+
+def leg_supervisor_hang(root: Path) -> None:
+    """The liveness acceptance drill: an injected silent stall
+    (``train.hang`` ``sleep=``) after the second chunk's snapshot; the
+    watchdog flags the stale step heartbeat, the supervisor escalates
+    SIGTERM→SIGKILL (the sleep survives SIGTERM by construction — PEP 475
+    resumes it after the graceful handler runs), relaunches with
+    ``--resume``, and the run completes with correct final metrics."""
+    leg_root = root / "supervisor_hang"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    baseline = _run_ws(Paths.from_root(root / "supervisor_hang_baseline"),
+                       checkpoint_every=2)
+    with obs.run(root / "obs" / "supervisor_hang") as jr:
+        # after=1,times=1: the stall fires after chunk 2 (snapshot at
+        # epoch 4 already on disk); the resumed run has only one chunk
+        # left, never reaches hit 2, and completes.
+        code, result = _supervise_child(
+            leg_root, jr, chaos="train.hang:after=1:times=1:sleep=300",
+            thresholds={"step": 3.0, "compile": 600.0, "startup": 600.0})
+    assert code == 0, f"supervisor exited {code}"
+    events = _events(jr)
+    kinds = _kinds(events)
+    assert {"supervisor_hang", "supervisor_restart",
+            "supervisor_exit"} <= kinds, kinds
+    hangs = [e for e in events if e["event"] == "supervisor_hang"]
+    assert hangs and hangs[0]["phase"] == "step", hangs
+    assert hangs[0]["age_s"] > hangs[0]["threshold_s"]
+    restarts = [e for e in events if e["event"] == "supervisor_restart"]
+    assert restarts and restarts[0]["reason"] == "hang"
+    assert restarts[0]["resume"] is True
+    exits = [e for e in events if e["event"] == "supervisor_exit"]
+    assert exits[-1]["classification"] == "completed", exits
+    ends = [e for e in events if e["event"] == "supervisor_end"]
+    assert ends and ends[-1]["status"] == "completed"
+    # The child's own journal closed its final run with run_end ok, and
+    # the supervised kill→resume path reproduced the uninterrupted
+    # metrics exactly.
+    child_runs = sorted((leg_root / "obs_child").iterdir())
+    last = schema.read_events(child_runs[-1] / "events.jsonl")
+    assert last[-1]["event"] == "run_end" and last[-1]["status"] == "ok"
+    np.testing.assert_array_equal(np.asarray(result["fold_test_acc"]),
+                                  baseline.fold_test_acc)
+
+
 def leg_combined(root: Path) -> None:
     """The acceptance drill: checkpoint.write corruption + train.step
     device fault + host.preempt on a 2-subject protocol; preempted mid-run,
@@ -256,6 +355,7 @@ LEGS = {
     "host.preempt": leg_host_preempt,
     "data.read": leg_data_read,
     "fetch.download": leg_fetch_download,
+    "supervisor.hang": leg_supervisor_hang,
     "combined": leg_combined,
 }
 
@@ -267,7 +367,28 @@ def main(argv=None) -> int:
     ap.add_argument("--legs", default=None,
                     help="Comma-separated leg names (default: all). "
                          f"Known: {', '.join(LEGS)}")
+    ap.add_argument("--child-train", action="store_true",
+                    help="Run as the supervised child (internal: used by "
+                         "the supervisor legs and tests).")
+    ap.add_argument("--chaos", default=None,
+                    help="child-train: chaos plan armed in the child.")
+    ap.add_argument("--epochs", type=int, default=6)
+    ap.add_argument("--checkpointEvery", type=int, default=2)
+    ap.add_argument("--subjects", default="1",
+                    help="child-train: comma-separated subject ids.")
+    ap.add_argument("--resume", action="store_true",
+                    help="child-train: resume from the run snapshot "
+                         "(appended by the supervisor on relaunch).")
     args = ap.parse_args(argv)
+
+    if args.child_train:
+        if not args.root:
+            ap.error("--child-train requires --root")
+        return child_train(
+            Path(args.root), epochs=args.epochs,
+            checkpoint_every=args.checkpointEvery, chaos=args.chaos,
+            resume=args.resume,
+            subjects=tuple(int(s) for s in args.subjects.split(",")))
 
     root = Path(args.root) if args.root else Path(tempfile.mkdtemp(
         prefix="eegtpu_chaos_"))

@@ -96,6 +96,11 @@ _COLUMNS = (
     # training rows show "-" here and vice versa.
     ("n_requests", "reqs"), ("latency_p95_ms", "p95_ms"),
     ("rejected", "rejected"), ("model_swaps", "swaps"),
+    # Supervision & liveness: supervisor restarts/hang detections (from
+    # supervisor_* events), expired-deadline drops and circuit-breaker
+    # trips (from request/circuit_state events).
+    ("supervisor_restarts", "restarts"), ("hang_detections", "hangs"),
+    ("expired", "expired"), ("breaker_trips", "trips"),
 )
 
 

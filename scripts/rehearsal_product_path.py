@@ -7,6 +7,10 @@ end-to-end instead, timing every stage and leaving the artifacts on disk:
   1. ``scripts/make_full_dataset.py``     full-size raw GDF tree + .mat
   2. ``python -m eegnetreplication_tpu.dataset --src kaggle``
   3. ``python -m eegnetreplication_tpu.data.verify``
+  3b. ``scripts/supervisor.py`` kill→resume drill: a short supervised
+     train with an injected ``train.hang`` stall; the watchdog detects
+     it, SIGTERM→SIGKILL escalates, relaunches with ``--resume``, and
+     the run completes (exit 0 is the assertion)
   4. ``python -m eegnetreplication_tpu.train --trainingType Within-Subject
      --epochs 500``  (all flags at reference defaults)
   5. ``python -m eegnetreplication_tpu.predict`` on subject 1's Eval set
@@ -93,6 +97,26 @@ def main(argv=None) -> int:
         "verify", [py, "-m", "eegnetreplication_tpu.data.verify",
                    "--subjects", subj_list],
         root, record, platform="cpu")
+    # Supervision drill (before train-ws, whose full run then overwrites
+    # this drill's 8-epoch models): a short supervised training run with
+    # an injected silent stall (train.hang sleep=600 after chunk 3); the
+    # supervisor's watchdog must flag the stale step heartbeat, escalate
+    # SIGTERM -> SIGKILL (the stall survives SIGTERM by design), relaunch
+    # with --resume, and the run must complete — the kill->resume->
+    # complete path proven end to end through the real CLIs.
+    ok = ok and run_stage(
+        "supervise-kill-resume",
+        [py, str(REPO / "scripts" / "supervisor.py"),
+         "--metricsDir", str(root / "reports" / "obs_supervisor"),
+         "--graceS", "20", "--pollS", "0.5",
+         "--hang", "step=60,startup=900,compile=1800",
+         "--maxRestarts", "3",
+         "--", py, "-m", "eegnetreplication_tpu.train",
+         "--trainingType", "Within-Subject", "--epochs", "8",
+         "--subjects", "1", "--checkpointEvery", "2",
+         "--generateReport", "False",
+         "--chaos", "train.hang:after=2:times=1:sleep=600"],
+        root, record, platform=args.platform, timeout=3600.0)
     train_cmd = [py, "-m", "eegnetreplication_tpu.train",
                  "--trainingType", "Within-Subject",
                  "--epochs", str(args.epochs),

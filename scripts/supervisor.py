@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Shim for ``eegtpu-supervise`` (``resil/supervise.py``) so the
+supervisor runs straight from a checkout without installing the package:
+
+    python scripts/supervisor.py --hang step=60 -- \\
+        python -m eegnetreplication_tpu.train --trainingType Within-Subject \\
+        --epochs 500 --checkpointEvery 50
+
+Launches the child command with a heartbeat file configured, watches it
+with per-phase staleness budgets, SIGTERM→SIGKILL-escalates hangs, maps
+exit codes to the restart policy (75/preempted → relaunch with --resume),
+and trips a crash-loop breaker instead of restarting forever.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from eegnetreplication_tpu.resil.supervise import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

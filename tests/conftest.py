@@ -29,11 +29,13 @@ import pytest  # noqa: E402
 
 @pytest.fixture(autouse=True)
 def _resil_state_isolated():
-    """The fault-injection registry and preemption flag are process-global;
-    a test that arms a site or requests a stop must never leak it into the
-    next test."""
+    """The fault-injection registry, preemption flag, and process-default
+    heartbeat emitter are process-global; a test that arms a site,
+    requests a stop, or configures a heartbeat file must never leak it
+    into the next test."""
     yield
-    from eegnetreplication_tpu.resil import inject, preempt
+    from eegnetreplication_tpu.resil import heartbeat, inject, preempt
 
     inject.disarm_all()
     preempt.clear()
+    heartbeat.reset_default()

@@ -9,8 +9,10 @@ exception, and the staged fetch mirror never leaving a half-mirrored tree.
 
 import json
 import os
+import random
 import subprocess
 import sys
+import time
 import types
 from pathlib import Path
 from unittest import mock
@@ -21,7 +23,15 @@ import pytest
 from eegnetreplication_tpu import obs
 from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
 from eegnetreplication_tpu.obs import schema
-from eegnetreplication_tpu.resil import inject, integrity, preempt, retry
+from eegnetreplication_tpu.resil import (
+    breaker,
+    heartbeat,
+    inject,
+    integrity,
+    preempt,
+    retry,
+    supervise,
+)
 from eegnetreplication_tpu.training import checkpoint as ckpt
 from eegnetreplication_tpu.training.protocols import within_subject_training
 from synthetic import make_loader
@@ -715,6 +725,392 @@ class TestFetchResilience:
         inject.arm("data.read", times=1)
         loaded = data_io.load_trials(p)
         assert loaded.X.shape == (4, 2, 8)
+
+
+class TestHeartbeat:
+    def test_beat_write_read_roundtrip(self, tmp_path):
+        hb = heartbeat.Heartbeat(tmp_path / "hb.json",
+                                 min_write_interval_s=0.0)
+        sent = hb.beat("step")
+        got = heartbeat.read(tmp_path / "hb.json")
+        assert got == sent
+        assert got.phase == "step" and got.pid == os.getpid()
+
+    def test_write_throttle_but_phase_change_writes(self, tmp_path):
+        hb = heartbeat.Heartbeat(tmp_path / "hb.json",
+                                 min_write_interval_s=60.0)
+        hb.beat("step")
+        hb.beat("step")  # throttled: same phase inside the interval
+        assert heartbeat.read(tmp_path / "hb.json").beat == 1
+        hb.beat("serve_forward")  # phase change must land immediately
+        assert heartbeat.read(tmp_path / "hb.json").phase == "serve_forward"
+
+    def test_unreadable_file_reads_as_none(self, tmp_path):
+        assert heartbeat.read(tmp_path / "missing.json") is None
+        (tmp_path / "torn.json").write_text('{"phase": "st')
+        assert heartbeat.read(tmp_path / "torn.json") is None
+
+    def test_journal_throttle(self, tmp_path):
+        with obs.run(tmp_path / "obs") as jr:
+            hb = heartbeat.Heartbeat(journal_every_s=3600.0)
+            for _ in range(5):
+                hb.beat("step")
+        events = schema.read_events(jr.events_path)
+        beats = [e for e in events if e["event"] == "heartbeat"]
+        assert len(beats) == 1  # first beat journaled, rest throttled
+        assert beats[0]["phase"] == "step"
+        assert not any("_schema_error" in e for e in events)
+
+    def test_default_emitter_configured_from_env(self, tmp_path,
+                                                 monkeypatch):
+        monkeypatch.setenv(heartbeat.HEARTBEAT_FILE_ENV,
+                           str(tmp_path / "env_hb.json"))
+        heartbeat.reset_default()
+        heartbeat.beat("fetch")
+        assert heartbeat.read(tmp_path / "env_hb.json").phase == "fetch"
+
+    def test_watchdog_per_phase_thresholds(self):
+        wd = heartbeat.Watchdog({"step": 0.1, "compile": 100.0})
+        old = heartbeat.Beat(phase="step", beat=1, t=time.time() - 1.0,
+                             pid=1)
+        assert wd.check_beat(old).stale
+        compiling = heartbeat.Beat(phase="compile", beat=1,
+                                   t=time.time() - 1.0, pid=1)
+        v = wd.check_beat(compiling)
+        assert not v.stale and v.threshold_s == 100.0
+
+    def test_watchdog_missing_beat_uses_startup_budget(self):
+        wd = heartbeat.Watchdog({"startup": 0.5})
+        assert not wd.check_beat(None).stale  # nothing to age against
+        v = wd.check_beat(None, since=time.time() - 1.0)
+        assert v.stale and v.phase == "startup"
+
+    def test_watchdog_pid_gate_ignores_foreign_beats(self, tmp_path):
+        hb = heartbeat.Heartbeat(tmp_path / "hb.json",
+                                 min_write_interval_s=0.0)
+        hb.beat("step")
+        wd = heartbeat.Watchdog({"startup": 0.1})
+        # A beat from another pid must not vouch for this child.
+        v = wd.check_file(tmp_path / "hb.json", pid=os.getpid() + 1,
+                          since=time.time() - 1.0)
+        assert v.stale and v.phase == "startup"
+        assert not wd.check_file(tmp_path / "hb.json",
+                                 pid=os.getpid()).stale
+
+
+class TestCircuitBreakerUnit:
+    def _clocked(self, **kw):
+        now = [0.0]
+        b = breaker.CircuitBreaker(clock=lambda: now[0], **kw)
+        return b, now
+
+    def test_opens_after_consecutive_failures_only(self):
+        b, _ = self._clocked(failure_threshold=3)
+        b.record_failure()
+        b.record_failure()
+        b.record_success()  # resets the consecutive count
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+        assert b.trips == 1
+
+    def test_half_open_probe_closes_or_reopens(self):
+        b, now = self._clocked(failure_threshold=1, reset_after_s=10.0)
+        b.record_failure()
+        assert b.state == "open"
+        now[0] = 11.0
+        assert b.state == "half_open"
+        assert b.allow()          # the probe slot
+        assert not b.allow()      # only one probe at a time
+        b.record_failure()        # probe failed: back to open
+        assert b.state == "open" and b.trips == 2
+        now[0] = 22.0
+        assert b.allow()
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_cancel_probe_releases_the_slot(self):
+        b, now = self._clocked(failure_threshold=1, reset_after_s=1.0)
+        b.record_failure()
+        now[0] = 2.0
+        assert b.allow()
+        b.cancel_probe()          # the probe never ran (e.g. 400 body)
+        assert b.allow()          # slot is free again
+
+    def test_transitions_journaled(self, tmp_path):
+        with obs.run(tmp_path / "obs") as jr:
+            b = breaker.CircuitBreaker(failure_threshold=1,
+                                       reset_after_s=0.0, journal=jr)
+            b.record_failure()
+            assert b.allow()      # open -> half_open (cooldown 0)
+            b.record_success()
+        events = schema.read_events(jr.events_path)
+        states = [e["state"] for e in events
+                  if e["event"] == "circuit_state"]
+        assert states == ["open", "half_open", "closed"]
+        assert not any("_schema_error" in e for e in events)
+
+
+class TestSeedableBackoff:
+    def test_seeded_rng_reproduces_exact_schedule(self):
+        mk = lambda: retry.RetryPolicy(base_delay_s=1.0, multiplier=2.0,
+                                       max_delay_s=60.0, jitter=0.25,
+                                       rng=random.Random(42))
+        a, b = mk(), mk()
+        sched_a = [a.delay(n) for n in range(1, 6)]
+        sched_b = [b.delay(n) for n in range(1, 6)]
+        assert sched_a == sched_b  # exact, not statistical
+        # Jitter is real: the schedule is not the bare exponential curve.
+        assert sched_a != [1.0, 2.0, 4.0, 8.0, 16.0]
+
+
+class TestSupervisor:
+    """Unit-level supervision with trivial (non-jax) children: fast tier-1
+    coverage of the watchdog/escalation/restart/crash-loop machinery (the
+    full training drill is the slow-marked ``supervisor.hang`` chaos
+    leg)."""
+
+    def _policy(self, **kw):
+        kw.setdefault("poll_s", 0.05)
+        kw.setdefault("grace_s", 1.0)
+        kw.setdefault("backoff", retry.RetryPolicy(
+            max_attempts=1_000_000, base_delay_s=0.0, jitter=0.0))
+        return supervise.SupervisorPolicy(**kw)
+
+    def _script(self, tmp_path, body: str) -> list:
+        p = tmp_path / "child.py"
+        p.write_text(body)
+        return [sys.executable, str(p)]
+
+    def test_preempted_exit_relaunches_with_resume(self, tmp_path):
+        cmd = self._script(tmp_path, (
+            "import sys\n"
+            "sys.exit(0 if '--resume' in sys.argv else 75)\n"))
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.Supervisor(cmd, policy=self._policy(),
+                                       journal=jr)
+            assert sup.run() == 0
+        assert sup.attempt == 2
+        events = schema.read_events(jr.events_path)
+        exits = [e for e in events if e["event"] == "supervisor_exit"]
+        assert [e["classification"] for e in exits] == ["preempted",
+                                                        "completed"]
+        assert exits[0]["exit_code"] == preempt.EX_PREEMPTED
+        restarts = [e for e in events if e["event"] == "supervisor_restart"]
+        assert restarts[0]["resume"] is True
+        assert restarts[0]["delay_s"] == 0.0  # preempted: no backoff
+        launches = [e for e in events if e["event"] == "supervisor_launch"]
+        assert "--resume" in launches[1]["cmd"]
+        assert not any("_schema_error" in e for e in events)
+
+    def test_hang_detected_term_escalation_and_relaunch(self, tmp_path):
+        # The child beats once, then blocks SIGTERM-proof (signal ignored)
+        # so the supervisor must escalate to SIGKILL.
+        cmd = self._script(tmp_path, (
+            "import json, os, signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "hb = os.environ['EEGTPU_HEARTBEAT_FILE']\n"
+            "tmp = hb + '.tmp'\n"
+            "open(tmp, 'w').write(json.dumps(\n"
+            "    {'phase': 'step', 'beat': 1, 't': time.time(),\n"
+            "     'pid': os.getpid()}))\n"
+            "os.replace(tmp, hb)\n"
+            "if '--resume' in sys.argv:\n"
+            "    sys.exit(0)\n"
+            "time.sleep(60)\n"))
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.Supervisor(
+                cmd, policy=self._policy(
+                    grace_s=0.4,
+                    thresholds={"step": 0.3, "startup": 20.0}),
+                heartbeat_file=tmp_path / "hb.json", journal=jr)
+            assert sup.run() == 0
+        events = schema.read_events(jr.events_path)
+        kinds = [e["event"] for e in events]
+        assert "supervisor_hang" in kinds
+        assert "supervisor_escalate" in kinds  # SIGTERM was not enough
+        hangs = [e for e in events if e["event"] == "supervisor_hang"]
+        assert hangs[0]["phase"] == "step"
+        assert hangs[0]["age_s"] > hangs[0]["threshold_s"]
+        exits = [e for e in events if e["event"] == "supervisor_exit"]
+        assert [e["classification"] for e in exits] == ["hang", "completed"]
+        ends = [e for e in events if e["event"] == "supervisor_end"]
+        assert ends[-1]["status"] == "completed"
+
+    def test_crash_loop_breaker_gives_up(self, tmp_path):
+        cmd = self._script(tmp_path, "import sys; sys.exit(1)\n")
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.Supervisor(
+                cmd, policy=self._policy(max_restarts=2,
+                                         restart_window_s=60.0),
+                journal=jr)
+            assert sup.run() == supervise.EX_CRASH_LOOP
+        assert sup.attempt == 3  # initial + 2 restarts, then the verdict
+        events = schema.read_events(jr.events_path)
+        giveup = [e for e in events if e["event"] == "supervisor_giveup"]
+        assert giveup and giveup[0]["restarts"] == 2
+        ends = [e for e in events if e["event"] == "supervisor_end"]
+        assert ends[-1]["status"] == "crash_loop"
+
+    def test_fatal_exit_never_restarts(self, tmp_path):
+        cmd = self._script(tmp_path, "import sys; sys.exit(2)\n")
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.Supervisor(cmd, policy=self._policy(),
+                                       journal=jr)
+            assert sup.run() == supervise.EX_FATAL
+        assert sup.attempt == 1
+
+    def test_transient_backoff_schedule_is_seeded_exact(self, tmp_path):
+        # The satellite contract: a seeded rng makes the restart schedule
+        # an exact assertion, not a sleep-through-jitter measurement.
+        mk_policy = lambda: retry.RetryPolicy(
+            max_attempts=1_000_000, base_delay_s=0.5, multiplier=2.0,
+            max_delay_s=60.0, jitter=0.25, rng=random.Random(7))
+        # Same seed, same DRAW SEQUENCE: delay(1) then delay(2) on one
+        # policy instance, exactly as the supervisor consumes it.
+        twin = mk_policy()
+        expected = [twin.delay(1), twin.delay(2)]
+        slept: list = []
+        cmd = self._script(tmp_path, "import sys; sys.exit(1)\n")
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.Supervisor(
+                cmd, policy=self._policy(max_restarts=2,
+                                         backoff=mk_policy()),
+                journal=jr, sleep=lambda s: slept.append(s))
+            sup.run()
+        events = schema.read_events(jr.events_path)
+        delays = [e["delay_s"] for e in events
+                  if e["event"] == "supervisor_restart"]
+        assert delays == [round(d, 3) for d in expected]
+        # The supervisor actually slept those exact delays (poll sleeps
+        # are poll_s-sized; the backoff sleeps are the large ones).
+        backoff_sleeps = [s for s in slept if s >= min(expected)]
+        assert backoff_sleeps == expected
+
+    def test_stop_request_forwards_and_ends_supervision(self, tmp_path):
+        cmd = self._script(tmp_path, (
+            "import signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, lambda *a: sys.exit(75))\n"
+            "time.sleep(60)\n"))
+        with obs.run(tmp_path / "obs") as jr:
+            # poll_s long enough that the child has installed its handler
+            # before the forwarded SIGTERM arrives.
+            sup = supervise.Supervisor(cmd, policy=self._policy(poll_s=0.5),
+                                       journal=jr)
+            preempt.request("test-stop")
+            code = sup.run()
+        assert code == preempt.EX_PREEMPTED  # the child's drain exit code
+        assert sup.attempt == 1  # no relaunch after our own stop
+        events = schema.read_events(jr.events_path)
+        ends = [e for e in events if e["event"] == "supervisor_end"]
+        assert ends[-1]["status"] == "stopped"
+
+
+class TestSupervisedResumeRegression:
+    """ISSUE 5 satellite: a supervisor-driven kill + ``--resume`` relaunch
+    reproduces the same final fold metrics as an uninterrupted run —
+    through a REAL process boundary (the in-process twin lives in
+    ``TestProtocolResilience.test_preempt_snapshots_and_resumes``)."""
+
+    def _child_cmd(self, root: Path, chaos: str | None = None) -> list:
+        cmd = [sys.executable, str(REPO / "scripts" / "chaos_drill.py"),
+               "--child-train", "--root", str(root), "--epochs", "4"]
+        if chaos:
+            cmd += ["--chaos", chaos]
+        return cmd
+
+    def test_out_of_process_kill_resume_matches_uninterrupted(
+            self, tmp_path):
+        env = dict(os.environ, EEGTPU_NO_LOG_FILE="1")
+        # Uninterrupted baseline through the SAME child entry point.
+        base_root = tmp_path / "baseline"
+        proc = subprocess.run(self._child_cmd(base_root), env=env,
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        baseline = json.loads((base_root / "result.json").read_text())
+
+        # Supervised run: the armed host.preempt stops the child at its
+        # first chunk boundary (exit EX_PREEMPTED, snapshot on disk); the
+        # plan re-arms in every relaunch, so each resumed child advances
+        # one chunk and is preempted again until only the eval remains —
+        # three launches, two --resume relaunches, every one driven by
+        # the supervisor's exit-code policy.
+        sup_root = tmp_path / "supervised"
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.Supervisor(
+                self._child_cmd(sup_root,
+                                chaos="host.preempt:after=0:times=1"),
+                policy=supervise.SupervisorPolicy(
+                    poll_s=0.1, grace_s=10.0,
+                    thresholds={"startup": 300.0, "compile": 300.0,
+                                "step": 120.0}),
+                heartbeat_file=sup_root / "heartbeat.json", journal=jr,
+                env=env)
+            assert sup.run() == 0
+        assert sup.attempt == 3
+        events = schema.read_events(jr.events_path)
+        exits = [e["classification"] for e in events
+                 if e["event"] == "supervisor_exit"]
+        assert exits == ["preempted", "preempted", "completed"]
+        result = json.loads((sup_root / "result.json").read_text())
+        np.testing.assert_array_equal(np.asarray(result["fold_test_acc"]),
+                                      np.asarray(baseline["fold_test_acc"]))
+        # The final resumed child's own journal closed cleanly.
+        child_runs = sorted((sup_root / "obs_child").iterdir())
+        assert len(child_runs) == 3
+        last = schema.read_events(child_runs[-1] / "events.jsonl")
+        assert last[-1]["event"] == "run_end"
+        assert last[-1]["status"] == "ok"
+
+
+class TestSupervisionEventSummary:
+    def _base(self, run_id="s1"):
+        return [{"event": "run_start", "t": 1.0, "run_id": run_id,
+                 "schema_version": 1, "git_sha": "abc", "platform": "cpu",
+                 "device_kind": "cpu", "n_devices": 1, "config": {}}]
+
+    def test_supervisor_fields(self):
+        ev = self._base() + [
+            {"event": "supervisor_start", "t": 2.0, "run_id": "s1",
+             "cmd": ["x"]},
+            {"event": "supervisor_hang", "t": 3.0, "run_id": "s1",
+             "attempt": 1, "age_s": 9.0, "threshold_s": 3.0,
+             "phase": "step"},
+            {"event": "supervisor_restart", "t": 4.0, "run_id": "s1",
+             "attempt": 1, "reason": "hang", "delay_s": 0.0,
+             "resume": True},
+            {"event": "supervisor_end", "t": 5.0, "run_id": "s1",
+             "status": "completed"},
+            {"event": "run_end", "t": 6.0, "run_id": "s1", "status": "ok",
+             "wall_s": 5.0}]
+        s = schema.event_summary(schema.validate_events(ev))
+        assert s["supervisor_restarts"] == 1
+        assert s["hang_detections"] == 1
+        assert s["supervisor_status"] == "completed"
+
+    def test_serving_expired_and_breaker_fields(self):
+        req = {"event": "request", "run_id": "s1", "n_trials": 1,
+               "latency_ms": 1.0}
+        ev = self._base() + [
+            dict(req, t=2.0, status="ok"),
+            dict(req, t=3.0, status="expired"),
+            dict(req, t=4.0, status="circuit_open"),
+            {"event": "circuit_state", "t": 5.0, "run_id": "s1",
+             "state": "open", "previous": "closed",
+             "reason": "failure_threshold"},
+            {"event": "circuit_state", "t": 6.0, "run_id": "s1",
+             "state": "half_open", "previous": "open",
+             "reason": "cooldown_elapsed"},
+            {"event": "run_end", "t": 7.0, "run_id": "s1", "status": "ok",
+             "wall_s": 6.0}]
+        s = schema.event_summary(schema.validate_events(ev))
+        assert s["n_requests"] == 3
+        assert s["expired"] == 1
+        assert s["circuit_refusals"] == 1
+        assert s["request_errors"] == 0  # shed load is not an error
+        assert s["breaker_trips"] == 1
 
 
 class TestObsReportCrashedRuns:

@@ -540,6 +540,286 @@ class TestServeForwardChaos:
             b.close()
 
 
+class TestDeadlines:
+    def test_expired_request_dropped_at_dequeue_before_forward(self):
+        from eegnetreplication_tpu.serve.batcher import DeadlineExceeded
+
+        release = threading.Event()
+        calls = []
+
+        def infer(x):
+            calls.append(len(x))
+            release.wait(10)
+            return np.zeros(len(x), np.int64)
+
+        b = MicroBatcher(infer, max_batch=4, max_wait_ms=0.0,
+                         max_queue_trials=16)
+        try:
+            first = b.submit(np.zeros((1, C, T), np.float32))
+            time.sleep(0.1)  # worker took the first batch, now blocked
+            expired = b.submit(np.zeros((1, C, T), np.float32),
+                               deadline=time.monotonic() - 0.001)
+            live = b.submit(np.zeros((1, C, T), np.float32),
+                            deadline=time.monotonic() + 60.0)
+            release.set()
+            with pytest.raises(DeadlineExceeded):
+                expired.result(timeout=10)
+            assert live.result(timeout=10).shape == (1,)
+            assert first.result(timeout=10).shape == (1,)
+            # The expired trial never reached a forward: only the first
+            # batch and the live request were dispatched.
+            assert sum(calls) == 2
+        finally:
+            release.set()
+            b.close()
+
+    def test_http_deadline_header_answers_504(self, serve_app, trials):
+        app, jr, _ = serve_app
+        req = urllib.request.Request(
+            app.url + "/predict",
+            data=json.dumps({"trials": trials[:1].tolist()}).encode(),
+            headers={"Content-Type": "application/json",
+                     "X-Deadline-Ms": "0.001"})
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(req, timeout=30)
+        assert err.value.code == 504
+        body = json.loads(err.value.read())
+        assert "deadline" in body["error"]
+
+    def test_json_deadline_field_within_budget_is_ok(self, serve_app,
+                                                    trials):
+        app, jr, _ = serve_app
+        resp = _post(app.url + "/predict",
+                     {"trials": trials[:1].tolist(),
+                      "deadline_ms": 60000.0})
+        assert len(resp["predictions"]) == 1
+
+    def test_bad_deadline_is_400(self, serve_app, trials):
+        app, jr, _ = serve_app
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post(app.url + "/predict",
+                  {"trials": trials[:1].tolist(), "deadline_ms": -5})
+        assert err.value.code == 400
+
+    def test_expired_requests_journaled_and_counted(self, tmp_path,
+                                                    trials):
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        ck = _checkpoint(tmp_path)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(ck, port=0, buckets=(1, 4), max_wait_ms=0.0,
+                           journal=jr).start()
+            try:
+                req = urllib.request.Request(
+                    app.url + "/predict",
+                    data=json.dumps(
+                        {"trials": trials[:1].tolist()}).encode(),
+                    headers={"Content-Type": "application/json",
+                             "X-Deadline-Ms": "0.001"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 504
+            finally:
+                app.stop()
+        events = obs_journal.schema.read_events(jr.events_path)
+        statuses = [e["status"] for e in events if e["event"] == "request"]
+        assert statuses == ["expired"]
+        end = [e for e in events if e["event"] == "serve_end"][0]
+        assert end["expired"] == 1 and end["errors"] == 0
+        summary = obs_journal.schema.event_summary(events)
+        assert summary["expired"] == 1
+        assert summary["request_errors"] == 0
+
+
+class TestCircuitBreakerServing:
+    def _app(self, tmp_path, jr, **kw):
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        return ServeApp(_checkpoint(tmp_path), port=0, buckets=(1, 4),
+                        max_wait_ms=0.0, journal=jr, **kw).start()
+
+    def _get(self, url):
+        try:
+            resp = urllib.request.urlopen(url, timeout=10)
+            return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    def _predict(self, app, x):
+        try:
+            return 200, _post(app.url + "/predict",
+                              {"trials": x.tolist()}, timeout=30)
+        except urllib.error.HTTPError as err:
+            return err.code, json.loads(err.read())
+
+    def test_open_circuit_503s_without_forward_then_recovers(self, tmp_path,
+                                                             trials):
+        """ISSUE 5 acceptance: an open circuit answers /predict and
+        /healthz with 503 without invoking the forward, and half-open
+        probes close it again with zero dropped in-flight requests."""
+        from eegnetreplication_tpu.resil import inject
+
+        x = trials[:1]
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = self._app(tmp_path, jr, breaker_threshold=2,
+                            breaker_reset_s=0.4)
+            try:
+                # Fatal-classified injected faults: no retry, each request
+                # is one failed dispatch; two of them open the breaker.
+                inject.arm("serve.forward", times=2, exc="ValueError",
+                           message="fatal by classification")
+                for _ in range(2):
+                    code, _body = self._predict(app, x)
+                    assert code == 500
+                assert app.breaker.state == "open"
+                # Count forwards while the circuit is open: none may run.
+                calls = []
+                real_infer = app.registry.infer
+                app.registry.infer = lambda t: (calls.append(len(t)),
+                                                real_infer(t))[-1]
+                code, body = self._predict(app, x)
+                assert code == 503
+                assert body["circuit"] == "open"
+                code, health = self._get(app.url + "/healthz")
+                assert code == 503
+                assert health["status"] == "degraded"
+                assert "circuit_open" in health["degraded"]
+                assert calls == []  # fast-fail: the forward never ran
+                # Cooldown -> half-open probe -> success closes it.
+                time.sleep(0.45)
+                code, body = self._predict(app, x)
+                assert code == 200 and len(body["predictions"]) == 1
+                assert app.breaker.state == "closed"
+                code, health = self._get(app.url + "/healthz")
+                assert code == 200 and health["status"] == "ok"
+                assert health["circuit"] == "closed"
+            finally:
+                app.stop()
+        events = obs_journal.schema.read_events(jr.events_path)
+        states = [e["state"] for e in events
+                  if e["event"] == "circuit_state"]
+        assert states == ["open", "half_open", "closed"]
+        end = [e for e in events if e["event"] == "serve_end"][0]
+        assert end["circuit_open"] == 1 and end["breaker_trips"] == 1
+        summary = obs_journal.schema.event_summary(events)
+        assert summary["breaker_trips"] == 1
+        assert summary["circuit_refusals"] == 1
+
+    def test_expired_half_open_probe_releases_its_slot(self, tmp_path,
+                                                       trials):
+        """A probe request shed at dequeue (deadline expired) never
+        reaches the forward, so the breaker sees no outcome — the probe
+        slot must be released anyway or half-open wedges shut forever."""
+        from eegnetreplication_tpu.resil import inject
+
+        x = trials[:1]
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = self._app(tmp_path, jr, breaker_threshold=1,
+                            breaker_reset_s=0.2)
+            try:
+                inject.arm("serve.forward", times=1, exc="ValueError",
+                           message="fatal by classification")
+                assert self._predict(app, x)[0] == 500  # opens
+                time.sleep(0.25)  # cooldown: half-open on next allow()
+                req = urllib.request.Request(
+                    app.url + "/predict",
+                    data=json.dumps({"trials": x.tolist()}).encode(),
+                    headers={"Content-Type": "application/json",
+                             "X-Deadline-Ms": "0.001"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 504  # probe shed at dequeue
+                # The slot came back: the next probe runs and closes it.
+                code, _body = self._predict(app, x)
+                assert code == 200
+                assert app.breaker.state == "closed"
+            finally:
+                app.stop()
+
+    def test_half_open_probe_failure_reopens(self, tmp_path, trials):
+        from eegnetreplication_tpu.resil import inject
+
+        x = trials[:1]
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = self._app(tmp_path, jr, breaker_threshold=1,
+                            breaker_reset_s=0.2)
+            try:
+                inject.arm("serve.forward", times=2, exc="ValueError",
+                           message="fatal by classification")
+                assert self._predict(app, x)[0] == 500  # opens
+                assert app.breaker.state == "open"
+                time.sleep(0.25)
+                assert self._predict(app, x)[0] == 500  # probe fails
+                assert app.breaker.state == "open"      # re-opened
+                time.sleep(0.25)
+                assert self._predict(app, x)[0] == 200  # probe succeeds
+                assert app.breaker.state == "closed"
+            finally:
+                app.stop()
+
+
+class TestHealthzLiveness:
+    def test_healthz_reports_worker_heartbeat_fields(self, serve_app):
+        app, jr, _ = serve_app
+        health = json.loads(urllib.request.urlopen(
+            app.url + "/healthz", timeout=10).read())
+        assert health["status"] == "ok" and health["degraded"] == []
+        assert health["circuit"] == "closed"
+        hb = health["worker_heartbeat"]
+        assert hb["stale"] is False
+        assert hb["phase"] in ("serve_idle", "serve_forward")
+        assert hb["age_s"] >= 0.0 and hb["threshold_s"] > 0.0
+
+    def test_healthz_degrades_while_worker_hangs(self, tmp_path, trials):
+        from eegnetreplication_tpu.resil import inject
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        ck = _checkpoint(tmp_path)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(ck, port=0, buckets=(1, 4), max_wait_ms=0.0,
+                           journal=jr,
+                           watchdog_thresholds={"serve_forward": 0.2,
+                                                "serve_idle": 10.0}
+                           ).start()
+            try:
+                inject.arm("serve.hang", times=1, sleep=1.5)
+                poster = threading.Thread(
+                    target=lambda: _post(app.url + "/predict",
+                                         {"trials": trials[:1].tolist()},
+                                         timeout=30))
+                poster.start()
+                time.sleep(0.8)  # worker is asleep inside the dispatch
+                try:
+                    urllib.request.urlopen(app.url + "/healthz", timeout=10)
+                    raise AssertionError("healthz did not degrade")
+                except urllib.error.HTTPError as err:
+                    assert err.code == 503
+                    health = json.loads(err.read())
+                assert "worker_heartbeat_stale" in health["degraded"]
+                assert health["worker_heartbeat"]["phase"] \
+                    == "serve_forward"
+                poster.join(timeout=30)
+                # Worker recovered: beats resumed, healthz back to 200.
+                health = json.loads(urllib.request.urlopen(
+                    app.url + "/healthz", timeout=10).read())
+                assert health["status"] == "ok"
+            finally:
+                app.stop()
+
+    def test_metrics_body_counts_requests(self, serve_app, trials):
+        app, jr, _ = serve_app
+        for i in range(2):
+            _post(app.url + "/predict", {"trials": trials[i:i + 1].tolist()})
+        metrics = json.loads(urllib.request.urlopen(
+            app.url + "/metrics", timeout=10).read())
+        obs_journal.schema.validate_metrics(metrics)
+        ok = [s for s in metrics["counters"]["requests_total"]
+              if s["labels"].get("status") == "ok"]
+        assert ok and ok[0]["value"] >= 2
+        lat = metrics["histograms"]["request_latency_ms"][0]
+        assert lat["count"] >= 2 and lat["min"] > 0.0
+
+
 class TestPredictCLIIntegration:
     def test_predict_trials_routes_through_engine_buckets(self, trials):
         """The CLI path and a server engine agree exactly (shared code)."""
