@@ -41,6 +41,10 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
                     "real_train_samples", "padded_train_slots"),
     "compile_begin": ("what",),
     "compile_end": ("what", "elapsed_s"),
+    # Persistent-compilation-cache accounting: one per compiled program
+    # (serve engine warmup buckets, training first dispatch).  cache_hit is
+    # True/False when EEGTPU_COMPILE_CACHE is enabled, None when it is not.
+    "compile": ("what", "cache_hit"),
     "fold_group": ("group", "fold_lo", "fold_hi"),
     "epoch": ("epoch", "total_epochs", "train_loss", "val_loss", "val_acc",
               "grad_norm", "n_folds"),
@@ -71,6 +75,15 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "supervisor_restart": ("attempt", "reason", "delay_s", "resume"),
     "supervisor_giveup": ("restarts", "window_s"),
     "supervisor_end": ("status",),
+    # Fleet serving (serve/fleet/): every membership, dispatch-failover,
+    # and rolling-canary decision the router makes is one of these.
+    "fleet_start": ("replicas", "checkpoint"),
+    "fleet_member": ("replica", "state", "previous", "reason"),
+    "fleet_retry": ("replica", "reason"),
+    "fleet_canary": ("phase",),
+    "fleet_shadow": ("replica", "reference", "n_trials", "agree"),
+    "fleet_reload": ("status", "checkpoint"),
+    "fleet_end": ("n_requests", "wall_s"),
     "run_end": ("status", "wall_s"),
 }
 
@@ -318,6 +331,38 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
             out["supervisor_status"] = "crash_loop"
     if trips:
         out["breaker_trips"] = len(trips)
+    # Fleet serving: membership churn, dispatch failovers, and the rolling
+    # canary's outcome — only reported for fleet streams so single-process
+    # serving rows stay compact.
+    fleet_starts = [e for e in events if e["event"] == "fleet_start"]
+    if fleet_starts or any(e["event"] in ("fleet_member", "fleet_reload")
+                           for e in events):
+        if fleet_starts:
+            out["fleet_replicas"] = len(fleet_starts[-1].get("replicas", []))
+        members = [e for e in events if e["event"] == "fleet_member"]
+        out["fleet_member_transitions"] = len(members)
+        out["fleet_rejoins"] = sum(1 for e in members
+                                   if e.get("reason") == "rejoined")
+        out["fleet_failovers"] = sum(1 for e in events
+                                     if e["event"] == "fleet_retry")
+        reloads = [e for e in events if e["event"] == "fleet_reload"]
+        if reloads:
+            out["fleet_reloads"] = len(reloads)
+            out["fleet_reload_status"] = reloads[-1].get("status")
+        shadows = [e for e in events if e["event"] == "fleet_shadow"]
+        if shadows:
+            agree = [e["agree"] for e in shadows
+                     if isinstance(e.get("agree"), numbers.Real)]
+            if agree:
+                out["fleet_shadow_agree"] = round(
+                    sum(agree) / len(agree), 4)
+    cache_events = [e for e in events if e["event"] == "compile"
+                    and e.get("cache_hit") is not None]
+    if cache_events:
+        out["compile_cache_hits"] = sum(1 for e in cache_events
+                                        if e["cache_hit"])
+        out["compile_cache_misses"] = sum(1 for e in cache_events
+                                          if not e["cache_hit"])
     out["compile_s"] = round(sum(e.get("elapsed_s", 0.0) for e in compiles), 2)
     if epochs:
         last = epochs[-1]

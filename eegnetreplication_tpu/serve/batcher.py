@@ -114,6 +114,22 @@ class MicroBatcher:
         with self._cv:
             return self._pending_trials
 
+    @property
+    def queue_depth_requests(self) -> int:
+        """Requests currently enqueued (not yet handed to the worker) —
+        the fleet router's least-loaded dispatch signal."""
+        with self._cv:
+            return len(self._pending)
+
+    def _gauge_depth_locked(self) -> None:
+        """Publish both queue-depth gauges (``self._cv`` held).  Every
+        transition (submit, coalesce, expiry drop, non-drain close) lands
+        here so ``/metrics`` always shows the LIVE depth, not just the
+        per-batch ``bucket_fill`` occupancy."""
+        self._journal.metrics.set("queue_depth_trials", self._pending_trials)
+        self._journal.metrics.set("queue_depth_requests",
+                                  len(self._pending))
+
     def submit(self, trials: np.ndarray,
                deadline: float | None = None) -> Future:
         """Enqueue ``(n, C, T)`` trials; the future resolves to their
@@ -141,8 +157,7 @@ class MicroBatcher:
                     f"limit {self.max_queue_trials})")
             self._pending.append((x, fut, time.perf_counter(), deadline))
             self._pending_trials += n
-            self._journal.metrics.set("queue_depth_trials",
-                                      self._pending_trials)
+            self._gauge_depth_locked()
             self._cv.notify_all()
         return fut
 
@@ -156,6 +171,7 @@ class MicroBatcher:
                     _, fut, _, _ = self._pending.popleft()
                     fut.set_exception(Rejected("serving is shutting down"))
                 self._pending_trials = 0
+                self._gauge_depth_locked()
             self._cv.notify_all()
         if self._worker is not threading.current_thread():
             self._worker.join(timeout)
@@ -226,8 +242,7 @@ class MicroBatcher:
             batch.append((x, fut, t_enq))
             n += req_n
         self._pending_trials -= n
-        self._journal.metrics.set("queue_depth_trials",
-                                  self._pending_trials)
+        self._gauge_depth_locked()
         return batch
 
     def _run(self) -> None:

@@ -185,27 +185,56 @@ class InferenceEngine:
         run's startup cost is part of its telemetry record.  Idempotent —
         a hot-reload that warms the incoming engine off to the side costs
         the compiles once, before the atomic swap.
+
+        When ``EEGTPU_COMPILE_CACHE`` names a directory, the JAX persistent
+        compilation cache is enabled first (explicit opt-in, any backend):
+        fleet replica restarts and scale-out then replay these executables
+        instead of recompiling them.  Each bucket additionally journals a
+        ``compile`` event with ``cache_hit`` — a warmup that wrote no new
+        cache entry replayed one — so a run's telemetry says whether its
+        startup paid the compiles or the cache did.
         """
         import jax
+
+        from eegnetreplication_tpu.utils.platform import (
+            compile_cache_hit,
+            compile_cache_probe,
+            enable_compilation_cache,
+        )
 
         c, t = self.geometry
         walls: dict[int, float] = {}
         with self._lock:
             if self._warmed:
                 return walls
+            # Enable AFTER the idempotence gate: a re-warm of an
+            # already-warm engine stays a pure no-op (no global jax
+            # config mutation when no compile will happen).
+            cache_dir = enable_compilation_cache(explicit_only=True)
             for b in self.buckets:
                 what = f"serve_forward_b{b}"
                 self._journal.event("compile_begin", what=what)
+                probe = compile_cache_probe(cache_dir)
                 t0 = time.perf_counter()
                 jax.block_until_ready(
                     self._fwd(self._jnp.zeros((b, c, t), self._jnp.float32)))
                 wall = time.perf_counter() - t0
                 walls[b] = wall
+                cache_hit = compile_cache_hit(cache_dir, probe)
+                self._journal.event("compile", what=what,
+                                    cache_hit=cache_hit,
+                                    cache_dir=cache_dir,
+                                    elapsed_s=round(wall, 3))
                 self._journal.event("compile_end", what=what,
                                     elapsed_s=round(wall, 3),
-                                    includes_execution=True)
+                                    includes_execution=True,
+                                    cache_hit=cache_hit)
                 self._journal.metrics.observe("compile_seconds", wall,
                                               what=what)
+                if cache_dir is not None:
+                    self._journal.metrics.inc(
+                        "compile_cache",
+                        outcome="hit" if cache_hit else "miss")
             self._warmed = True
         logger.info("Engine warm: buckets %s compiled in %.2fs total (%s)",
                     self.buckets, sum(walls.values()), self.digest[:12])

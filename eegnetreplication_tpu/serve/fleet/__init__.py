@@ -1,0 +1,45 @@
+"""Fleet serving: N shared-nothing replicas behind one router.
+
+One serving process caps ``/predict`` throughput at a single device and
+makes every hot-reload a fleet-wide event.  This package turns the
+single-process serving stack (``serve/engine.py`` + ``MicroBatcher`` +
+``serve/service.py``) into a cluster:
+
+- :mod:`~eegnetreplication_tpu.serve.fleet.membership` — health-gated
+  replica membership: ``/healthz`` + heartbeat-file polling drains
+  degraded/stale replicas out of rotation and rejoins restarted ones
+  automatically;
+- :mod:`~eegnetreplication_tpu.serve.fleet.router` — least-loaded
+  dispatch over live queue depth, one circuit breaker per replica,
+  connection-failure failover (an idempotent inference is simply retried
+  on a sibling);
+- :mod:`~eegnetreplication_tpu.serve.fleet.canary` — rolling canary
+  hot-reload: swap ONE replica, shadow-compare its outputs against an
+  old-digest replica on captured live traffic, then roll the remainder —
+  the single-process zero-drop reload contract extended to the cluster;
+- :mod:`~eegnetreplication_tpu.serve.fleet.service` — the router HTTP
+  process plus the replica-spawning wiring through
+  :class:`~eegnetreplication_tpu.resil.supervise.MultiSupervisor`.
+
+Every membership/dispatch/canary decision is journaled as a ``fleet_*``
+event (``obs/schema.py``).
+"""
+
+from eegnetreplication_tpu.serve.fleet.canary import RollingReload
+from eegnetreplication_tpu.serve.fleet.membership import (
+    FleetMembership,
+    Replica,
+    ReplicaClient,
+)
+from eegnetreplication_tpu.serve.fleet.router import FleetRouter, NoLiveReplicas
+from eegnetreplication_tpu.serve.fleet.service import FleetApp
+
+__all__ = [
+    "FleetApp",
+    "FleetMembership",
+    "FleetRouter",
+    "NoLiveReplicas",
+    "Replica",
+    "ReplicaClient",
+    "RollingReload",
+]

@@ -1,0 +1,6 @@
+"""``python -m eegnetreplication_tpu.serve.fleet`` — the fleet endpoint."""
+
+from eegnetreplication_tpu.serve.fleet.service import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,184 @@
+"""Least-loaded dispatch over live replicas, with per-replica breakers.
+
+The router turns N independent serving processes into one endpoint.  For
+each request it picks the live replica with the lowest load (its own
+in-flight count to that replica plus the queue depth the membership poll
+last read from ``/healthz``), dispatches over a pooled keep-alive
+connection, and feeds the outcome to that replica's
+:class:`~eegnetreplication_tpu.resil.breaker.CircuitBreaker`.
+
+Failure semantics are what make a fleet more available than its members:
+
+- **Transport failure** (connection refused/reset — the replica process
+  died mid-request): the replica is pulled from membership immediately
+  and the request is retried on a sibling.  Inference is pure, so the
+  retry is safe; a kill-one-replica-under-load run completes with zero
+  failed requests.
+- **HTTP 5xx** from a replica counts against its breaker and fails over
+  to a sibling; only when every live replica has failed does the client
+  see the error.
+- **HTTP 429** (replica queue full) is backpressure, not a fault: it
+  does not trip the breaker, and the client gets 429 only when every
+  live replica is saturated.
+- **Open breaker** replicas are skipped during selection; half-open
+  probe slots are claimed on the chosen replica only, immediately before
+  its dispatch, so slots never leak.
+
+Every failover is journaled as a ``fleet_retry`` event.  Dispatched
+request bodies are kept in a small ring buffer — the rolling-canary
+shadow compare replays exactly this captured live traffic.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from collections import deque
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.serve.fleet import membership as ms
+from eegnetreplication_tpu.utils.logging import logger
+
+
+class NoLiveReplicas(RuntimeError):
+    """No live replica could accept the request (the 503-shaped fleet
+    signal — every member is out, draining, or breaker-open)."""
+
+
+class AllReplicasBusy(RuntimeError):
+    """Every live replica answered backpressure (the 429-shaped signal)."""
+
+
+# Transport errors that mean "this process is gone", not "it is slow":
+# these pull the replica from membership immediately instead of waiting
+# for the health poller's consecutive-failure threshold.
+_DEAD_CONNECTION = (ConnectionRefusedError, ConnectionResetError,
+                    BrokenPipeError, http.client.BadStatusLine,
+                    http.client.RemoteDisconnected)
+
+
+class FleetRouter:
+    """Dispatch requests across a :class:`~eegnetreplication_tpu.serve.fleet.membership.FleetMembership`."""
+
+    def __init__(self, membership: ms.FleetMembership, *,
+                 predict_timeout_s: float = 60.0, journal=None,
+                 ring_size: int = 128):
+        self.membership = membership
+        self.predict_timeout_s = float(predict_timeout_s)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        # Captured live traffic for the canary shadow compare: (body,
+        # content_type) of recently dispatched requests.
+        self._ring: deque[tuple[bytes, str]] = deque(maxlen=ring_size)
+        self._ring_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self.n_dispatched = 0
+        self.n_failovers = 0
+
+    # -- shadow-traffic capture -------------------------------------------
+    def recent_bodies(self, n: int) -> list[tuple[bytes, str]]:
+        """Up to ``n`` most recently dispatched (body, content_type) pairs
+        (newest first) — the canary's shadow-compare sample."""
+        with self._ring_lock:
+            items = list(self._ring)
+        return items[::-1][:n]
+
+    # -- dispatch ----------------------------------------------------------
+    def _pick(self, tried: set[str]) -> ms.Replica | None:
+        """Least-loaded live replica not yet tried, with a non-open
+        breaker.  Claims the breaker's admission (and half-open probe
+        slot) on the CHOSEN replica only."""
+        while True:
+            candidates = [r for r in self.membership.dispatchable()
+                          if r.replica_id not in tried
+                          and r.breaker.state != "open"]
+            if not candidates:
+                return None
+            replica = min(candidates, key=lambda r: r.load)
+            if replica.breaker.allow():
+                return replica
+            tried.add(replica.replica_id)  # open/probe-exhausted: skip
+
+    def dispatch(self, body: bytes, content_type: str = "application/json",
+                 headers: dict | None = None) -> tuple[int, bytes, str]:
+        """Route one ``/predict`` body; returns ``(status, body,
+        replica_id)``.  Raises :class:`NoLiveReplicas` /
+        :class:`AllReplicasBusy` when the fleet cannot take it."""
+        send_headers = dict(headers or {})
+        send_headers["Content-Type"] = content_type
+        with self._ring_lock:
+            self._ring.append((body, content_type))
+        with self._stats_lock:
+            self.n_dispatched += 1
+        tried: set[str] = set()
+        last_busy: tuple[int, bytes, str] | None = None
+        last_error: tuple[int, bytes, str] | None = None
+        while True:
+            replica = self._pick(tried)
+            if replica is None:
+                if last_busy is not None:
+                    raise AllReplicasBusy(
+                        "every live replica answered backpressure")
+                if last_error is not None:
+                    return last_error  # every live replica failed: honest 5xx
+                raise NoLiveReplicas("no live replicas in the fleet")
+            tried.add(replica.replica_id)
+            replica.begin()
+            try:
+                status, data = replica.client.request(
+                    "POST", "/predict", body=body, headers=send_headers,
+                    timeout_s=self.predict_timeout_s)
+            except (OSError, http.client.HTTPException) as exc:
+                replica.breaker.record_failure()
+                if isinstance(exc, _DEAD_CONNECTION):
+                    self.membership.mark_unreachable(
+                        replica, f"dispatch: {type(exc).__name__}")
+                self._failover(replica, f"{type(exc).__name__}: {exc}")
+                continue
+            finally:
+                replica.done()
+            if status == 429:
+                # Backpressure is not a fault: release any half-open probe
+                # slot allow() claimed (no outcome will be recorded) and
+                # try a sibling.
+                replica.breaker.cancel_probe()
+                last_busy = (status, data, replica.replica_id)
+                continue
+            if status >= 500:
+                replica.breaker.record_failure()
+                last_error = (status, data, replica.replica_id)
+                self._failover(replica, f"http {status}")
+                continue
+            replica.breaker.record_success()
+            return status, data, replica.replica_id
+
+    def dispatch_to(self, replica: ms.Replica, body: bytes,
+                    content_type: str = "application/json",
+                    timeout_s: float | None = None) -> tuple[int, bytes]:
+        """Direct dispatch to ONE replica (no failover, no breaker) — the
+        canary shadow compare uses this to ask a specific member."""
+        return replica.client.request(
+            "POST", "/predict", body=body,
+            headers={"Content-Type": content_type},
+            timeout_s=timeout_s if timeout_s is not None
+            else self.predict_timeout_s)
+
+    def _failover(self, replica: ms.Replica, reason: str) -> None:
+        with self._stats_lock:
+            self.n_failovers += 1
+        self._journal.event("fleet_retry", replica=replica.replica_id,
+                            reason=reason[:200])
+        self._journal.metrics.inc("fleet_failovers")
+        logger.warning("Fleet dispatch failover off %s: %s",
+                       replica.replica_id, reason)
+
+    # -- maintenance -------------------------------------------------------
+    def wait_idle(self, timeout_s: float = 30.0) -> bool:
+        """Block until no dispatches are in flight (drain helper)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if all(r.inflight == 0 for r in self.membership.replicas):
+                return True
+            time.sleep(0.02)
+        return False

@@ -1,0 +1,464 @@
+"""Fleet service wiring: router HTTP process + supervised replica fleet.
+
+``python -m eegnetreplication_tpu.serve.fleet --checkpoint m.npz
+--replicas 4`` spawns N single-process serving replicas (each its own
+``python -m eegnetreplication_tpu.serve`` child with a private port and
+heartbeat file) under a
+:class:`~eegnetreplication_tpu.resil.supervise.MultiSupervisor` — a
+crashed replica is relaunched and rejoins membership automatically — and
+binds the router endpoint in front of them:
+
+- ``POST /predict`` — least-loaded dispatch with failover (see
+  :mod:`~eegnetreplication_tpu.serve.fleet.router`); the replica's
+  response passes through unchanged, plus a ``routed_to`` field is NOT
+  injected (bytes pass through verbatim — the replica already reports
+  which digest answered).
+- ``POST /reload`` — rolling canary reload of the whole fleet
+  (:mod:`~eegnetreplication_tpu.serve.fleet.canary`); synchronous, one
+  at a time (a concurrent reload answers 409).
+- ``GET /healthz`` — fleet membership snapshot: per-replica state,
+  digest, queue depth, circuit state; 503 when no replica is live.
+- ``GET /metrics`` — the router run's metrics-registry snapshot.
+
+The router process journals every membership/dispatch/canary decision as
+``fleet_*`` events into its own obs run; each replica keeps its own
+single-process serving journal.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import sys
+import threading
+import time
+from http.server import ThreadingHTTPServer
+from pathlib import Path
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import preempt, supervise
+from eegnetreplication_tpu.serve.service import JsonRequestHandler
+from eegnetreplication_tpu.serve.fleet import membership as ms
+from eegnetreplication_tpu.serve.fleet.canary import RollingReload
+from eegnetreplication_tpu.serve.fleet.router import (
+    AllReplicasBusy,
+    FleetRouter,
+    NoLiveReplicas,
+)
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind-probe; the usual small race is
+    acceptable for spawning local replicas)."""
+    with socket.socket() as sock:
+        sock.bind((host, 0))
+        return sock.getsockname()[1]
+
+
+def replica_specs(urls: list[str], *,
+                  heartbeat_files: list[Path | None] | None = None,
+                  journal=None) -> list[ms.Replica]:
+    """Replicas (r0, r1, ...) for a list of base URLs."""
+    hbs = heartbeat_files or [None] * len(urls)
+    return [ms.Replica(f"r{i}", url, heartbeat_file=hb, journal=journal)
+            for i, (url, hb) in enumerate(zip(urls, hbs))]
+
+
+class FleetApp:
+    """The assembled fleet endpoint: membership + router + HTTP listener."""
+
+    def __init__(self, replicas: list[ms.Replica], checkpoint: str, *,
+                 host: str = "127.0.0.1", port: int = 0,
+                 poll_s: float = 0.25, predict_timeout_s: float = 60.0,
+                 shadow_n: int = 16, agree_floor: float = 0.0,
+                 on_checkpoint_change=None, journal=None):
+        self.journal = journal if journal is not None \
+            else obs_journal.current()
+        self.checkpoint = str(checkpoint)
+        # Called with the new checkpoint after a reload converges, so the
+        # process that SPAWNS replicas (the supervisor wiring) can update
+        # its launch commands — without this, a replica crash after a
+        # converged roll would be relaunched on the OLD weights and
+        # silently rejoin rotation serving them.
+        self._on_checkpoint_change = on_checkpoint_change
+        self.membership = ms.FleetMembership(replicas, poll_s=poll_s,
+                                             journal=self.journal)
+        self.router = FleetRouter(self.membership,
+                                  predict_timeout_s=predict_timeout_s,
+                                  journal=self.journal)
+        self.shadow_n = int(shadow_n)
+        self.agree_floor = float(agree_floor)
+        self._host, self._port = host, int(port)
+        self._httpd: ThreadingHTTPServer | None = None
+        self._listener: threading.Thread | None = None
+        self._stopped = False
+        self._reload_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._counts = {"ok": 0, "rejected": 0, "no_replicas": 0,
+                        "bad_request": 0, "error": 0}
+        self._inflight = 0
+        self._idle = threading.Condition(self._stats_lock)
+        self._t_start = time.perf_counter()
+
+    # -- lifecycle --------------------------------------------------------
+    @property
+    def address(self) -> tuple[str, int]:
+        if self._httpd is None:
+            raise RuntimeError("fleet server not started")
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "FleetApp":
+        self.membership.start()
+        app = self
+
+        class Handler(_FleetHandler):
+            pass
+
+        Handler.app = app
+        self._httpd = ThreadingHTTPServer((self._host, self._port), Handler)
+        self._httpd.daemon_threads = True
+        self._listener = threading.Thread(target=self._httpd.serve_forever,
+                                          name="fleet-http", daemon=True)
+        self._listener.start()
+        self.journal.event(
+            "fleet_start", checkpoint=self.checkpoint,
+            replicas=[{"replica": r.replica_id, "url": r.url}
+                      for r in self.membership.replicas],
+            host=self.address[0], port=self.address[1])
+        logger.info("Fleet router at %s over %d replicas", self.url,
+                    len(self.membership.replicas))
+        return self
+
+    def stop(self, handler_timeout_s: float = 30.0) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        self.router.wait_idle()
+        # Wait for in-flight handler THREADS, not just router dispatches:
+        # a handler past dispatch still journals its 'request' event, and
+        # fleet_end/run_end must land after every one of those lines.
+        with self._idle:
+            if not self._idle.wait_for(lambda: self._inflight == 0,
+                                       timeout=handler_timeout_s):
+                logger.warning("%d in-flight fleet handler(s) did not "
+                               "finish within %.1fs", self._inflight,
+                               handler_timeout_s)
+            counts = dict(self._counts)
+        self.membership.close()
+        self.journal.event(
+            "fleet_end", n_requests=sum(counts.values()), **counts,
+            failovers=self.router.n_failovers,
+            wall_s=round(time.perf_counter() - self._t_start, 3))
+        logger.info("Fleet stopped: %s (%d failovers)", counts,
+                    self.router.n_failovers)
+
+    # -- request accounting ------------------------------------------------
+    def begin_request(self) -> None:
+        with self._idle:
+            self._inflight += 1
+
+    def end_request(self) -> None:
+        with self._idle:
+            self._inflight -= 1
+            if self._inflight == 0:
+                self._idle.notify_all()
+
+    def record(self, status: str, n_trials: int, latency_ms: float,
+               replica: str | None) -> None:
+        with self._stats_lock:
+            self._counts[status] = self._counts.get(status, 0) + 1
+        self.journal.event("request", n_trials=n_trials,
+                           latency_ms=round(latency_ms, 3), status=status,
+                           replica=replica)
+        self.journal.metrics.inc("requests_total", status=status)
+        if status == "ok":
+            self.journal.metrics.observe("request_latency_ms", latency_ms)
+
+    # -- rolling reload ----------------------------------------------------
+    def rolling_reload(self, checkpoint: str, *,
+                       shadow_n: int | None = None,
+                       agree_floor: float | None = None) -> dict:
+        """One rolling canary reload (serialized; raises RuntimeError when
+        one is already running)."""
+        if not self._reload_lock.acquire(blocking=False):
+            raise RuntimeError("a rolling reload is already in progress")
+        try:
+            reload_ = RollingReload(
+                self.router, checkpoint,
+                previous_checkpoint=self.checkpoint,
+                shadow_n=self.shadow_n if shadow_n is None else shadow_n,
+                agree_floor=(self.agree_floor if agree_floor is None
+                             else agree_floor),
+                journal=self.journal)
+            result = reload_.run()
+            if result["status"] in ("converged", "partial"):
+                self.checkpoint = str(checkpoint)
+                if self._on_checkpoint_change is not None:
+                    try:
+                        self._on_checkpoint_change(str(checkpoint))
+                    except Exception as exc:  # noqa: BLE001 — reload stands
+                        logger.warning("on_checkpoint_change hook failed: "
+                                       "%s", exc)
+            return result
+        finally:
+            self._reload_lock.release()
+
+
+class _FleetHandler(JsonRequestHandler):
+    """Router endpoint handler (instances on ThreadingHTTPServer threads;
+    journaling goes through ``self.app.journal`` explicitly — handler
+    threads do not inherit contextvars).  Plumbing (_reply/_read_body/
+    logging) is the shared serve-layer base."""
+
+    app: FleetApp = None  # bound by FleetApp.start()
+
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        logger.debug("fleet http: " + fmt, *args)
+
+    def do_GET(self):  # noqa: N802 — stdlib naming
+        app = self.app
+        if self.path == "/healthz":
+            snapshot = app.membership.snapshot()
+            n_live = sum(1 for r in snapshot if r["state"] == ms.LIVE)
+            digests = sorted({r["digest"] for r in snapshot
+                              if r["state"] == ms.LIVE and r["digest"]})
+            self._reply(200 if n_live else 503, {
+                "status": "ok" if n_live else "no_live_replicas",
+                "n_replicas": len(snapshot), "n_live": n_live,
+                "checkpoint": app.checkpoint,
+                "serving_digests": digests,
+                "replicas": snapshot})
+            return
+        if self.path == "/metrics":
+            self._reply(200, app.journal.metrics.snapshot(
+                run_id=app.journal.run_id))
+            return
+        self._reply(404, {"error": f"unknown path {self.path}"})
+
+    def do_POST(self):  # noqa: N802 — stdlib naming
+        # In-flight bracketing covers everything that journals, so
+        # FleetApp.stop() can hold fleet_end (and the run context's
+        # run_end) until these threads finish — a straggler 'request'
+        # event after the terminal record would break the completed-
+        # stream contract (same hardening as ServeApp.stop).
+        app = self.app
+        app.begin_request()
+        try:
+            if self.path == "/predict":
+                self._predict()
+                return
+            if self.path == "/reload":
+                self._reload()
+                return
+            self._reply(404, {"error": f"unknown path {self.path}"})
+        finally:
+            app.end_request()
+
+    def _predict(self) -> None:
+        app = self.app
+        t0 = time.perf_counter()
+        body = self._read_body()
+        content_type = (self.headers.get("Content-Type")
+                        or "application/json").split(";")[0].strip()
+        passthrough = {}
+        if self.headers.get("X-Deadline-Ms"):
+            passthrough["X-Deadline-Ms"] = self.headers["X-Deadline-Ms"]
+        try:
+            status, data, replica_id = app.router.dispatch(
+                body, content_type, headers=passthrough)
+        except AllReplicasBusy as exc:
+            app.record("rejected", 0, (time.perf_counter() - t0) * 1000.0,
+                       None)
+            self._reply(429, {"error": str(exc)})
+            return
+        except NoLiveReplicas as exc:
+            app.record("no_replicas", 0,
+                       (time.perf_counter() - t0) * 1000.0, None)
+            self._reply(503, {"error": str(exc)})
+            return
+        latency_ms = (time.perf_counter() - t0) * 1000.0
+        # n_trials for the request event comes from the replica's reply,
+        # but parsing is bounded: re-decoding a huge prediction body on
+        # the router hot path just for one count is not worth it — large
+        # responses journal n_trials=0 (the replica's own journal has the
+        # exact figure).
+        n_trials = 0
+        if status == 200 and len(data) <= 16384:
+            try:
+                n_trials = int(json.loads(data.decode()).get("n", 0))
+            except (ValueError, UnicodeDecodeError):
+                n_trials = 0
+        label = ("ok" if status == 200 else
+                 "rejected" if status == 429 else
+                 "bad_request" if 400 <= status < 500 else "error")
+        app.record(label, n_trials, latency_ms, replica_id)
+        self._reply_bytes(status, data)
+
+    def _reload(self) -> None:
+        app = self.app
+        try:
+            payload = json.loads(self._read_body().decode() or "{}")
+        except (ValueError, UnicodeDecodeError):
+            self._reply(400, {"error": "reload body must be JSON"})
+            return
+        checkpoint = payload.get("checkpoint") or app.checkpoint
+        kwargs = {}
+        try:
+            if "shadow_n" in payload:
+                kwargs["shadow_n"] = int(payload["shadow_n"])
+            if "agree_floor" in payload:
+                kwargs["agree_floor"] = float(payload["agree_floor"])
+        except (TypeError, ValueError) as exc:
+            # A malformed knob is the client's error, answered as one —
+            # not an unhandled exception that drops the connection.
+            self._reply(400, {"error": f"bad reload parameter: {exc}"})
+            return
+        try:
+            result = app.rolling_reload(str(checkpoint), **kwargs)
+        except RuntimeError as exc:
+            self._reply(409, {"error": str(exc)})
+            return
+        self._reply(200 if result["status"] in ("converged", "partial")
+                    else 409, result)
+
+
+def update_child_checkpoints(sup: supervise.MultiSupervisor,
+                             checkpoint: str) -> None:
+    """Point every supervised replica's launch command at ``checkpoint``
+    so a crash-relaunch after a converged rolling reload comes back on
+    the weights the fleet actually serves, not the ones it was born
+    with."""
+    for child in sup.children.values():
+        cmd = child.spec.cmd
+        if "--checkpoint" in cmd:
+            cmd[cmd.index("--checkpoint") + 1] = str(checkpoint)
+
+
+def spawn_replica_fleet(checkpoint: str, n: int, *, run_dir: Path,
+                        host: str = "127.0.0.1",
+                        serve_args: list[str] | None = None,
+                        policy: supervise.SupervisorPolicy | None = None,
+                        journal=None) -> tuple[supervise.MultiSupervisor,
+                                               list[ms.Replica]]:
+    """Child specs + supervisor + Replica handles for ``n`` local replicas.
+
+    Each replica is ``python -m eegnetreplication_tpu.serve`` on its own
+    port with its own heartbeat file (under ``run_dir``) and its own obs
+    run.  The caller runs ``supervisor.run()`` (usually on a thread) and
+    starts membership; a SIGKILLed replica is relaunched on the same port
+    and rejoins automatically.
+    """
+    run_dir = Path(run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    ports = [free_port(host) for _ in range(n)]
+    specs, urls, hbs = [], [], []
+    for i, port in enumerate(ports):
+        hb_file = run_dir / f"replica{i}.heartbeat.json"
+        cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
+               "--checkpoint", str(checkpoint), "--host", host,
+               "--port", str(port),
+               "--metricsDir", str(run_dir / "replica_obs")]
+        cmd += list(serve_args or [])
+        specs.append(supervise.ChildSpec(name=f"r{i}", cmd=cmd,
+                                         heartbeat_file=hb_file))
+        urls.append(f"http://{host}:{port}")
+        hbs.append(hb_file)
+    policy = policy or supervise.SupervisorPolicy(
+        grace_s=10.0, poll_s=0.25,
+        # Serving replicas have no snapshot to resume; the flag is
+        # accepted by serve main but appending it is noise.
+        resume_arg=None,
+        thresholds={"startup": 300.0})
+    sup = supervise.MultiSupervisor(specs, policy=policy, journal=journal)
+    replicas = replica_specs(urls, heartbeat_files=hbs, journal=journal)
+    return sup, replicas
+
+
+def main(argv=None) -> int:
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+    parser = argparse.ArgumentParser(
+        prog="eegtpu-fleet",
+        description="Multi-replica EEG inference fleet: supervised serving "
+                    "replicas behind a least-loaded router with "
+                    "health-gated membership and rolling canary reload.")
+    parser.add_argument("--checkpoint", required=True)
+    parser.add_argument("--replicas", type=int, default=2,
+                        help="Number of local replica processes to spawn.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8791,
+                        help="Router listen port (0 = ephemeral).")
+    parser.add_argument("--pollS", type=float, default=0.25,
+                        help="Membership health-poll cadence.")
+    parser.add_argument("--shadowN", type=int, default=16,
+                        help="Captured live requests replayed in the "
+                             "canary shadow compare.")
+    parser.add_argument("--agreeFloor", type=float, default=0.0,
+                        help="Minimum canary/reference agreement fraction "
+                             "(0 disables the agreement gate; the "
+                             "canary-must-answer gate always applies).")
+    parser.add_argument("--maxWaitMs", type=float, default=5.0)
+    parser.add_argument("--maxQueue", type=int, default=512)
+    parser.add_argument("--buckets", default=None)
+    parser.add_argument("--metricsDir", type=str, default=None)
+    parser.add_argument("--startupTimeoutS", type=float, default=300.0)
+    args = parser.parse_args(argv)
+    if args.replicas < 1:
+        parser.error("--replicas must be >= 1")
+
+    from eegnetreplication_tpu.config import Paths
+
+    metrics_dir = (Path(args.metricsDir) if args.metricsDir
+                   else Paths.from_here().reports / "obs")
+    serve_args = ["--maxWaitMs", str(args.maxWaitMs),
+                  "--maxQueue", str(args.maxQueue)]
+    if args.buckets:
+        serve_args += ["--buckets", args.buckets]
+    with obs_journal.run(metrics_dir, config=vars(args),
+                         role="fleet") as journal, preempt.guard():
+        sup, replicas = spawn_replica_fleet(
+            args.checkpoint, args.replicas, run_dir=journal.dir,
+            host=args.host, serve_args=serve_args, journal=journal)
+        sup_thread = threading.Thread(target=sup.run, name="fleet-supervisor",
+                                      daemon=True)
+        sup_thread.start()
+        app = FleetApp(replicas, args.checkpoint, host=args.host,
+                       port=args.port, poll_s=args.pollS,
+                       shadow_n=args.shadowN, agree_floor=args.agreeFloor,
+                       on_checkpoint_change=lambda ck:
+                       update_child_checkpoints(sup, ck),
+                       journal=journal)
+        app.membership.start()
+        if not app.membership.wait_live(args.replicas,
+                                        timeout_s=args.startupTimeoutS):
+            live = len(app.membership.dispatchable())
+            logger.warning("Only %d/%d replicas live after %.0fs — "
+                           "serving with what we have", live, args.replicas,
+                           args.startupTimeoutS)
+        app.start()
+        print(f"fleet serving at {app.url} "
+              f"({len(app.membership.dispatchable())} live)", flush=True)
+        try:
+            while not preempt.requested():
+                time.sleep(0.2)
+        finally:
+            logger.info("Fleet stop requested — draining")
+            app.stop()
+            sup.stop()
+            sup_thread.join(timeout=60.0)
+    return preempt.EX_PREEMPTED if preempt.requested() else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

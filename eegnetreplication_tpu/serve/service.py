@@ -270,7 +270,34 @@ class ServeApp:
             self.journal.metrics.observe("request_latency_ms", latency_ms)
 
 
-class _ServeHandler(BaseHTTPRequestHandler):
+class JsonRequestHandler(BaseHTTPRequestHandler):
+    """Shared HTTP plumbing for the serving handlers (single-process and
+    fleet): keep-alive JSON replies with explicit Content-Length, bounded
+    body reads, debug-level access logging.  Subclasses provide routes.
+    """
+
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
+        logger.debug("serve http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        self._reply_bytes(code, json.dumps(payload).encode())
+
+    def _reply_bytes(self, code: int, body: bytes,
+                     content_type: str = "application/json") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> bytes:
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        return self.rfile.read(length) if length else b""
+
+
+class _ServeHandler(JsonRequestHandler):
     """One request; instances live on the ThreadingHTTPServer's threads.
 
     Handler threads do not inherit the main thread's contextvars, so all
@@ -279,23 +306,6 @@ class _ServeHandler(BaseHTTPRequestHandler):
     """
 
     app: ServeApp = None  # bound by ServeApp.start()
-    protocol_version = "HTTP/1.1"
-
-    # -- plumbing ---------------------------------------------------------
-    def log_message(self, fmt, *args):  # noqa: A003 — stdlib signature
-        logger.debug("serve http: " + fmt, *args)
-
-    def _reply(self, code: int, payload: dict) -> None:
-        body = json.dumps(payload).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _read_body(self) -> bytes:
-        length = int(self.headers.get("Content-Length", 0) or 0)
-        return self.rfile.read(length) if length else b""
 
     def _parse_trials(self, body: bytes) -> np.ndarray:
         """Trials from a JSON object or raw ``.npz`` bytes (the native
@@ -339,9 +349,15 @@ class _ServeHandler(BaseHTTPRequestHandler):
                     "stale": verdict.stale},
                 "checkpoint": app.checkpoint,
                 "model_digest": engine.digest,
+                # The fleet router's membership poll reads these two:
+                # variables_digest verifies canary identity (which weights
+                # this replica actually serves), the queue depths feed
+                # least-loaded dispatch — no separate endpoint needed.
+                "variables_digest": engine.digest,
                 "geometry": {"n_channels": c, "n_times": t},
                 "buckets": list(engine.buckets),
                 "queue_depth_trials": app.batcher.queue_depth,
+                "queue_depth_requests": app.batcher.queue_depth_requests,
                 "model_swaps": app.registry.swaps})
             return
         if self.path == "/metrics":

@@ -49,15 +49,46 @@ def _armed_dispatch(jitted, site: str = "train.step"):
     a wrapper traces+compiles (minutes of legitimate silence), so it beats
     phase ``compile`` and later dispatches beat ``step`` — the watchdog
     budgets the two very differently (``resil/heartbeat.py``).
+
+    The first dispatch is also where the persistent compilation cache pays
+    off: when ``EEGTPU_COMPILE_CACHE`` names a directory it is enabled
+    (explicit opt-in, any backend) before the compile, and the dispatch
+    journals a ``compile`` event with ``cache_hit`` — no new cache entry
+    after the compile means an executable was replayed, which is what
+    makes supervisor restarts and fleet scale-out cheap.
     """
+    import time
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+
     first = [True]
 
     def dispatch(pool_x, pool_y, specs, carry_or_states, keys):
-        heartbeat.beat("compile" if first[0] else "step",
+        was_first = first[0]
+        heartbeat.beat("compile" if was_first else "step",
                        n_folds=int(keys.shape[0]))
         first[0] = False
         inject.fire(site, n_folds=int(keys.shape[0]))
-        return jitted(pool_x, pool_y, specs, carry_or_states, keys)
+        if not was_first:
+            return jitted(pool_x, pool_y, specs, carry_or_states, keys)
+        from eegnetreplication_tpu.utils.platform import (
+            compile_cache_hit,
+            compile_cache_probe,
+            enable_compilation_cache,
+        )
+
+        cache_dir = enable_compilation_cache(explicit_only=True)
+        probe = compile_cache_probe(cache_dir)
+        t0 = time.perf_counter()
+        # jit compiles synchronously inside this call (execution stays
+        # async), so the wall around it is trace+compile time.
+        out = jitted(pool_x, pool_y, specs, carry_or_states, keys)
+        obs_journal.current().event(
+            "compile", what=f"{site}_dispatch",
+            cache_hit=compile_cache_hit(cache_dir, probe),
+            cache_dir=cache_dir,
+            elapsed_s=round(time.perf_counter() - t0, 3))
+        return out
 
     return dispatch
 

@@ -230,7 +230,7 @@ def force_cpu(n_devices: int | None = None) -> bool:
     return not initialized
 
 
-def enable_compilation_cache() -> str | None:
+def enable_compilation_cache(explicit_only: bool = False) -> str | None:
     """Point JAX's persistent compilation cache at a per-user directory.
 
     The fused protocol trainers are one large XLA program; its first compile
@@ -242,15 +242,26 @@ def enable_compilation_cache() -> str | None:
     ``EEGTPU_COMPILE_CACHE=0`` disables, any other value overrides the
     directory.  Best-effort: returns the directory or None, never raises.
 
-    Only wired up for accelerator backends (see :func:`select_platform`):
+    Auto-enabled only for accelerator backends (see :func:`select_platform`):
     XLA:CPU caches AOT machine code keyed loosely enough that a reload can
     cross CPU-feature sets (observed here: error-level feature-mismatch spam
     and a documented SIGILL risk) — and CPU compiles are fast anyway.
+
+    ``explicit_only=True`` (the serving engine and training dispatch use
+    this) enables the cache ONLY when ``EEGTPU_COMPILE_CACHE`` names a
+    directory — an explicit opt-in, honored on any backend: a replica
+    fleet's processes share one host (identical CPU features), so restarts
+    and scale-out can skip recompiles the single-process caution exists to
+    avoid crossing machines with.  Explicit opt-in also drops the
+    min-compile-time floor to zero so even seconds-sized serving programs
+    are cached (replica cold-start is exactly those small programs).
     """
     setting = os.environ.get("EEGTPU_COMPILE_CACHE", "")
     if setting.lower() in ("0", "false", "no", "off"):
         return None
     explicit = bool(setting)  # user opted in/pointed somewhere: warn on drop
+    if explicit_only and not explicit:
+        return None
     uid = os.getuid() if hasattr(os, "getuid") else "u"
     # "1"/"true"/... mean "enable with the default path", not a directory
     # literally named "1" in the current cwd; other values are directories
@@ -290,11 +301,97 @@ def enable_compilation_cache() -> str | None:
         jax.config.update("jax_compilation_cache_dir", path)
         # The model is tiny; default thresholds (2 s / 32 KiB) would skip
         # exactly the small-but-tunnel-expensive programs we care about.
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # An explicit opt-in caches everything: serving warmup programs
+        # compile in well under half a second on CPU and are exactly what
+        # replica restarts need to replay.
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0 if explicit else 0.5)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        # jax latches its cache decision once per process, at the FIRST
+        # compile — which may have happened before this function
+        # configured the directory (e.g. an engine warmed after some
+        # earlier jit ran): the latched state then has NO cache object
+        # and every later compile silently skips the cache.  Unlatch
+        # (reset) whenever the live cache object is missing or points at
+        # a different directory, so the next compile re-initializes from
+        # the configuration above.  Private API, pinned-container jax;
+        # best-effort by design.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            cache_obj = getattr(_cc, "_cache", None)
+            if cache_obj is None \
+                    or str(getattr(cache_obj, "path",
+                                   getattr(cache_obj, "_path", ""))) != path:
+                _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — cache stays an optimization
+            pass
     except Exception:  # noqa: BLE001 — cache is an optimization only
         return None
     return path
+
+
+def compilation_cache_entries(path: str | os.PathLike | None) -> int:
+    """Number of persisted executables in a compilation-cache directory.
+    Best-effort — an unreadable/missing directory counts as empty."""
+    if not path:
+        return 0
+    try:
+        return sum(1 for name in os.listdir(path)
+                   if not name.endswith(".tmp"))
+    except OSError:
+        return 0
+
+
+# Process-local count of persistent-cache hits, fed by a jax monitoring
+# listener (the event the compiler records on every successful cache
+# read).  Listener-based counting is immune to concurrent writers in a
+# SHARED cache directory — fleet replicas warming simultaneously would
+# make a before/after entry count misreport a genuine hit as a miss.
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_cache_hits = 0
+_cache_hit_listener_state = "uninstalled"  # -> "installed" | "unavailable"
+
+
+def compilation_cache_hits() -> int | None:
+    """Persistent-cache hits observed by THIS process so far, or ``None``
+    when the monitoring listener could not be installed (API drift —
+    callers fall back to directory entry counts)."""
+    global _cache_hit_listener_state
+    if _cache_hit_listener_state == "uninstalled":
+        try:
+            from jax._src import monitoring as _monitoring
+
+            def _on_event(event, *args, **kwargs):
+                global _cache_hits
+                if event == _CACHE_HIT_EVENT:
+                    _cache_hits += 1
+
+            _monitoring.register_event_listener(_on_event)
+            _cache_hit_listener_state = "installed"
+        except Exception:  # noqa: BLE001 — private API, best-effort
+            _cache_hit_listener_state = "unavailable"
+    return _cache_hits if _cache_hit_listener_state == "installed" else None
+
+
+def compile_cache_probe(cache_dir: str | None) -> tuple:
+    """Snapshot taken immediately before one compile; feed to
+    :func:`compile_cache_hit` right after it."""
+    return (compilation_cache_hits(), compilation_cache_entries(cache_dir))
+
+
+def compile_cache_hit(cache_dir: str | None, probe: tuple) -> bool | None:
+    """Whether the compile bracketed by ``probe`` replayed a persisted
+    executable.  ``None`` when the cache is disabled; hit-counter based
+    when the monitoring listener is available, else the entry-count
+    fallback (accurate only without concurrent cache writers)."""
+    if not cache_dir:
+        return None
+    hits_before, entries_before = probe
+    hits_now = compilation_cache_hits()
+    if hits_before is not None and hits_now is not None:
+        return hits_now > hits_before
+    return compilation_cache_entries(cache_dir) <= entries_before
 
 
 def select_platform_info(probe_timeout_s: float | None = None,

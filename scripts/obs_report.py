@@ -101,6 +101,10 @@ _COLUMNS = (
     # trips (from request/circuit_state events).
     ("supervisor_restarts", "restarts"), ("hang_detections", "hangs"),
     ("expired", "expired"), ("breaker_trips", "trips"),
+    # Fleet runs (fleet_* events): replica count, dispatch failovers off
+    # dead/failing replicas, and the last rolling reload's outcome.
+    ("fleet_replicas", "fleet"), ("fleet_failovers", "failovers"),
+    ("fleet_reload_status", "fleet_reload"),
 )
 
 

@@ -16,6 +16,10 @@ end-to-end instead, timing every stage and leaving the artifacts on disk:
   5. ``python -m eegnetreplication_tpu.predict`` on subject 1's Eval set
   6. ``scripts/serve_smoke.py``: the online serving subsystem answers the
      same trials file over HTTP and must byte-match the predict CLI
+  6b. ``scripts/serve_bench.py --fleet 3 --selftest``: three supervised
+     replicas of the trained model behind the fleet router; open-loop
+     scaling floor, then kill-one-replica-under-load with zero failed
+     requests and automatic rejoin (``fleet-kill`` stage)
   7. viz figures (temporal/spatial/PSD) saved from the trained checkpoint
 
 Stage walls and exit codes land in ``<root>/rehearsal.json``.  Run on the
@@ -167,6 +171,16 @@ def main(argv=None) -> int:
          "--trials",
          str(root / "data" / "processed" / "Eval" / "A01E-trials.npz")],
         root, record, platform=args.platform)
+    # Fleet kill drill: 3 supervised replicas of the trained model behind
+    # the router; open-loop scaling floor, then SIGKILL one replica under
+    # load — zero failed requests, automatic rejoin (selftest asserts).
+    ok = ok and run_stage(
+        "fleet-kill",
+        [py, str(REPO / "scripts" / "serve_bench.py"),
+         "--fleet", "3", "--selftest",
+         "--checkpoint", str(root / "models" / "subject_01_best_model.npz"),
+         "--out", str(root / "BENCH_FLEET.json")],
+        root, record, platform=args.platform, timeout=1800.0)
     if ok:
         viz_src = (
             "import sys; sys.path.insert(0, {repo!r})\n"

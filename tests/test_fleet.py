@@ -1,0 +1,501 @@
+"""Fleet serving subsystem (``eegnetreplication_tpu/serve/fleet/``).
+
+Covers the ISSUE-6 acceptance surface: health-gated membership (drain on
+degraded/stale, out on unreachable, automatic rejoin), least-loaded
+dispatch with per-replica breakers and zero-failure failover off a dead
+replica, the rolling canary reload (shadow compare, rollback, corrupt
+push leaves the fleet untouched), and the ``serve_bench.py --fleet``
+tier-1 selftest (scaling floor + kill-one-replica-under-load).
+
+The membership/router/canary machinery is pure HTTP orchestration, so
+most tests run against scriptable stdlib fake replicas — no JAX, no
+subprocesses; the end-to-end truth (real engines, real processes, real
+SIGKILL) is the selftest leg.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import pytest
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.serve.fleet import membership as ms
+from eegnetreplication_tpu.serve.fleet.canary import RollingReload
+from eegnetreplication_tpu.serve.fleet.router import (
+    AllReplicasBusy,
+    FleetRouter,
+    NoLiveReplicas,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+class FakeReplica:
+    """A scriptable single-replica double: /healthz, /predict, /reload.
+
+    Behavior knobs are plain attributes, mutated mid-test to simulate
+    degradation, death (``stop()``), bad pushes, and disagreeing models.
+    """
+
+    def __init__(self, digest: str = "d-old", port: int = 0):
+        self.digest = digest
+        self.healthz_digest = None           # override what /healthz shows
+        self.queue_depth = 0
+        self.degraded: list[str] = []        # non-empty -> healthz 503
+        self.predict_status = 200
+        self.predictions = [0, 1, 2]         # served to every /predict
+        # reload_fn(checkpoint) -> (status, digest-or-error)
+        self.reload_fn = lambda ck: (200, "d-new")
+        self.log: list[tuple[str, bytes]] = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # HTTP/1.0: one connection per request.  A stopped fake must
+            # look DEAD, like a SIGKILLed replica whose sockets the OS
+            # closed — with keep-alive, stdlib handler threads would keep
+            # serving pooled connections after shutdown().  The pooled
+            # keep-alive path is exercised end-to-end by the selftest leg.
+            protocol_version = "HTTP/1.0"
+
+            def log_message(self, *a):  # noqa: A003 — quiet
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                if self.path == "/healthz":
+                    code = 503 if fake.degraded else 200
+                    self._reply(code, {
+                        "status": "degraded" if fake.degraded else "ok",
+                        "degraded": fake.degraded,
+                        "variables_digest": (fake.healthz_digest
+                                             or fake.digest),
+                        "queue_depth_requests": fake.queue_depth,
+                        "queue_depth_trials": fake.queue_depth})
+                    return
+                self._reply(404, {})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(n) if n else b""
+                fake.log.append((self.path, body))
+                if self.path == "/predict":
+                    if fake.predict_status != 200:
+                        self._reply(fake.predict_status,
+                                    {"error": "scripted"})
+                        return
+                    self._reply(200, {"predictions": fake.predictions,
+                                      "n": len(fake.predictions),
+                                      "model_digest": fake.digest})
+                    return
+                if self.path == "/reload":
+                    ck = json.loads(body.decode()).get("checkpoint")
+                    status, result = fake.reload_fn(ck)
+                    if status == 200:
+                        fake.digest = result
+                        self._reply(200, {"status": "ok",
+                                          "model_digest": result})
+                    else:
+                        self._reply(status, {"error": result})
+                    return
+                self._reply(404, {})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def predict_count(self) -> int:
+        return sum(1 for path, _ in self.log if path == "/predict")
+
+    def reload_checkpoints(self) -> list[str]:
+        return [json.loads(body.decode()).get("checkpoint")
+                for path, body in self.log if path == "/reload"]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def journal(tmp_path):
+    with obs_journal.run(tmp_path / "obs", config={}) as jr:
+        yield jr
+
+
+def _fleet(fakes, journal, **membership_kw):
+    replicas = [ms.Replica(f"r{i}", fake.url, journal=journal)
+                for i, fake in enumerate(fakes)]
+    membership = ms.FleetMembership(replicas, journal=journal,
+                                    **membership_kw)
+    router = FleetRouter(membership, journal=journal)
+    return replicas, membership, router
+
+
+def _events(jr, kind):
+    return [e for e in schema.read_events(jr.events_path, complete=False)
+            if e["event"] == kind]
+
+
+class TestMembership:
+    def test_join_drain_on_degraded_and_recover(self, journal):
+        fake = FakeReplica()
+        try:
+            replicas, membership, _ = _fleet([fake], journal)
+            r = replicas[0]
+            assert r.state == ms.JOINING
+            membership.poll_once()
+            assert r.state == ms.LIVE
+            assert r.digest == "d-old"
+            fake.degraded = ["circuit_open"]
+            membership.poll_once()
+            assert r.state == ms.DRAINING
+            assert membership.dispatchable() == []
+            fake.degraded = []
+            membership.poll_once()
+            assert r.state == ms.LIVE
+            transitions = [(e["state"], e["reason"])
+                           for e in _events(journal, "fleet_member")]
+            assert transitions == [("live", "joined"),
+                                   ("draining", "circuit_open"),
+                                   ("live", "recovered")]
+        finally:
+            fake.stop()
+
+    def test_unreachable_goes_out_and_rejoins(self, journal):
+        fake = FakeReplica()
+        port = fake.port
+        replicas, membership, _ = _fleet([fake], journal,
+                                         fail_threshold=2)
+        r = replicas[0]
+        membership.poll_once()
+        assert r.state == ms.LIVE
+        fake.stop()
+        membership.poll_once()
+        assert r.state == ms.LIVE  # one failed poll is not a verdict
+        membership.poll_once()
+        assert r.state == ms.OUT
+        # "Restart" on the same port (allow_reuse_address): the next
+        # healthy poll rejoins it with no external intervention.
+        fake2 = FakeReplica(port=port)
+        try:
+            membership.poll_once()
+            assert r.state == ms.LIVE
+            reasons = [e["reason"]
+                       for e in _events(journal, "fleet_member")]
+            assert reasons == ["joined", "unreachable: ConnectionRefusedError",
+                               "rejoined"]
+        finally:
+            fake2.stop()
+
+    def test_stale_heartbeat_file_drains_without_flapping(self, journal,
+                                                          tmp_path):
+        fake = FakeReplica()
+
+        def write_beat(age_s: float):
+            hb_file.write_text(json.dumps(
+                {"phase": "serve_idle", "beat": 3,
+                 "t": time.time() - age_s, "pid": os.getpid()}))
+
+        try:
+            hb_file = tmp_path / "hb.json"
+            write_beat(0.0)
+            replica = ms.Replica("r0", fake.url, heartbeat_file=hb_file,
+                                 journal=journal)
+            membership = ms.FleetMembership([replica], journal=journal)
+            membership.poll_once()
+            assert replica.state == ms.LIVE
+            write_beat(3600.0)  # the worker wedges: healthz still 200
+            membership.poll_once()
+            assert replica.state == ms.DRAINING
+            # No live<->draining flapping while the beat stays stale: a
+            # healthy healthz must not re-admit a wedged worker.
+            membership.poll_once()
+            membership.poll_once()
+            assert replica.state == ms.DRAINING
+            transitions = [(e["state"], e["reason"])
+                           for e in _events(journal, "fleet_member")]
+            assert transitions[0] == ("live", "joined")
+            assert len(transitions) == 2
+            assert transitions[1][0] == "draining"
+            assert transitions[1][1].startswith(
+                "heartbeat_stale:serve_idle")
+            write_beat(0.0)  # the worker recovers
+            membership.poll_once()
+            assert replica.state == ms.LIVE
+        finally:
+            fake.stop()
+
+
+class TestRouter:
+    def test_least_loaded_dispatch(self, journal):
+        busy, idle = FakeReplica(), FakeReplica()
+        busy.queue_depth = 50
+        try:
+            _, membership, router = _fleet([busy, idle], journal)
+            membership.poll_once()
+            for _ in range(5):
+                status, _, replica_id = router.dispatch(b"{}")
+                assert status == 200
+                assert replica_id == "r1"  # the idle one, every time
+            assert idle.predict_count() == 5
+            assert busy.predict_count() == 0
+        finally:
+            busy.stop()
+            idle.stop()
+
+    def test_dead_replica_fails_over_with_zero_failures(self, journal):
+        dying, healthy = FakeReplica(), FakeReplica()
+        try:
+            replicas, membership, router = _fleet([dying, healthy], journal)
+            membership.poll_once()
+            dying.queue_depth = 0
+            dying.stop()  # dies AFTER membership saw it live
+            for _ in range(8):
+                status, _, _ = router.dispatch(b"{}")
+                assert status == 200  # every request lands on the sibling
+            assert replicas[0].state == ms.OUT  # pulled at first dead conn
+            assert router.n_failovers >= 1
+            retries = _events(journal, "fleet_retry")
+            assert retries and retries[0]["replica"] == "r0"
+        finally:
+            healthy.stop()
+
+    def test_all_busy_is_429_no_live_is_503(self, journal):
+        fake = FakeReplica()
+        try:
+            replicas, membership, router = _fleet([fake], journal)
+            membership.poll_once()
+            fake.predict_status = 429
+            with pytest.raises(AllReplicasBusy):
+                router.dispatch(b"{}")
+            membership.set_state(replicas[0], ms.OUT, "test")
+            with pytest.raises(NoLiveReplicas):
+                router.dispatch(b"{}")
+        finally:
+            fake.stop()
+
+    def test_5xx_failover_trips_the_replica_breaker(self, journal):
+        from eegnetreplication_tpu.resil.breaker import CircuitBreaker
+
+        broken, healthy = FakeReplica(), FakeReplica()
+        try:
+            replicas = [
+                ms.Replica("r0", broken.url, journal=journal,
+                           breaker=CircuitBreaker(failure_threshold=3,
+                                                  site="fleet.r0",
+                                                  journal=journal)),
+                ms.Replica("r1", healthy.url, journal=journal)]
+            membership = ms.FleetMembership(replicas, journal=journal)
+            router = FleetRouter(membership, journal=journal)
+            membership.poll_once()
+            broken.predict_status = 500
+            broken.queue_depth = 0
+            healthy.queue_depth = 10  # force r0 to be tried first
+            for _ in range(6):
+                status, _, replica_id = router.dispatch(b"{}")
+                assert status == 200 and replica_id == "r1"
+            # Three 500s opened r0's breaker; later dispatches skip it.
+            assert replicas[0].breaker.state == "open"
+            assert broken.predict_count() == 3
+        finally:
+            broken.stop()
+            healthy.stop()
+
+
+class TestRollingReload:
+    def _seed_ring(self, router, n=4):
+        for _ in range(n):
+            status, _, _ = router.dispatch(b"{}")
+            assert status == 200
+
+    def test_converges_and_journals_shadow(self, journal):
+        fakes = [FakeReplica() for _ in range(3)]
+        try:
+            _, membership, router = _fleet(fakes, journal)
+            membership.poll_once()
+            self._seed_ring(router)
+            result = RollingReload(router, "new.npz",
+                                   previous_checkpoint="old.npz",
+                                   shadow_n=3, journal=journal).run()
+            assert result["status"] == "converged"
+            assert result["new_digest"] == "d-new"
+            assert result["shadow"]["n"] == 3
+            assert result["shadow"]["agree"] == 1.0
+            assert all(f.digest == "d-new" for f in fakes)
+            assert len(result["rolled"]) == 3
+            shadows = _events(journal, "fleet_shadow")
+            assert len(shadows) == 3
+            assert all(e["agree"] == 1.0 for e in shadows)
+            reloads = _events(journal, "fleet_reload")
+            assert reloads[-1]["status"] == "converged"
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_corrupt_push_leaves_whole_fleet_on_old_digest(self, journal):
+        fakes = [FakeReplica() for _ in range(3)]
+        for f in fakes:
+            f.reload_fn = lambda ck: (400, "IntegrityError: sha mismatch")
+        try:
+            _, membership, router = _fleet(fakes, journal)
+            membership.poll_once()
+            self._seed_ring(router)
+            result = RollingReload(router, "corrupt.npz",
+                                   previous_checkpoint="old.npz",
+                                   journal=journal).run()
+            assert result["status"] == "failed"
+            assert result["stage"] == "canary_reload"
+            assert all(f.digest == "d-old" for f in fakes)
+            # Exactly ONE replica (the canary) ever saw the bad push.
+            assert sum(len(f.reload_checkpoints()) for f in fakes) == 1
+            membership.poll_once()
+            assert len(membership.dispatchable()) == 3  # canary rejoined
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_shadow_disagreement_rolls_canary_back(self, journal):
+        fakes = [FakeReplica() for _ in range(3)]
+
+        def scripted_reload(fake):
+            def fn(ck):
+                if ck == "new.npz":
+                    # The new model answers differently; healthz digest
+                    # follows the swap, as the real replica's would.
+                    fake.predictions = [3, 3, 3]
+                    return 200, "d-new"
+                fake.predictions = [0, 1, 2]   # rollback restores it
+                return 200, "d-old"
+            return fn
+
+        for f in fakes:
+            f.reload_fn = scripted_reload(f)
+        try:
+            _, membership, router = _fleet(fakes, journal)
+            membership.poll_once()
+            self._seed_ring(router)
+            result = RollingReload(router, "new.npz",
+                                   previous_checkpoint="old.npz",
+                                   shadow_n=3, agree_floor=0.9,
+                                   journal=journal).run()
+            assert result["status"] == "failed"
+            assert result["stage"] == "shadow"
+            assert result["shadow"]["agree"] == 0.0
+            # The canary was rolled back; nobody else was ever touched.
+            assert all(f.digest == "d-old" for f in fakes)
+            canary_reloads = [ck for f in fakes
+                              for ck in f.reload_checkpoints()]
+            assert sorted(canary_reloads) == ["new.npz", "old.npz"]
+            phases = [e["phase"] for e in _events(journal, "fleet_canary")]
+            assert "shadow_fail" in phases and "rolled_back" in phases
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_unverifiable_digest_aborts(self, journal):
+        # The reload response claims d-new but /healthz keeps showing
+        # d-old: identity cannot be verified, so nothing else is rolled.
+        fakes = [FakeReplica() for _ in range(2)]
+        for f in fakes:
+            f.healthz_digest = "d-old"
+        try:
+            _, membership, router = _fleet(fakes, journal)
+            membership.poll_once()
+            result = RollingReload(router, "new.npz",
+                                   previous_checkpoint="old.npz",
+                                   journal=journal).run()
+            assert result["status"] == "failed"
+            assert result["stage"] == "digest_verify"
+            phases = [e["phase"] for e in _events(journal, "fleet_canary")]
+            assert "digest_mismatch" in phases
+            # Only the canary saw /reload traffic (its push + rollback).
+            touched = [f for f in fakes if f.reload_checkpoints()]
+            assert len(touched) == 1
+        finally:
+            for f in fakes:
+                f.stop()
+
+    def test_event_summary_reports_fleet_fields(self, journal):
+        fakes = [FakeReplica() for _ in range(2)]
+        try:
+            _, membership, router = _fleet(fakes, journal)
+            membership.poll_once()
+            self._seed_ring(router)
+            RollingReload(router, "new.npz", previous_checkpoint="old.npz",
+                          shadow_n=2, journal=journal).run()
+        finally:
+            for f in fakes:
+                f.stop()
+        events = schema.read_events(journal.events_path, complete=False)
+        summary = schema.event_summary(events)
+        assert summary["fleet_member_transitions"] >= 2
+        assert summary["fleet_reload_status"] == "converged"
+        assert summary["fleet_shadow_agree"] == 1.0
+        assert not any("_schema_error" in e for e in events)
+
+
+class TestCheckpointReconciliation:
+    def test_converged_reload_updates_supervised_launch_commands(self):
+        """A crash-relaunch after a converged rolling reload must come
+        back on the NEW checkpoint — the supervisor's child commands are
+        rewritten by the on_checkpoint_change hook."""
+        from eegnetreplication_tpu.resil import supervise
+        from eegnetreplication_tpu.serve.fleet.service import (
+            update_child_checkpoints,
+        )
+
+        specs = [supervise.ChildSpec(
+            name=f"r{i}",
+            cmd=[sys.executable, "-m", "eegnetreplication_tpu.serve",
+                 "--checkpoint", "old.npz", "--port", str(9000 + i)])
+            for i in range(3)]
+        sup = supervise.MultiSupervisor(specs)
+        update_child_checkpoints(sup, "new.npz")
+        for child in sup.children.values():
+            cmd = child.spec.cmd
+            assert cmd[cmd.index("--checkpoint") + 1] == "new.npz"
+            assert "old.npz" not in cmd
+
+
+class TestFleetSelftest:
+    def test_fleet_selftest_passes(self, tmp_path):
+        """ISSUE-6 acceptance, end to end with real processes: open-loop
+        rps scales >= 0.8x linear to 4 replicas on CPU, a SIGKILLed
+        replica under load costs zero failed requests and rejoins, the
+        rolling canary converges the fleet to the new digest with shadow
+        compares journaled, and a corrupt push changes nothing."""
+        out = tmp_path / "BENCH_FLEET_selftest.json"
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
+             "--fleet", "4", "--selftest", "--out", str(out)],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu"))
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads(out.read_text())
+        assert record["linear_fraction"] >= 0.8
+        assert record["kill_leg"]["failures"] == 0
+        assert record["kill_leg"]["rejoined"] is True
+        assert record["reload_leg"]["reload"]["status"] == "converged"
+        assert record["reload_leg"]["load"]["failures"] == 0
+        assert record["failed_canary_leg"]["digests_unchanged"] is True
+        assert record["journal"]["fleet_shadow_events"] >= 1
+        assert record["http_smoke"]["ok"] is True

@@ -12,6 +12,7 @@ import os
 import random
 import subprocess
 import sys
+import threading
 import time
 import types
 from pathlib import Path
@@ -1006,6 +1007,153 @@ class TestSupervisor:
         events = schema.read_events(jr.events_path)
         ends = [e for e in events if e["event"] == "supervisor_end"]
         assert ends[-1]["status"] == "stopped"
+
+
+class TestMultiSupervisor:
+    """ISSUE-6 satellite: the multi-child supervision mode behind the
+    replica fleet — kill one of three dummy children under load and ONLY
+    that child restarts, siblings' heartbeats never go stale, and the
+    crash-loop breaker fires per child."""
+
+    BEATING_CHILD = (
+        "import json, os, signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(0))\n"
+        "hb = os.environ.get('EEGTPU_HEARTBEAT_FILE')\n"
+        "i = 0\n"
+        "while True:\n"
+        "    i += 1\n"
+        "    if hb:\n"
+        "        tmp = hb + '.tmp'\n"
+        "        open(tmp, 'w').write(json.dumps(\n"
+        "            {'phase': 'step', 'beat': i, 't': time.time(),\n"
+        "             'pid': os.getpid()}))\n"
+        "        os.replace(tmp, hb)\n"
+        "    time.sleep(0.05)\n")
+
+    def _policy(self, **kw):
+        kw.setdefault("poll_s", 0.05)
+        kw.setdefault("grace_s", 2.0)
+        kw.setdefault("backoff", retry.RetryPolicy(
+            max_attempts=1_000_000, base_delay_s=0.0, jitter=0.0))
+        return supervise.SupervisorPolicy(**kw)
+
+    def _specs(self, tmp_path, bodies: dict) -> list:
+        specs = []
+        for name, body in bodies.items():
+            script = tmp_path / f"{name}.py"
+            script.write_text(body)
+            specs.append(supervise.ChildSpec(
+                name=name, cmd=[sys.executable, str(script)],
+                heartbeat_file=tmp_path / f"{name}.hb.json"))
+        return specs
+
+    @staticmethod
+    def _wait(predicate, timeout_s=15.0, what="condition"):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if predicate():
+                return
+            time.sleep(0.02)
+        raise AssertionError(f"timed out waiting for {what}")
+
+    def test_kill_one_of_three_only_that_child_restarts(self, tmp_path):
+        specs = self._specs(tmp_path, {f"c{i}": self.BEATING_CHILD
+                                       for i in range(3)})
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.MultiSupervisor(
+                specs, policy=self._policy(
+                    thresholds={"step": 2.0, "startup": 30.0}),
+                journal=jr)
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            self._wait(lambda: all(
+                c.state == "running" for c in sup.children.values()),
+                what="all three children running")
+            victim = sup.children["c1"]
+            os.kill(victim.pid, 9)
+            self._wait(lambda: victim.attempt == 2
+                       and victim.state == "running",
+                       what="victim relaunch")
+            # A couple of watchdog cycles: the siblings keep beating and
+            # must never be flagged stale while the victim bounces.
+            time.sleep(0.5)
+            assert sup.children["c0"].attempt == 1
+            assert sup.children["c2"].attempt == 1
+            sup.stop()
+            th.join(timeout=15.0)
+            assert not th.is_alive()
+        events = schema.read_events(jr.events_path, complete=False)
+        restarts = [e for e in events if e["event"] == "supervisor_restart"]
+        assert [e["child"] for e in restarts] == ["c1"]
+        assert restarts[0]["reason"] == "transient"  # SIGKILL, not hang
+        assert not any(e["event"] == "supervisor_hang" for e in events)
+        exits = [e for e in events if e["event"] == "supervisor_exit"]
+        # 4 exits total: the kill + three drains at stop (and the
+        # relaunched victim's drain).
+        assert sum(1 for e in exits if e["child"] == "c1") == 2
+        ends = [e for e in events if e["event"] == "supervisor_end"]
+        assert ends[-1]["status"] == "stopped"
+        assert not any("_schema_error" in e for e in events)
+
+    def test_crash_loop_breaker_fires_per_child(self, tmp_path):
+        specs = self._specs(tmp_path, {
+            "looper": "import sys; sys.exit(1)\n",
+            "worker": "import sys; sys.exit(0)\n"})
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.MultiSupervisor(
+                specs, policy=self._policy(max_restarts=2,
+                                           restart_window_s=60.0),
+                journal=jr)
+            assert sup.run() == supervise.EX_CRASH_LOOP
+        assert sup.children["looper"].attempt == 3  # initial + 2 restarts
+        assert sup.children["looper"].state == "crash_loop"
+        assert sup.children["worker"].attempt == 1
+        assert sup.children["worker"].state == "done"
+        events = schema.read_events(jr.events_path, complete=False)
+        giveups = [e for e in events if e["event"] == "supervisor_giveup"]
+        assert [e["child"] for e in giveups] == ["looper"]
+        ends = [e for e in events if e["event"] == "supervisor_end"]
+        assert ends[-1]["status"] == "crash_loop"
+        assert ends[-1]["children"] == {"looper": "crash_loop",
+                                        "worker": "done"}
+
+    def test_hang_detection_is_per_child(self, tmp_path):
+        # One child beats once then wedges (SIGTERM-proof); the sibling
+        # keeps beating.  Only the wedged child is escalated + relaunched.
+        wedged = (
+            "import json, os, signal, sys, time\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "hb = os.environ['EEGTPU_HEARTBEAT_FILE']\n"
+            "open(hb + '.tmp', 'w').write(json.dumps(\n"
+            "    {'phase': 'step', 'beat': 1, 't': time.time(),\n"
+            "     'pid': os.getpid()}))\n"
+            "os.replace(hb + '.tmp', hb)\n"
+            "if '--resume' not in sys.argv:\n"
+            "    time.sleep(60)\n"
+            "sys.exit(0)\n")
+        specs = self._specs(tmp_path, {"wedge": wedged,
+                                       "ok": self.BEATING_CHILD})
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.MultiSupervisor(
+                specs, policy=self._policy(
+                    grace_s=0.4, resume_arg="--resume",
+                    thresholds={"step": 0.5, "startup": 30.0}),
+                journal=jr)
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            self._wait(lambda: sup.children["wedge"].state == "done",
+                       what="wedged child killed, relaunched, completed")
+            assert sup.children["ok"].attempt == 1
+            sup.stop()
+            th.join(timeout=15.0)
+        events = schema.read_events(jr.events_path, complete=False)
+        hangs = [e for e in events if e["event"] == "supervisor_hang"]
+        assert hangs and all(e["child"] == "wedge" for e in hangs)
+        assert any(e["event"] == "supervisor_escalate"
+                   and e["child"] == "wedge" for e in events)
+        exits = [e for e in events if e["event"] == "supervisor_exit"
+                 and e["child"] == "wedge"]
+        assert exits[0]["classification"] == "hang"
 
 
 class TestSupervisedResumeRegression:

@@ -106,6 +106,38 @@ class TestEngine:
         whats = [e["what"] for e in events if e["event"] == "compile_end"]
         assert whats == ["serve_forward_b1", "serve_forward_b4"]
 
+    def test_warmup_persistent_cache_replays_on_second_engine(
+            self, tmp_path, monkeypatch):
+        """ISSUE-6 satellite: with EEGTPU_COMPILE_CACHE set, warmup
+        enables the persistent compilation cache (explicit opt-in, CPU
+        included) and journals ``compile`` events whose ``cache_hit``
+        flips to True once the executables exist — what makes replica
+        restarts and scale-out skip recompiles."""
+        monkeypatch.setenv("EEGTPU_COMPILE_CACHE", str(tmp_path / "cc"))
+        try:
+            with obs_journal.run(tmp_path / "obs", config={}) as jr:
+                model, params, bs = _variables()
+                InferenceEngine(model, params, bs, buckets=(1, 4),
+                                journal=jr).warmup()
+                # A NEW engine object (fresh jit) over the same program:
+                # the persistent cache, not the in-process one, must
+                # answer.
+                InferenceEngine(model, params, bs, buckets=(1, 4),
+                                journal=jr).warmup()
+            events = obs_journal.schema.read_events(jr.events_path)
+            compiles = [e for e in events if e["event"] == "compile"]
+            assert [e["what"] for e in compiles] == [
+                "serve_forward_b1", "serve_forward_b4"] * 2
+            assert [e["cache_hit"] for e in compiles[:2]] == [False, False]
+            assert [e["cache_hit"] for e in compiles[2:]] == [True, True]
+            assert all(e["cache_dir"] == str(tmp_path / "cc")
+                       for e in compiles)
+            assert not any("_schema_error" in e for e in events)
+        finally:
+            # The cache dir is a pytest tmp path: leaving the global jax
+            # config pointed at it would leak into every later test.
+            jax.config.update("jax_compilation_cache_dir", None)
+
     def test_digest_identifies_weights(self, tmp_path):
         a = InferenceEngine.from_checkpoint(_checkpoint(tmp_path, seed=0),
                                             buckets=(1,), warm=False)
@@ -369,9 +401,20 @@ class TestHTTPService:
             app.url + "/healthz", timeout=10).read())
         assert health["status"] == "ok"
         assert health["geometry"] == {"n_channels": C, "n_times": T}
+        # Fleet-router satellite: the canary-identity digest and live
+        # queue depths ride on /healthz — no separate endpoint.
+        assert health["variables_digest"] == app.registry.engine.digest
+        assert health["queue_depth_trials"] == 0
+        assert health["queue_depth_requests"] == 0
         metrics = json.loads(urllib.request.urlopen(
             app.url + "/metrics", timeout=10).read())
         obs_journal.schema.validate_metrics(metrics)
+        # Satellite: the batcher publishes LIVE queue-depth gauges (not
+        # just per-batch bucket_fill) — the request above must have left
+        # them registered and drained back to zero.
+        gauges = metrics["gauges"]
+        assert gauges["queue_depth_trials"][0]["value"] == 0
+        assert gauges["queue_depth_requests"][0]["value"] == 0
 
     def test_bad_shape_is_400_and_journaled(self, serve_app):
         app, jr, _ = serve_app
