@@ -61,6 +61,15 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "request": ("n_trials", "latency_ms", "status"),
     "model_swap": ("checkpoint", "digest"),
     "serve_end": ("n_requests", "rejected", "wall_s"),
+    # Streaming sessions (serve/sessions/): one stream's lifecycle, every
+    # window decision, the durable snapshot/restore pair, and the
+    # graceful-degradation record of a window that missed its deadline.
+    "session_start": ("session", "hop", "window"),
+    "session_window": ("session", "window", "status", "latency_ms"),
+    "window_expired": ("session", "window"),
+    "session_snapshot": ("path", "n_sessions"),
+    "session_resume": ("session", "acked"),
+    "session_end": ("session", "windows", "expired"),
     # Liveness (resil/heartbeat.py): throttled beats from long-lived
     # loops, and the circuit breaker's state machine (resil/breaker.py).
     "heartbeat": ("phase", "beat"),
@@ -308,6 +317,27 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         if lat:
             out["latency_p50_ms"] = round(lat[int(0.50 * (len(lat) - 1))], 3)
             out["latency_p95_ms"] = round(lat[int(0.95 * (len(lat) - 1))], 3)
+    # Streaming sessions: stream counts, per-window tail latency,
+    # deadline misses, and snapshot/resume activity — only reported for
+    # streams that actually served sessions.
+    session_starts = [e for e in events if e["event"] == "session_start"]
+    session_resumes = [e for e in events if e["event"] == "session_resume"]
+    windows = [e for e in events if e["event"] == "session_window"]
+    if session_starts or session_resumes or windows:
+        out["n_sessions"] = len({e["session"] for e in
+                                 session_starts + session_resumes})
+        out["session_windows"] = len(windows)
+        out["windows_expired"] = sum(
+            1 for e in windows if e.get("status") == "expired")
+        out["session_resumes"] = len(session_resumes)
+        out["session_snapshots"] = sum(
+            1 for e in events if e["event"] == "session_snapshot")
+        wlat = sorted(e["latency_ms"] for e in windows
+                      if e.get("status") == "ok"
+                      and isinstance(e.get("latency_ms"), numbers.Real))
+        if wlat:
+            out["window_p50_ms"] = round(wlat[int(0.50 * (len(wlat) - 1))], 3)
+            out["window_p95_ms"] = round(wlat[int(0.95 * (len(wlat) - 1))], 3)
     if injected:
         out["faults_injected"] = len(injected)
     if retries:

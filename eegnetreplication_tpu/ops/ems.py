@@ -234,6 +234,183 @@ def _ems_jit(x, factor_new, init_block_size, method):
     )
 
 
+@jax.jit
+def _stream_seed_stats(block: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """(mean, biased var) of the seed block — the EMA initial conditions."""
+    return jnp.mean(block, axis=-1), jnp.var(block, axis=-1)
+
+
+@jax.jit
+def _stream_chunk(m, v, mean0, a, c, eps, x_chunk):
+    """Advance the EMS recurrences over one chunk from carried state.
+
+    The step body is the exact ``method="scan"`` formulation of
+    :func:`exponential_moving_standardize`; because a sequential recurrence
+    has no reassociation freedom, splitting the scan at ANY chunk boundary
+    and threading ``(m, v)`` through reproduces the one-shot evaluation
+    bit for bit (the property ``tests/test_sessions.py`` pins, and the one
+    mid-stream resume depends on: resent samples re-standardize to the
+    same bytes).
+    """
+    z = x_chunk - mean0[..., None]
+
+    def step(carry, z_t):
+        m_prev, v_prev = carry
+        mm = c * m_prev + a * z_t
+        vv = c * v_prev + a * jnp.square(z_t - mm)
+        return (mm, vv), (mm, vv)
+
+    (m, v), (ms, vs) = jax.lax.scan(step, (m, v), jnp.moveaxis(z, -1, 0))
+    dev = z - jnp.moveaxis(ms, 0, -1)
+    out = dev / jnp.sqrt(jnp.moveaxis(vs, 0, -1) + eps)
+    return m, v, out
+
+
+class StreamingEMS:
+    """Chunk-resumable exponential-moving-standardization carrier.
+
+    The offline pipeline standardizes a COMPLETE recording in one call;
+    a live headset delivers the same recording a few samples at a time,
+    and the per-channel EMA state must survive both arbitrary chunking
+    and a process crash.  This carrier holds exactly that state: feed
+    ``(C, n)`` chunks to :meth:`push` and it returns the standardized
+    samples, byte-identical to
+    ``raw_exponential_moving_standardize(x, method="scan")`` over the
+    concatenated stream regardless of how the stream was chunked
+    (including one sample at a time) — a first-order recurrence evaluated
+    sequentially has no reassociation freedom, so a split-and-carry scan
+    reproduces the one-shot bytes exactly.
+
+    Until ``init_block_size`` samples have arrived the carrier buffers
+    raw input and emits nothing (the offline semantics seed the EMAs from
+    the first block's mean/variance, which cannot be known earlier); the
+    seeding push then emits everything buffered.  A stream shorter than
+    the block can be forced out with :meth:`flush`, which seeds from
+    whatever arrived — the ``block = min(init_block_size, T)`` clause of
+    the offline path.
+
+    The full carrier state round-trips through :meth:`state_arrays` /
+    :meth:`from_state` as a flat ndarray mapping, which is what the
+    serving session store snapshots (stamped, atomic, keep-N) so a
+    supervisor restart resumes the stream mid-recurrence.
+
+    Note: each distinct chunk length compiles its own scan program (jit
+    shape cache); stream with a bounded set of chunk sizes.
+    """
+
+    def __init__(self, n_channels: int, factor_new: float = 1e-3,
+                 init_block_size: int = 1000, eps: float = 1e-10):
+        if n_channels < 1:
+            raise ValueError(f"n_channels must be >= 1, got {n_channels}")
+        if init_block_size < 1:
+            raise ValueError(
+                f"init_block_size must be >= 1, got {init_block_size}")
+        self.n_channels = int(n_channels)
+        self.factor_new = float(factor_new)
+        self.init_block_size = int(init_block_size)
+        self.eps = float(eps)
+        self.n_seen = 0
+        self._buf: np.ndarray = np.zeros((self.n_channels, 0), np.float32)
+        self._mean0: np.ndarray | None = None  # seeded <=> not None
+        self._m: np.ndarray | None = None
+        self._v: np.ndarray | None = None
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def seeded(self) -> bool:
+        return self._mean0 is not None
+
+    @property
+    def n_emitted(self) -> int:
+        """Samples standardized and handed back so far."""
+        return self.n_seen if self.seeded else 0
+
+    # -- streaming --------------------------------------------------------
+    def _check_chunk(self, chunk) -> np.ndarray:
+        x = np.asarray(chunk, np.float32)
+        if x.ndim != 2 or x.shape[0] != self.n_channels:
+            raise ValueError(
+                f"expected a ({self.n_channels}, n) chunk, got "
+                f"{tuple(np.shape(chunk))}")
+        return x
+
+    def _seed_and_run(self, buffered: np.ndarray,
+                      block: int) -> np.ndarray:
+        mean0, var0 = _stream_seed_stats(jnp.asarray(buffered[:, :block]))
+        self._mean0 = np.asarray(mean0)
+        self._m = np.zeros_like(self._mean0)
+        self._v = np.asarray(var0)
+        self._buf = np.zeros((self.n_channels, 0), np.float32)
+        return self._advance(buffered)
+
+    def _advance(self, chunk: np.ndarray) -> np.ndarray:
+        m, v, out = _stream_chunk(
+            jnp.asarray(self._m), jnp.asarray(self._v),
+            jnp.asarray(self._mean0),
+            np.float32(self.factor_new), np.float32(1.0 - self.factor_new),
+            np.float32(self.eps), jnp.asarray(chunk))
+        self._m, self._v = np.asarray(m), np.asarray(v)
+        return np.asarray(out)
+
+    def push(self, chunk) -> np.ndarray:
+        """Ingest a ``(C, n)`` chunk; return the ``(C, k)`` standardized
+        samples this push released (``k = 0`` while the seed block is
+        still filling, then the whole backlog on the seeding push, then
+        ``k = n``)."""
+        x = self._check_chunk(chunk)
+        self.n_seen += x.shape[1]
+        if self.seeded:
+            if x.shape[1] == 0:
+                return x
+            return self._advance(x)
+        self._buf = np.concatenate([self._buf, x], axis=1)
+        if self._buf.shape[1] < self.init_block_size:
+            return np.zeros((self.n_channels, 0), np.float32)
+        return self._seed_and_run(self._buf, self.init_block_size)
+
+    def flush(self) -> np.ndarray:
+        """Seed from a short (< ``init_block_size``) buffered stream and
+        emit it — the offline ``block = min(init_block_size, T)``
+        behaviour for a stream that ended early.  No-op when already
+        seeded or nothing arrived."""
+        if self.seeded or self._buf.shape[1] == 0:
+            return np.zeros((self.n_channels, 0), np.float32)
+        return self._seed_and_run(self._buf, self._buf.shape[1])
+
+    # -- snapshot state ---------------------------------------------------
+    def state_arrays(self) -> dict[str, np.ndarray]:
+        """The complete carrier state as a flat ndarray mapping (the shape
+        ``resil.integrity.stamp`` signs and npz persists)."""
+        zeros = np.zeros(self.n_channels, np.float32)
+        return {
+            "n_channels": np.asarray(self.n_channels, np.int64),
+            "factor_new": np.asarray(self.factor_new, np.float64),
+            "init_block_size": np.asarray(self.init_block_size, np.int64),
+            "eps": np.asarray(self.eps, np.float64),
+            "n_seen": np.asarray(self.n_seen, np.int64),
+            "seeded": np.asarray(self.seeded, np.bool_),
+            "buf": self._buf,
+            "mean0": self._mean0 if self.seeded else zeros,
+            "m": self._m if self.seeded else zeros,
+            "v": self._v if self.seeded else zeros,
+        }
+
+    @classmethod
+    def from_state(cls, flat: dict) -> "StreamingEMS":
+        """Rebuild a carrier from :meth:`state_arrays` output; pushing the
+        post-snapshot remainder of a stream through it continues the
+        recurrences byte-identically."""
+        ems = cls(int(flat["n_channels"]), float(flat["factor_new"]),
+                  int(flat["init_block_size"]), float(flat["eps"]))
+        ems.n_seen = int(flat["n_seen"])
+        ems._buf = np.asarray(flat["buf"], np.float32)
+        if bool(flat["seeded"]):
+            ems._mean0 = np.asarray(flat["mean0"], np.float32)
+            ems._m = np.asarray(flat["m"], np.float32)
+            ems._v = np.asarray(flat["v"], np.float32)
+        return ems
+
+
 def raw_exponential_moving_standardize(
     x: np.ndarray, factor_new: float = 0.001, init_block_size: int = 1000,
     method: str = "associative",

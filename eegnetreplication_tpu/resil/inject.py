@@ -44,6 +44,12 @@ site                  action     effect
 ``serve.hang``        sleep      same stall in the serve batcher worker
                                  before its inference dispatch (wedges
                                  the worker; ``/healthz`` degrades)
+``session.snapshot``  corrupt    garble the staged session-store snapshot
+                                 bytes (crash mid-``tmp.replace`` over the
+                                 streaming sessions' durable state)
+``session.restore``   raise      ``OSError`` while restoring sessions at
+                                 startup (transient read fault — the
+                                 restore path must survive or degrade)
 ====================  =========  ==========================================
 
 Chaos plans (the ``--chaos`` flag) are comma-separated site specs with
@@ -71,7 +77,7 @@ from eegnetreplication_tpu.utils.logging import logger
 # instead of silently never firing.
 SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
          "host.preempt", "train.chunk", "serve.forward", "train.hang",
-         "serve.hang")
+         "serve.hang", "session.snapshot", "session.restore")
 
 ACTIONS = ("raise", "corrupt", "preempt", "sleep")
 
@@ -110,6 +116,10 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                       "serve.forward, hit {hit})"),
     "train.hang": ("sleep", None, "injected hang: train.hang (hit {hit})"),
     "serve.hang": ("sleep", None, "injected hang: serve.hang (hit {hit})"),
+    "session.snapshot": ("corrupt", "OSError",
+                         "injected fault: session.snapshot (hit {hit})"),
+    "session.restore": ("raise", "OSError",
+                        "injected fault: session.restore (hit {hit})"),
 }
 
 

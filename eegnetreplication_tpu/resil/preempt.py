@@ -44,6 +44,43 @@ class Preempted(RuntimeError):
 _flag = threading.Event()
 _reason: str | None = None
 
+# Drain hooks: callables a long-lived subsystem registers so that a
+# graceful stop flushes its durable state even when the subsystem's own
+# stop path is bypassed (e.g. a Preempted exception unwinding past it).
+# ``guard()`` runs them when it exits with a stop requested; callers with
+# an orderly shutdown path (ServeApp.stop) may also run them directly —
+# hooks must therefore be idempotent.
+_drain_hooks: list = []
+_drain_lock = threading.Lock()
+
+
+def add_drain_hook(fn) -> None:
+    """Register ``fn()`` to run at graceful-stop drain time.  Hooks must
+    be idempotent and exception-safe from the caller's point of view
+    (failures are logged, never raised — a broken flush must not mask the
+    preemption exit path)."""
+    with _drain_lock:
+        if fn not in _drain_hooks:
+            _drain_hooks.append(fn)
+
+
+def remove_drain_hook(fn) -> None:
+    """Unregister a drain hook (no-op when absent)."""
+    with _drain_lock:
+        if fn in _drain_hooks:
+            _drain_hooks.remove(fn)
+
+
+def run_drain_hooks() -> None:
+    """Run every registered drain hook, logging (not raising) failures."""
+    with _drain_lock:
+        hooks = list(_drain_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception as exc:  # noqa: BLE001 — drain must complete
+            logger.warning("Preemption drain hook %r failed: %s", fn, exc)
+
 
 def request(reason: str = "signal") -> None:
     """Flag a stop request (called from signal handlers and the
@@ -58,11 +95,14 @@ def requested() -> bool:
 
 
 def clear() -> None:
-    """Reset the flag (test teardown / between drill legs — the flag is
-    process-global)."""
+    """Reset the module's process-global state — the stop flag AND the
+    registered drain hooks (test teardown / between drill legs; a hook
+    from a torn-down subsystem must not fire in the next leg)."""
     global _reason
     _reason = None
     _flag.clear()
+    with _drain_lock:
+        _drain_hooks.clear()
 
 
 def check(**ctx) -> None:
@@ -122,3 +162,11 @@ def guard(signals: tuple[int, ...] = (signal.SIGTERM, signal.SIGINT)
     finally:
         for sig, prev in previous.items():
             signal.signal(sig, prev)
+        # A guarded entry point that stops gracefully drains every
+        # registered hook on the way out (session snapshots, future
+        # flush-on-preempt consumers) — even when the stop surfaced as a
+        # Preempted exception that unwound past the subsystem's own
+        # shutdown path.  Hooks are idempotent by contract, so an
+        # orderly stop that already flushed costs one cheap re-flush.
+        if _flag.is_set():
+            run_drain_hooks()

@@ -23,6 +23,12 @@ Serving decomposes into four pieces, each independently testable:
   (``POST /predict``, ``POST /reload``, ``GET /healthz``,
   ``GET /metrics``), graceful SIGTERM drain via ``resil.preempt``, and
   the ``serve.forward`` chaos site under the shared retry policy.
+- :mod:`~eegnetreplication_tpu.serve.sessions` — durable streaming BCI
+  sessions (the paper's live-headset workload): per-stream EMS carry +
+  sliding-window state, snapshotted through ``resil.integrity`` with
+  keep-N generations so a supervised restart resumes mid-stream with a
+  byte-identical decision stream (``POST /session/*`` on the same
+  server).
 
 Every request flows through obs (latency/queue-depth/bucket-occupancy
 metrics, ``serve_start``/``request``/``model_swap``/``serve_end`` journal
@@ -40,10 +46,16 @@ from eegnetreplication_tpu.serve.engine import (
 )
 from eegnetreplication_tpu.serve.registry import ModelRegistry
 from eegnetreplication_tpu.serve.service import ServeApp, serve_until_preempted
+from eegnetreplication_tpu.serve.sessions import (
+    SessionStore,
+    StreamSession,
+    WindowDecision,
+)
 
 __all__ = [
     "DEFAULT_BUCKETS", "InferenceEngine", "bucket_ladder",
     "load_model_from_checkpoint", "variables_digest",
     "MicroBatcher", "Rejected", "ModelRegistry",
     "ServeApp", "serve_until_preempted",
+    "SessionStore", "StreamSession", "WindowDecision",
 ]

@@ -18,6 +18,26 @@ run is observable (obs) and survivable (resil):
   worker's heartbeat is stale, so external orchestrators can act.
 - ``GET /metrics`` — the run's metrics-registry snapshot (schema-valid).
 
+Streaming sessions (``serve/sessions/``) — the stateful workload:
+
+- ``POST /session/open`` — ``{"session": id?, "hop": n, ...}``: create or
+  re-attach; the response's ``acked`` cursor is the resume contract.
+- ``POST /session/<id>/samples`` — raw little-endian float32 ``(C, n)``
+  bytes or ``{"samples": [[...]]}``: push samples through the session's
+  EMS carry; every window that completes routes through the shared
+  micro-batcher under the session's per-window deadline.  A late window
+  is journaled ``window_expired`` and answered ``pred=-1`` — the stream
+  keeps going (graceful degradation, not stream death).
+- ``GET /session/<id>/state`` — the resume cursor + decision counters.
+- ``POST /session/<id>/close`` — flush, journal ``session_end``, return
+  the full decision stream.
+
+Session state snapshots periodically and at the SIGTERM drain through
+``resil.integrity`` (stamped, atomic, keep-N generations); a supervised
+restart with ``--resume`` restores the newest valid generation and
+clients replay from their acked cursor — the chunk-invariant EMS carrier
+makes the resumed decision stream byte-identical to an uninterrupted run.
+
 A :class:`~eegnetreplication_tpu.resil.breaker.CircuitBreaker` guards
 ``serve.forward``: consecutive post-retry failures open it and /predict
 answers fast 503s without touching the queue or the device; after the
@@ -63,6 +83,12 @@ from eegnetreplication_tpu.serve.batcher import (
 )
 from eegnetreplication_tpu.serve.engine import CLASS_NAMES, DEFAULT_BUCKETS
 from eegnetreplication_tpu.serve.registry import ModelRegistry
+from eegnetreplication_tpu.serve.sessions import SessionStore, WindowDecision
+from eegnetreplication_tpu.serve.sessions.session import (
+    STATUS_ERROR,
+    STATUS_EXPIRED,
+    STATUS_OK,
+)
 from eegnetreplication_tpu.utils.logging import logger
 
 # Short in-process budget: a device hiccup is worth two spaced re-runs of
@@ -123,12 +149,27 @@ class ServeApp:
                  request_timeout_s: float = 30.0, journal=None,
                  breaker_threshold: int = 5,
                  breaker_reset_s: float = 30.0,
-                 watchdog_thresholds: dict | None = None):
+                 watchdog_thresholds: dict | None = None,
+                 sessions_dir: str | Path | None = None,
+                 session_snapshot_every: int = 50,
+                 resume: bool = False):
         self.journal = journal if journal is not None \
             else obs_journal.current()
         self.checkpoint = str(checkpoint)
         self.registry = ModelRegistry(tuple(buckets), journal=self.journal)
         self.registry.load(checkpoint)
+        # Streaming sessions: durable when sessions_dir is given (the CLI
+        # always passes one), in-memory otherwise.  --resume restores the
+        # newest valid snapshot generation BEFORE the listener binds, so a
+        # resuming client's first poll already sees its acked cursor.
+        self.sessions_dir = Path(sessions_dir) if sessions_dir else None
+        self.sessions = SessionStore(
+            self.sessions_dir / "sessions.npz" if self.sessions_dir
+            else None,
+            snapshot_every_windows=session_snapshot_every,
+            journal=self.journal)
+        if resume:
+            self.sessions.restore()
         # Liveness + failure-domain hardening: the worker's heartbeat (an
         # in-process emitter, plus the EEGTPU_HEARTBEAT_FILE file when a
         # supervisor configured one) feeds /healthz staleness; the
@@ -158,6 +199,9 @@ class ServeApp:
         self._n_errors = 0
         self._n_expired = 0
         self._n_circuit_open = 0
+        self._n_sessions_opened = 0
+        self._n_session_windows = 0
+        self._n_windows_expired = 0
         self._inflight = 0
         self._idle = threading.Condition(self._stats_lock)
         self._t_start = time.perf_counter()
@@ -193,6 +237,9 @@ class ServeApp:
             max_wait_ms=self.batcher.max_wait_s * 1000.0,
             max_queue_trials=self.batcher.max_queue_trials,
             digest=self.registry.engine.digest,
+            sessions_dir=(str(self.sessions_dir)
+                          if self.sessions_dir else None),
+            sessions_restored=len(self.sessions.restored),
             host=self.address[0], port=self.address[1])
         logger.info("Serving %s at %s (buckets %s)", self.checkpoint,
                     self.url, self.registry.buckets)
@@ -227,10 +274,24 @@ class ServeApp:
             n_req, n_rej, n_err = (self._n_requests, self._n_rejected,
                                    self._n_errors)
             n_exp, n_open = self._n_expired, self._n_circuit_open
+            n_sess, n_win, n_wexp = (self._n_sessions_opened,
+                                     self._n_session_windows,
+                                     self._n_windows_expired)
+        # The final session snapshot lands AFTER the handler wait: every
+        # in-flight ingest has recorded its decisions, so the drained
+        # snapshot is the complete durable state a --resume restores.
+        # Any background periodic snapshot finishes first so the drain's
+        # write (and journal event) is the terminal one.
+        self.sessions.drain_background()
+        self.sessions.snapshot()
+        self.sessions.detach()
         self.journal.event("serve_end", n_requests=n_req, rejected=n_rej,
                            errors=n_err, expired=n_exp,
                            circuit_open=n_open,
                            breaker_trips=self.breaker.trips,
+                           sessions=n_sess, session_windows=n_win,
+                           windows_expired=n_wexp,
+                           session_snapshots=self.sessions.snapshots,
                            wall_s=round(time.perf_counter() - self._t_start,
                                         3),
                            model_swaps=self.registry.swaps)
@@ -268,6 +329,72 @@ class ServeApp:
         self.journal.metrics.inc("requests_total", status=status)
         if status == "ok":
             self.journal.metrics.observe("request_latency_ms", latency_ms)
+
+    # -- streaming sessions (called from handler threads) ------------------
+    def decide_windows(self, session, ready) -> list[WindowDecision]:
+        """Route freshly completed windows through the shared batcher and
+        record one decision per window, in window order.
+
+        All windows are submitted before any result is awaited, so a
+        burst of windows from one chunk coalesces into one forward.  The
+        session's per-window deadline starts at submit time and is
+        enforced twice, exactly like ``/predict``: at batcher dequeue
+        (the forward never runs for an already-late window) and at
+        response time.  Expired/errored windows record ``pred=-1`` and
+        the stream continues — one late decision must not kill a live
+        session.  Caller holds ``session.lock``.
+        """
+        submitted = []
+        for index, start, win in ready:
+            t0 = time.perf_counter()
+            deadline = (None if session.deadline_ms is None
+                        else time.monotonic() + session.deadline_ms / 1000.0)
+            try:
+                fut = self.batcher.submit(win[None], deadline=deadline)
+            except Rejected:
+                fut = None
+            submitted.append((index, start, t0, deadline, fut))
+        decisions = []
+        for index, start, t0, deadline, fut in submitted:
+            status, pred = STATUS_ERROR, -1
+            if fut is not None:
+                try:
+                    preds = fut.result(timeout=self.request_timeout_s)
+                    if deadline is not None and time.monotonic() > deadline:
+                        status = STATUS_EXPIRED  # answered, but too late
+                    else:
+                        status, pred = STATUS_OK, int(preds[0])
+                except DeadlineExceeded:
+                    status = STATUS_EXPIRED
+                except Exception:  # noqa: BLE001 — recorded, not raised
+                    status = STATUS_ERROR
+            latency_ms = (time.perf_counter() - t0) * 1000.0
+            decision = WindowDecision(index=index, start=start, pred=pred,
+                                      status=status, latency_ms=latency_ms)
+            session.record(decision)
+            decisions.append(decision)
+            self.journal.event("session_window", session=session.session_id,
+                               window=index, start=start, status=status,
+                               pred=pred,
+                               latency_ms=round(latency_ms, 3))
+            self.journal.metrics.inc("session_windows", status=status)
+            if status == STATUS_OK:
+                self.journal.metrics.observe("window_latency_ms", latency_ms)
+            elif status == STATUS_EXPIRED:
+                self.journal.event("window_expired",
+                                   session=session.session_id,
+                                   window=index,
+                                   deadline_ms=session.deadline_ms,
+                                   latency_ms=round(latency_ms, 3))
+            with self._stats_lock:
+                self._n_session_windows += 1
+                if status == STATUS_EXPIRED:
+                    self._n_windows_expired += 1
+        return decisions
+
+    def count_session_opened(self) -> None:
+        with self._stats_lock:
+            self._n_sessions_opened += 1
 
 
 class JsonRequestHandler(BaseHTTPRequestHandler):
@@ -364,6 +491,10 @@ class _ServeHandler(JsonRequestHandler):
             self._reply(200, app.journal.metrics.snapshot(
                 run_id=app.journal.run_id))
             return
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "session" and parts[2] == "state":
+            self._session_state(app, parts[1])
+            return
         self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):  # noqa: N802 — stdlib naming
@@ -378,6 +509,17 @@ class _ServeHandler(JsonRequestHandler):
             if self.path == "/reload":
                 self._reload(app)
                 return
+            parts = self.path.strip("/").split("/")
+            if parts[0] == "session":
+                if len(parts) == 2 and parts[1] == "open":
+                    self._session_open(app)
+                    return
+                if len(parts) == 3 and parts[2] == "samples":
+                    self._session_samples(app, parts[1])
+                    return
+                if len(parts) == 3 and parts[2] == "close":
+                    self._session_close(app, parts[1])
+                    return
             self._reply(404, {"error": f"unknown path {self.path}"})
         finally:
             app.end_request()
@@ -527,6 +669,139 @@ class _ServeHandler(JsonRequestHandler):
                           "model_digest": engine.digest,
                           "model_swaps": app.registry.swaps})
 
+    # -- streaming session routes ------------------------------------------
+    def _session_json(self, session, **extra) -> dict:
+        return {"session": session.session_id, "acked": session.acked,
+                "windows": session.windows_decided,
+                "expired": session.n_expired,
+                "seeded": session.ems.seeded,
+                "window": session.window, "hop": session.hop,
+                "deadline_ms": session.deadline_ms, **extra}
+
+    def _session_open(self, app: ServeApp) -> None:
+        try:
+            payload = json.loads(self._read_body().decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            sid = payload.get("session") or os.urandom(6).hex()
+            c, t = app.registry.engine.geometry
+            window = int(payload.get("window", t))
+            if window != t:
+                raise ValueError(
+                    f"window must equal the model's input length ({t}), "
+                    f"got {window}")
+            hop = int(payload.get("hop", max(1, t // 4)))
+            deadline_ms = payload.get("deadline_ms")
+            session, resumed = app.sessions.open(
+                sid, n_channels=c, window=window, hop=hop,
+                deadline_ms=(None if deadline_ms is None
+                             else float(deadline_ms)),
+                ems_factor_new=float(payload.get("ems_factor_new", 1e-3)),
+                ems_init_block_size=int(
+                    payload.get("ems_init_block_size", 1000)),
+                ems_eps=float(payload.get("ems_eps", 1e-10)))
+        except Exception as exc:  # noqa: BLE001 — client error
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        if not resumed:
+            app.count_session_opened()
+            app.journal.event("session_start", session=session.session_id,
+                              hop=session.hop, window=session.window,
+                              deadline_ms=session.deadline_ms,
+                              n_channels=session.n_channels)
+            app.journal.metrics.inc("sessions_opened")
+        # A re-open of a restored (or still-live) session returns the
+        # acked cursor unchanged: this response IS the resume handshake —
+        # the client replays its stream from byte offset acked*C*4.
+        self._reply(200, self._session_json(
+            session, resumed=resumed, n_channels=session.n_channels,
+            class_names=list(CLASS_NAMES)))
+
+    def _get_session(self, app: ServeApp, sid: str):
+        try:
+            return app.sessions.get(sid)
+        except KeyError:
+            self._reply(404, {"error": f"unknown session {sid!r}"})
+            return None
+
+    def _parse_samples(self, session, body: bytes) -> np.ndarray:
+        """A ``(C, n)`` chunk from raw little-endian float32 bytes (C-order,
+        channel-major) or ``{"samples": [[...]]}`` JSON."""
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        c = session.n_channels
+        if ctype == "application/json":
+            payload = json.loads(body.decode())
+            if not isinstance(payload, dict) or "samples" not in payload:
+                raise ValueError('JSON body must be {"samples": [[...]]}')
+            x = np.asarray(payload["samples"], np.float32)
+        else:
+            if len(body) % (4 * c):
+                raise ValueError(
+                    f"raw body length {len(body)} is not a whole number of "
+                    f"float32 ({c}, n) samples")
+            x = np.frombuffer(body, np.dtype("<f4")).reshape(c, -1)
+        if x.ndim != 2 or x.shape[0] != c:
+            raise ValueError(
+                f"expected a ({c}, n) chunk, got {tuple(x.shape)}")
+        return x
+
+    def _session_samples(self, app: ServeApp, sid: str) -> None:
+        session = self._get_session(app, sid)
+        if session is None:
+            return
+        try:
+            chunk = self._parse_samples(session, self._read_body())
+        except Exception as exc:  # noqa: BLE001 — client error
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        with session.lock:
+            ready = session.ingest(chunk)
+            decisions = app.decide_windows(session, ready)
+            reply = self._session_json(
+                session,
+                decisions=[d.as_json() for d in decisions])
+        app.sessions.maybe_snapshot()
+        self._reply(200, reply)
+
+    def _session_state(self, app: ServeApp, sid: str) -> None:
+        app.begin_request()
+        try:
+            session = self._get_session(app, sid)
+            if session is None:
+                return
+            with session.lock:
+                tail = [d.as_json() for d in session.decisions[-16:]]
+                self._reply(200, self._session_json(
+                    session, decisions_tail=tail,
+                    model_digest=app.registry.engine.digest))
+        finally:
+            app.end_request()
+
+    def _session_close(self, app: ServeApp, sid: str) -> None:
+        # Claim the session FIRST: racing closes must yield one winner
+        # (which drains and journals) and one clean 404, not a KeyError
+        # 500 and a doubled session_end.
+        session = app.sessions.take(sid)
+        if session is None:
+            self._reply(404, {"error": f"unknown session {sid!r}"})
+            return
+        with session.lock:
+            ready = session.finish()
+            app.decide_windows(session, ready)
+            preds = [int(p) for p in session.preds()]
+            reply = self._session_json(session, preds=preds,
+                                       preds_offset=session.preds_offset,
+                                       class_names=list(CLASS_NAMES))
+            app.journal.event("session_end", session=session.session_id,
+                              windows=session.windows_decided,
+                              expired=session.n_expired,
+                              acked=session.acked)
+            app.journal.metrics.inc("sessions_closed")
+        # Persist the now-smaller table so a restart cannot resurrect the
+        # closed stream.
+        app.sessions.snapshot()
+        self._reply(200, reply)
+
 
 def serve_until_preempted(app: ServeApp, poll_s: float = 0.2) -> None:
     """Block until a graceful-stop request (SIGTERM/SIGINT under
@@ -573,12 +848,22 @@ def main(argv=None) -> int:
                              "probe requests are admitted.")
     parser.add_argument("--metricsDir", type=str, default=None,
                         help="Run-journal root (default reports/obs).")
+    parser.add_argument("--sessionsDir", type=str, default=None,
+                        help="Durable session-snapshot directory (default "
+                             "checkpoints/serve_sessions under the data "
+                             "root).  Must be stable across restarts — it "
+                             "is what --resume restores from.")
+    parser.add_argument("--sessionSnapshotEvery", type=int, default=50,
+                        help="Snapshot session state every N decided "
+                             "windows (plus at every close and at the "
+                             "SIGTERM drain).")
     parser.add_argument("--resume", action="store_true",
-                        help="Accepted for supervisor compatibility "
-                             "(eegtpu-supervise appends it on relaunch): "
-                             "serving has no snapshot to resume — a "
-                             "relaunch simply serves the checkpoint "
-                             "again.")
+                        help="Restore streaming sessions from the newest "
+                             "valid snapshot generation in --sessionsDir "
+                             "(eegtpu-supervise appends this on relaunch); "
+                             "clients then replay from their acked "
+                             "cursor.  Stateless /predict serving needs "
+                             "nothing restored.")
     args = parser.parse_args(argv)
 
     try:
@@ -593,13 +878,18 @@ def main(argv=None) -> int:
 
     metrics_dir = (Path(args.metricsDir) if args.metricsDir
                    else Paths.from_here().reports / "obs")
+    sessions_dir = (Path(args.sessionsDir) if args.sessionsDir
+                    else Paths.from_here().checkpoints / "serve_sessions")
     with obs_journal.run(metrics_dir, config=vars(args)) as journal, \
             preempt.guard():
         app = ServeApp(args.checkpoint, host=args.host, port=args.port,
                        buckets=buckets, max_wait_ms=args.maxWaitMs,
                        max_queue_trials=args.maxQueue,
                        breaker_threshold=args.breakerThreshold,
-                       breaker_reset_s=args.breakerResetS, journal=journal)
+                       breaker_reset_s=args.breakerResetS,
+                       sessions_dir=sessions_dir,
+                       session_snapshot_every=args.sessionSnapshotEvery,
+                       resume=args.resume, journal=journal)
         app.start()
         print(f"serving at {app.url}", flush=True)
         serve_until_preempted(app)

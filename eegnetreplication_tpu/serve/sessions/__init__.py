@@ -1,0 +1,39 @@
+"""Durable streaming BCI sessions: stateful serving with mid-stream resume.
+
+The request/response serving stack (engine, batcher, fleet) is stateless:
+every ``/predict`` carries pre-epoched trials and nothing outlives the
+response.  The paper's deployment scenario is the opposite — a live EEG
+headset streaming 22-channel samples at 250 Hz — and a live stream has
+state the process must not lose: the exponential-moving-standardization
+carry, the partial sliding window, the decision cursor.  This package
+makes that state a first-class durable artifact under the same integrity
+and preemption contracts as training checkpoints:
+
+- :mod:`~eegnetreplication_tpu.serve.sessions.session` — one stream's
+  state: a chunk-resumable EMS carrier
+  (:class:`~eegnetreplication_tpu.ops.ems.StreamingEMS`), a sliding
+  257-sample window with configurable hop, and the append-only decision
+  record.  Chunking-invariant by construction, so a resumed stream
+  re-standardizes resent samples to the same bytes.
+- :mod:`~eegnetreplication_tpu.serve.sessions.store` — the durability
+  layer: every session's flat ndarray state snapshotted into one
+  sha256-stamped npz (atomic tmp+rename, keep-N generations, corrupt
+  generations quarantined with fallback — the
+  ``training/checkpoint.py`` snapshot contract), restored on a
+  supervised restart so clients resume mid-stream from the last acked
+  sample index.
+
+The HTTP surface (``POST /session/open``, ``POST /session/<id>/samples``,
+``GET /session/<id>/state``, ``POST /session/<id>/close``) lives in
+:mod:`~eegnetreplication_tpu.serve.service`; windows route through the
+existing warm engine + micro-batcher with per-window deadlines (a late
+window journals ``window_expired`` and the stream keeps going).
+"""
+
+from eegnetreplication_tpu.serve.sessions.session import (
+    StreamSession,
+    WindowDecision,
+)
+from eegnetreplication_tpu.serve.sessions.store import SessionStore
+
+__all__ = ["StreamSession", "WindowDecision", "SessionStore"]

@@ -1,0 +1,297 @@
+"""Session store: crash-consistent snapshots of every live stream.
+
+Per-session state (EMS carry, window buffer, decision record) dies with
+the process unless something writes it down — and a supervisor restart,
+the exact recovery path the resilience stack exists for, would then
+silently corrupt or drop a live decoding stream.  The store persists ALL
+live sessions into one flat npz under the same contracts as training
+checkpoints:
+
+- sha256 content digest embedded and verified
+  (:mod:`~eegnetreplication_tpu.resil.integrity`);
+- atomic same-directory tmp + rename (a crash mid-write can only damage
+  the staged file);
+- keep-N generation rotation with quarantine-and-fallback on a corrupt
+  newest generation
+  (:func:`~eegnetreplication_tpu.training.checkpoint.rotate_generations` /
+  :func:`~eegnetreplication_tpu.training.checkpoint.resolve_snapshot` —
+  the same machinery, not a reimplementation);
+- the ``session.snapshot`` / ``session.restore`` chaos sites, so the
+  whole corrupt-write -> quarantine -> previous-generation path is
+  deterministically drillable.
+
+Snapshots happen periodically (every ``snapshot_every_windows`` decided
+windows, amortized across sessions), at every session close, and at the
+SIGTERM drain (the store registers a :mod:`~eegnetreplication_tpu.resil.preempt`
+drain hook).  ``restore()`` runs once at startup under ``--resume``:
+clients then read their last-acked sample cursor from
+``GET /session/<id>/state`` and replay from there — the chunking-invariant
+EMS carrier turns the replayed suffix into byte-identical windows, so
+every window decided ``ok`` after the resume carries the prediction an
+uninterrupted run would have produced.  (Degraded ``expired``/``error``
+statuses are timing statements about the load at delivery, not about the
+signal: a window that expired just before the crash may heal to ``ok``
+when the replay re-decides it.)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import inject, integrity, preempt
+from eegnetreplication_tpu.resil import retry as resil_retry
+from eegnetreplication_tpu.serve.sessions.session import StreamSession
+from eegnetreplication_tpu.training.checkpoint import (
+    resolve_snapshot,
+    rotate_generations,
+    snapshot_keep,
+)
+from eegnetreplication_tpu.utils.logging import logger
+
+# Session ids travel in URL paths and become npz key prefixes; constrain
+# them so neither layer needs escaping.
+_SESSION_ID_RE = re.compile(r"^[A-Za-z0-9_-]{1,64}$")
+
+# Restoring at startup is worth a couple of spaced re-reads (the
+# session.restore chaos site injects exactly this transient shape), but a
+# deterministic failure must fall through fast — the serving process is
+# mid-boot.
+RESTORE_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.05,
+                                        max_delay_s=1.0)
+
+
+def valid_session_id(session_id: str) -> bool:
+    return bool(_SESSION_ID_RE.match(session_id or ""))
+
+
+class SessionStore:
+    """Live sessions + their durable snapshot chain.
+
+    ``path`` names the snapshot file (``<dir>/sessions.npz``); ``None``
+    runs the store in-memory only (sessions work, nothing survives a
+    restart — test/bench convenience, never the served default).
+    """
+
+    def __init__(self, path: str | Path | None, *, keep: int | None = None,
+                 snapshot_every_windows: int = 50, journal=None):
+        self.path = Path(path) if path is not None else None
+        self.keep = keep
+        self.snapshot_every_windows = max(1, int(snapshot_every_windows))
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._lock = threading.Lock()          # the session table
+        self._snap_lock = threading.Lock()     # serializes snapshot writes
+        # At most ONE periodic background snapshot in flight: a second
+        # threshold crossing while one runs is simply absorbed by it (the
+        # write captures the then-current state) or by the next trigger.
+        self._async_snap = threading.Semaphore(1)
+        self._sessions: dict[str, StreamSession] = {}
+        self._windows_at_last_snap = 0
+        self.snapshots = 0
+        self.restored: list[str] = []
+        # Graceful-stop drain: a preempted process flushes session state
+        # even when the stop unwinds past ServeApp.stop (hooks are
+        # idempotent — an orderly stop just re-flushes cheaply).
+        preempt.add_drain_hook(self.snapshot)
+
+    # -- session table ----------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._sessions)
+
+    def get(self, session_id: str) -> StreamSession:
+        with self._lock:
+            return self._sessions[session_id]  # KeyError -> 404 upstream
+
+    def open(self, session_id: str, **session_kwargs
+             ) -> tuple[StreamSession, bool]:
+        """Create (or re-attach to) a session; returns ``(session,
+        resumed)``.  Opening an id that already exists — typically one
+        restored from a snapshot — re-attaches WITHOUT touching its
+        state, so a client's post-restart open is how it learns its
+        resume cursor."""
+        if not valid_session_id(session_id):
+            raise ValueError(
+                f"invalid session id {session_id!r} (want 1-64 chars of "
+                "[A-Za-z0-9_-])")
+        with self._lock:
+            existing = self._sessions.get(session_id)
+            if existing is not None:
+                return existing, True
+            session = StreamSession(session_id, **session_kwargs)
+            self._sessions[session_id] = session
+            return session, False
+
+    def take(self, session_id: str) -> StreamSession | None:
+        """Atomically claim a session out of the table (``None`` when it
+        is already gone) — the winner of racing closes gets the session,
+        the loser gets a clean miss instead of a KeyError."""
+        with self._lock:
+            return self._sessions.pop(session_id, None)
+
+    def close(self, session_id: str) -> StreamSession | None:
+        """Remove a session from the table (its terminal summary is the
+        caller's to journal) and persist the now-smaller table so a
+        restart does not resurrect the closed stream."""
+        session = self.take(session_id)
+        self.snapshot()
+        return session
+
+    # -- durability -------------------------------------------------------
+    def _flatten(self) -> tuple[dict[str, np.ndarray], int, int]:
+        """One flat mapping over every live session (each under its
+        session lock, so no ingest can interleave with its serialization).
+        """
+        flat: dict[str, np.ndarray] = {}
+        total_windows = 0
+        with self._lock:
+            sessions = dict(self._sessions)
+        for sid in sorted(sessions):
+            session = sessions[sid]
+            with session.lock:
+                state = session.state_arrays()
+                total_windows += session.windows_decided
+            for key, value in state.items():
+                flat[f"s/{sid}/{key}"] = value
+        flat["__meta__"] = np.frombuffer(json.dumps(
+            {"sessions": sorted(sessions)}).encode(), dtype=np.uint8)
+        return flat, total_windows, len(sessions)
+
+    def snapshot(self) -> Path | None:
+        """Persist every live session (stamped, atomic, rotated); returns
+        the snapshot path or ``None`` for an in-memory store.  Safe to
+        call from any thread and idempotent — the drain hook, the
+        periodic trigger, and close() all land here."""
+        if self.path is None:
+            return None
+        with self._snap_lock:
+            flat, total_windows, n_sessions = self._flatten()
+            integrity.stamp(flat)
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(self.path.suffix + ".tmp")
+            with open(tmp, "wb") as fh:
+                np.savez(fh, **flat)
+            # The chaos site garbles the STAGED bytes — the exact shape of
+            # a crash mid-tmp.replace — so the drill proves restore falls
+            # back through the generation chain.
+            inject.fire("session.snapshot", path=tmp,
+                        n_sessions=n_sessions)
+            rotate_generations(
+                self.path, self.keep if self.keep is not None
+                else snapshot_keep())
+            tmp.replace(self.path)
+            self.snapshots += 1
+            self._windows_at_last_snap = total_windows
+            # Journal INSIDE the write lock: a background periodic
+            # snapshot racing the drain snapshot must emit its event
+            # before the drain's (and so always before serve_end).
+            self._journal.event("session_snapshot", path=str(self.path),
+                                n_sessions=n_sessions,
+                                n_windows=total_windows)
+            self._journal.metrics.inc("session_snapshots")
+            logger.debug("Session snapshot: %d session(s), %d decided "
+                         "window(s) -> %s", n_sessions, total_windows,
+                         self.path)
+        return self.path
+
+    def maybe_snapshot(self) -> bool:
+        """Kick off a BACKGROUND snapshot when ``snapshot_every_windows``
+        new windows have been decided since the last one (called from the
+        ``/samples`` handler).  Asynchronous on purpose: the serialize +
+        sha256 + npz write must never sit on a streaming client's reply
+        path, and ``_flatten`` takes every session's lock — a slow
+        session must not couple into another session's real-time
+        latency.  Returns whether a snapshot was scheduled."""
+        if self.path is None:
+            return False
+        with self._lock:
+            total = sum(s.windows_decided for s in self._sessions.values())
+        if total - self._windows_at_last_snap < self.snapshot_every_windows:
+            return False
+        if not self._async_snap.acquire(blocking=False):
+            return False  # one already in flight; it captures this state
+
+        def _run():
+            try:
+                self.snapshot()
+            except Exception as exc:  # noqa: BLE001 — periodic, retried
+                logger.warning("Background session snapshot failed: %s",
+                               exc)
+            finally:
+                self._async_snap.release()
+
+        threading.Thread(target=_run, name="session-snapshot",
+                         daemon=True).start()
+        return True
+
+    def drain_background(self, timeout: float = 30.0) -> None:
+        """Wait for any in-flight background snapshot (shutdown path: the
+        drain snapshot and its journal event must come LAST)."""
+        if self._async_snap.acquire(timeout=timeout):
+            self._async_snap.release()
+        else:
+            logger.warning("Background session snapshot still running "
+                           "after %.1fs", timeout)
+
+    def restore(self) -> list[str]:
+        """Load the newest valid snapshot generation (quarantining corrupt
+        ones and falling back — :func:`resolve_snapshot`); returns the
+        restored session ids.  Missing snapshot = clean start."""
+        if self.path is None:
+            return []
+
+        def _resolve():
+            inject.fire("session.restore", path=self.path)
+            return resolve_snapshot(self.path, consume=True)
+
+        try:
+            resolved = resil_retry.call(_resolve, policy=RESTORE_RETRY,
+                                        site="session.restore")
+        except FileNotFoundError:
+            return []
+        except Exception as exc:  # noqa: BLE001 — boot must not die on this
+            logger.warning("Session restore failed (%s); starting with no "
+                           "sessions", exc)
+            return []
+        if resolved is None:
+            return []
+        resolved_path, flat = resolved
+        flat.pop(integrity.DIGEST_KEY, None)
+        meta = json.loads(bytes(flat.pop("__meta__")).decode())
+        restored = []
+        for sid in meta.get("sessions", []):
+            prefix = f"s/{sid}/"
+            state = {k[len(prefix):]: v for k, v in flat.items()
+                     if k.startswith(prefix)}
+            session = StreamSession.from_state(sid, state)
+            with self._lock:
+                self._sessions[sid] = session
+            restored.append(sid)
+            self._journal.event("session_resume", session=sid,
+                                acked=session.acked,
+                                windows=session.windows_decided,
+                                snapshot=str(resolved_path))
+            self._journal.metrics.inc("session_resumes")
+            logger.info("Session %s restored from %s: acked %d samples, "
+                        "%d window(s) decided", sid, resolved_path,
+                        session.acked, session.windows_decided)
+        self.restored = restored
+        with self._lock:
+            self._windows_at_last_snap = sum(
+                s.windows_decided for s in self._sessions.values())
+        return restored
+
+    def detach(self) -> None:
+        """Unregister the drain hook (ServeApp.stop after its final
+        snapshot; test teardown)."""
+        preempt.remove_drain_hook(self.snapshot)

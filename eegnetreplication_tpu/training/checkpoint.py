@@ -62,11 +62,13 @@ def _generation_path(path: Path, gen: int) -> Path:
     return path.with_name(f"{path.name}.gen{gen}")
 
 
-def _rotate_generations(path: Path, keep: int) -> None:
+def rotate_generations(path: Path, keep: int) -> None:
     """Shift ``path`` into the ``.gen*`` chain before a new write replaces
     it: genN-1 -> dropped, ..., gen1 -> gen2, path -> gen1.  ``keep`` counts
     generations INCLUDING the about-to-land newest; ``keep=1`` keeps no
-    fallback (plain overwrite, the pre-resil behaviour)."""
+    fallback (plain overwrite, the pre-resil behaviour).  Public: the
+    serving session store rotates its stream snapshots through the same
+    chain."""
     if keep <= 1 or not path.exists():
         return
     _generation_path(path, keep - 1).unlink(missing_ok=True)
@@ -77,7 +79,7 @@ def _rotate_generations(path: Path, keep: int) -> None:
     path.replace(_generation_path(path, 1))
 
 
-def _quarantine(path: Path, error: BaseException | str) -> Path:
+def quarantine_artifact(path: Path, error: BaseException | str) -> Path:
     """Move a corrupt artifact aside as ``<name>[.N].corrupt`` (journaled).
 
     The corpse is preserved for post-mortem rather than deleted; resume
@@ -120,7 +122,7 @@ def _read_verified(path: Path) -> dict[str, np.ndarray]:
     unreadable container may be any user-supplied path handed to the
     public loaders (predict/viz) — destructively renaming a user's
     mis-formatted file would destroy it.  Framework-owned snapshots get
-    full quarantine-on-any-shape via :func:`_resolve_snapshot` instead.
+    full quarantine-on-any-shape via :func:`resolve_snapshot` instead.
     """
     try:
         flat = _read_flat(path)
@@ -132,7 +134,7 @@ def _read_verified(path: Path) -> dict[str, np.ndarray]:
     try:
         integrity.verify(flat, what=str(path))
     except integrity.IntegrityError:
-        _quarantine(path, "content digest mismatch")
+        quarantine_artifact(path, "content digest mismatch")
         raise
     flat.pop(integrity.DIGEST_KEY, None)
     return flat
@@ -171,8 +173,8 @@ def clear_resolve_memo() -> None:
     _RESOLVE_MEMO.clear()
 
 
-def _resolve_snapshot(path: str | Path, *,
-                      consume: bool = False) -> tuple[Path, dict] | None:
+def resolve_snapshot(path: str | Path, *,
+                     consume: bool = False) -> tuple[Path, dict] | None:
     """Newest snapshot generation whose content passes integrity.
 
     Walks ``path``, ``path.gen1``, ``path.gen2``, ... newest-first; any
@@ -216,7 +218,7 @@ def _resolve_snapshot(path: str | Path, *,
             flat = _read_flat(cand)
             integrity.verify(flat, what=str(cand))
         except Exception as exc:  # noqa: BLE001 — any unreadable shape
-            _quarantine(cand, exc)
+            quarantine_artifact(cand, exc)
             continue
         if not consume:
             try:
@@ -334,7 +336,7 @@ def save_run_snapshot(path: str | Path, carry: Any,
         np.savez(fh, **flat)
     inject.fire("checkpoint.write", path=tmp, what="run_snapshot",
                 epochs_done=epochs_done)
-    _rotate_generations(path, keep if keep is not None else snapshot_keep())
+    rotate_generations(path, keep if keep is not None else snapshot_keep())
     tmp.replace(path)
     return path
 
@@ -347,7 +349,7 @@ def read_snapshot_signature(path: str | Path) -> dict | None:
     error).  Corrupt generations encountered on the way are quarantined,
     so a subsequent :func:`load_run_snapshot` resolves the same survivor.
     """
-    resolved = _resolve_snapshot(path)
+    resolved = resolve_snapshot(path)
     if resolved is None:
         return None
     _, flat = resolved
@@ -364,12 +366,12 @@ def load_run_snapshot(path: str | Path, carry_template: Any,
     """Restore a run snapshot; returns ``(carry, metrics, epochs_done)``.
 
     Resolves the newest generation that passes content integrity
-    (quarantining corrupt ones — see :func:`_resolve_snapshot`).  Raises
+    (quarantining corrupt ones — see :func:`resolve_snapshot`).  Raises
     ``ValueError`` if the stored signature does not match — resuming into
     a different protocol/epoch-count/seed would silently corrupt the
     science — and ``FileNotFoundError`` when no valid generation survives.
     """
-    resolved = _resolve_snapshot(path, consume=True)
+    resolved = resolve_snapshot(path, consume=True)
     if resolved is None:
         raise FileNotFoundError(
             f"No valid run snapshot at {path} (all generations corrupt or "

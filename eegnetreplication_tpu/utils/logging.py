@@ -1,24 +1,68 @@
 """Application logger.
 
 Mirrors the reference's observability contract (``src/eegnet_repl/logger.py``):
-a root logger at DEBUG with dual sinks (``app.log`` + console) and the exact
+a root logger at DEBUG with dual sinks (a log file + console) and the exact
 format string, so log-scraping consumers (the GUI Logs tab) see identical
 lines.  Unlike the reference we configure lazily and idempotently so importing
 the package inside tests or other applications does not clobber an existing
 logging setup; set ``EEGTPU_NO_LOG_FILE=1`` to skip the file sink.
+
+The file sink lands under the data root's reports tree
+(``<root>/reports/logs/app-<pid>.log``) rather than the reference's bare
+``app.log`` in the CWD: a CWD-relative file pollutes whatever directory
+the process happens to start in (the repo root, for a checkout) and
+collides when supervisor-managed children share a CWD — the per-pid name
+keeps each replica's stream separate.  ``EEGTPU_LOG_FILE`` overrides the
+full path; ``EEGTPU_DATA_ROOT`` moves the default tree with the rest of
+the project paths.
 """
 
 from __future__ import annotations
 
 import logging
 import os
+from pathlib import Path
 
 LOG_FORMAT = "%(asctime)s - %(filename)s - %(funcName)s - %(levelname)s - %(message)s"
 
 _configured = False
 
 
-def configure(log_file: str = "app.log", level: int = logging.DEBUG) -> logging.Logger:
+def default_log_file() -> str:
+    """The default file-sink path: ``EEGTPU_LOG_FILE`` when set, else
+    ``<data root>/reports/logs/app-<pid>.log`` (the same root resolution
+    as :class:`~eegnetreplication_tpu.config.Paths`, inlined here because
+    logging must import before everything else)."""
+    explicit = os.environ.get("EEGTPU_LOG_FILE")
+    if explicit:
+        return explicit
+    env_root = os.environ.get("EEGTPU_DATA_ROOT")
+    root = Path(env_root) if env_root \
+        else Path(__file__).resolve().parents[2]
+    return str(root / "reports" / "logs" / f"app-{os.getpid()}.log")
+
+
+# How many per-pid log files survive in the default sink directory.
+# Every process (each supervisor relaunch, every bench stage) opens its
+# own file; without pruning a crash-looping supervised service would
+# accumulate files forever.
+LOG_KEEP = 20
+
+
+def _prune_old_logs(log_dir: Path, keep: int = LOG_KEEP) -> None:
+    """Best-effort: drop all but the ``keep`` newest ``app-*.log`` files
+    (never the raising kind — logging setup must not fail a run)."""
+    try:
+        logs = sorted(log_dir.glob("app-*.log"),
+                      key=lambda p: p.stat().st_mtime, reverse=True)
+        for stale in logs[keep:]:
+            stale.unlink(missing_ok=True)
+    except OSError:
+        pass
+
+
+def configure(log_file: str | None = None,
+              level: int = logging.DEBUG) -> logging.Logger:
     """Configure the root logger once; return it."""
     global _configured
     root = logging.getLogger()
@@ -27,10 +71,14 @@ def configure(log_file: str = "app.log", level: int = logging.DEBUG) -> logging.
     if not root.handlers:
         handlers: list[logging.Handler] = [logging.StreamHandler()]
         if not os.environ.get("EEGTPU_NO_LOG_FILE"):
+            path = Path(log_file or default_log_file())
             try:
-                handlers.insert(0, logging.FileHandler(log_file))
+                path.parent.mkdir(parents=True, exist_ok=True)
+                if not os.environ.get("EEGTPU_LOG_FILE"):
+                    _prune_old_logs(path.parent)
+                handlers.insert(0, logging.FileHandler(path))
             except OSError:
-                pass
+                pass  # read-only tree: console-only logging
         formatter = logging.Formatter(LOG_FORMAT)
         for h in handlers:
             h.setFormatter(formatter)

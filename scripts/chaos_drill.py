@@ -299,6 +299,59 @@ def leg_supervisor_hang(root: Path) -> None:
                                   baseline.fold_test_acc)
 
 
+def leg_session_resume(root: Path) -> None:
+    """The streaming-session acceptance drill: SIGKILL a serving child
+    mid-stream under a real Supervisor; the relaunch restores the session
+    snapshot, the client replays from its acked cursor, and the final
+    decision stream equals the uninterrupted offline reference exactly.
+    Then the durability fallback: a CORRUPT newest snapshot generation is
+    quarantined (journaled) and restore falls back to the previous valid
+    generation."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import stream_bench
+    from serve_bench import make_synthetic_checkpoint
+
+    from eegnetreplication_tpu.serve.sessions import SessionStore
+    from eegnetreplication_tpu.serve.sessions.session import WindowDecision
+
+    leg_root = root / "session_resume"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    ckpt = make_synthetic_checkpoint(leg_root, 4, 64)
+    x = stream_bench.make_recording(4, 1500, seed=3)
+    record = stream_bench.kill_resume_leg(
+        ckpt, x, hop=16, init_block=375, chunk=25, root=leg_root)
+    assert record["restarts"] >= 1, record
+    assert record["session_resumes"] >= 1, record
+    assert record["duplicate_conflicts"] == 0, record
+    assert record["decisions_equal"], record
+
+    # Corrupt-newest-generation fallback: the armed session.snapshot site
+    # garbles the SECOND snapshot's staged bytes (the crash-mid-replace
+    # shape); restore must quarantine it and resume from generation 1.
+    with obs.run(root / "obs" / "session_restore") as jr:
+        snap = leg_root / "corrupt_store" / "sessions.npz"
+        store = SessionStore(snap, keep=2)
+        session, _ = store.open("c1", n_channels=4, window=64, hop=16,
+                                ems_init_block_size=256)
+        for idx, start, win in session.ingest(x[:, :800]):
+            session.record(WindowDecision(index=idx, start=start, pred=0,
+                                          status="ok", latency_ms=1.0))
+        store.snapshot()                      # the valid fallback gen
+        session.ingest(x[:, 800:1000])
+        with inject.scoped(inject.FaultSpec(site="session.snapshot",
+                                            times=1)):
+            store.snapshot()                  # garbled newest gen
+        store.detach()
+        store2 = SessionStore(snap, keep=2)
+        assert store2.restore() == ["c1"]
+        assert store2.get("c1").acked == 800, store2.get("c1").acked
+        store2.detach()
+    kinds = _kinds(_events(jr))
+    assert {"checkpoint_quarantine", "session_resume",
+            "fault_injected"} <= kinds, kinds
+
+
 def leg_combined(root: Path) -> None:
     """The acceptance drill: checkpoint.write corruption + train.step
     device fault + host.preempt on a 2-subject protocol; preempted mid-run,
@@ -356,6 +409,7 @@ LEGS = {
     "data.read": leg_data_read,
     "fetch.download": leg_fetch_download,
     "supervisor.hang": leg_supervisor_hang,
+    "session.resume": leg_session_resume,
     "combined": leg_combined,
 }
 

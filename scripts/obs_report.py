@@ -101,6 +101,10 @@ _COLUMNS = (
     # trips (from request/circuit_state events).
     ("supervisor_restarts", "restarts"), ("hang_detections", "hangs"),
     ("expired", "expired"), ("breaker_trips", "trips"),
+    # Streaming sessions (session_* events): stream count, per-window
+    # tail latency, and mid-stream resumes after supervised restarts.
+    ("n_sessions", "sessions"), ("window_p95_ms", "p95_window_ms"),
+    ("session_resumes", "resumes"),
     # Fleet runs (fleet_* events): replica count, dispatch failovers off
     # dead/failing replicas, and the last rolling reload's outcome.
     ("fleet_replicas", "fleet"), ("fleet_failovers", "failovers"),

@@ -16,6 +16,11 @@ end-to-end instead, timing every stage and leaving the artifacts on disk:
   5. ``python -m eegnetreplication_tpu.predict`` on subject 1's Eval set
   6. ``scripts/serve_smoke.py``: the online serving subsystem answers the
      same trials file over HTTP and must byte-match the predict CLI
+  6a. ``scripts/stream_bench.py --selftest``: a paced 250 Hz streaming
+     session of the trained model (decision parity vs the offline
+     pipeline, p95 window latency under the hop interval), then SIGKILL
+     mid-stream under a supervisor — the relaunched child restores the
+     session snapshot and the resumed decision stream is identical
   6b. ``scripts/serve_bench.py --fleet 3 --selftest``: three supervised
      replicas of the trained model behind the fleet router; open-loop
      scaling floor, then kill-one-replica-under-load with zero failed
@@ -171,6 +176,19 @@ def main(argv=None) -> int:
          "--trials",
          str(root / "data" / "processed" / "Eval" / "A01E-trials.npz")],
         root, record, platform=args.platform)
+    # Streaming-session resume drill: replay a paced 250 Hz stream into a
+    # stateful session of the trained subject-1 model (decisions must
+    # byte-match the offline pipeline, p95 window latency under the hop
+    # interval), then SIGKILL the supervised serve child mid-stream — the
+    # relaunch restores the session snapshot and the client resumes from
+    # its acked cursor with an identical decision stream (selftest
+    # asserts all floors).
+    ok = ok and run_stage(
+        "stream-resume",
+        [py, str(REPO / "scripts" / "stream_bench.py"), "--selftest",
+         "--checkpoint", str(root / "models" / "subject_01_best_model.npz"),
+         "--out", str(root / "BENCH_STREAM.json")],
+        root, record, platform=args.platform, timeout=1800.0)
     # Fleet kill drill: 3 supervised replicas of the trained model behind
     # the router; open-loop scaling floor, then SIGKILL one replica under
     # load — zero failed requests, automatic rejoin (selftest asserts).

@@ -1,0 +1,525 @@
+#!/usr/bin/env python
+"""Streaming-session bench: replay a live EEG stream, write BENCH_STREAM.json.
+
+Two legs over the stateful session API (``serve/sessions/``):
+
+1. **replay** — a full recording streamed chunk-by-chunk at the headset
+   rate (250 Hz) into a real :class:`~eegnetreplication_tpu.serve.service.ServeApp`
+   session over HTTP.  Per-window deadlines ride the PR-4 machinery; the
+   leg reports per-window latency percentiles and the two acceptance
+   numbers: ``p95_window_ms < hop interval`` (the stream keeps up with
+   the headset) and ``parity`` (the streamed decision sequence is
+   byte-identical to the offline pipeline — one-shot EMS, same windows,
+   same engine — on the same recording).
+
+2. **kill-resume** — the same stream against a SUPERVISED serve child
+   (``eegtpu-supervise`` policy semantics via
+   :class:`~eegnetreplication_tpu.resil.supervise.Supervisor`): the child
+   is SIGKILLed mid-stream, the supervisor relaunches it with
+   ``--resume``, the client reads its last-acked sample cursor back from
+   ``GET /session/<id>/state`` and replays from there, and the final
+   decision stream must equal the uninterrupted reference exactly —
+   every re-decided window must also agree with what the client was told
+   before the crash (``duplicates_consistent``).
+
+``--selftest`` runs a seconds-sized version (tiny geometry, ~6 s of
+stream) and asserts the floors; it is tier-1
+(``tests/test_sessions.py`` invokes it) and the ``stream-resume`` stage
+of ``scripts/rehearsal_product_path.py`` runs it against the trained
+subject-1 checkpoint at full 22x257 geometry.  The full run (default
+sizes, no floor) is the BENCH_STREAM.json producer.
+
+Usage:
+    python scripts/stream_bench.py --out BENCH_STREAM.json
+    python scripts/stream_bench.py --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+# serve_bench lives beside this script (synthetic-checkpoint helper);
+# needed when stream_bench is IMPORTED (chaos_drill) rather than run.
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from serve_bench import _percentile, make_synthetic_checkpoint  # noqa: E402
+
+HEADSET_RATE_HZ = 250.0  # the paper's live deployment scenario
+
+
+def make_recording(n_channels: int, n_samples: int, seed: int = 0
+                   ) -> np.ndarray:
+    """A synthetic continuous ``(C, T)`` recording: band-limited
+    oscillations over pink-ish noise with a DC offset, so the EMS carry
+    has real work to do."""
+    rng = np.random.RandomState(seed)
+    t = np.arange(n_samples) / HEADSET_RATE_HZ
+    x = rng.randn(n_channels, n_samples).astype(np.float32) * 4.0
+    for c in range(n_channels):
+        f = 6.0 + 2.0 * (c % 8)
+        x[c] += (12.0 * np.sin(2 * np.pi * f * t + c)).astype(np.float32)
+    return x + 7.5  # headset-like DC offset the standardization removes
+
+
+def offline_reference(checkpoint: Path, x: np.ndarray, *, window: int,
+                      hop: int, init_block: int) -> np.ndarray:
+    """The uninterrupted ground truth: one-shot EMS over the whole
+    recording, every complete window extracted at the session's
+    positions, predictions from the same warm engine the service uses."""
+    from eegnetreplication_tpu.ops.ems import StreamingEMS
+    from eegnetreplication_tpu.serve.engine import InferenceEngine
+
+    ems = StreamingEMS(x.shape[0], init_block_size=init_block)
+    std = ems.push(x)
+    std = np.concatenate([std, ems.flush()], axis=1)
+    wins = []
+    k = 0
+    while k * hop + window <= std.shape[1]:
+        wins.append(std[:, k * hop:k * hop + window])
+        k += 1
+    if not wins:
+        return np.zeros(0, np.int64)
+    engine = InferenceEngine.from_checkpoint(checkpoint, warm=False)
+    return engine.infer(np.stack(wins))
+
+
+# ---------------------------------------------------------------------------
+# HTTP client helpers (stdlib only, like serve_bench).
+
+
+def _post(url: str, data: bytes, ctype: str = "application/json",
+          timeout: float = 30.0) -> dict:
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _get(url: str, timeout: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _wait_healthy(base: str, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            _get(base + "/healthz", timeout=2.0)
+            return
+        except Exception:  # noqa: BLE001 — still booting
+            time.sleep(0.2)
+    raise TimeoutError(f"server at {base} never became healthy")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class DecisionLog:
+    """Window -> decision, tolerant of re-delivery after a resume.
+
+    The resume contract distinguishes the two decision classes: an
+    ``ok`` decision is a pure function of the recording (chunk-invariant
+    EMS + deterministic engine), so two ``ok`` deliveries of the same
+    window must agree exactly — a disagreement is a ``conflict``.  A
+    degraded status (``expired``/``error``) is a statement about TIMING
+    under the load at delivery, not about the signal; a replay after a
+    restart may legitimately heal it to ``ok`` (or degrade an ``ok``
+    that now misses its deadline), so status transitions are counted as
+    ``healed`` rather than conflicts, and the latest delivery wins.
+    """
+
+    def __init__(self):
+        self.by_window: dict[int, dict] = {}
+        self.conflicts: list[tuple[int, dict, dict]] = []
+        self.healed = 0
+
+    def add(self, decisions: list[dict]) -> None:
+        for d in decisions:
+            prev = self.by_window.get(d["window"])
+            if prev is not None:
+                if (prev["status"] == "ok" and d["status"] == "ok"
+                        and prev["pred"] != d["pred"]):
+                    self.conflicts.append((d["window"], prev, d))
+                elif prev["status"] != d["status"]:
+                    self.healed += 1
+            self.by_window[d["window"]] = d
+
+    def preds(self) -> np.ndarray:
+        if not self.by_window:
+            return np.zeros(0, np.int64)
+        n = max(self.by_window) + 1
+        return np.asarray([self.by_window.get(i, {"pred": -2})["pred"]
+                           for i in range(n)], np.int64)
+
+    def ok_latencies(self) -> list[float]:
+        return sorted(d["latency_ms"] for d in self.by_window.values()
+                      if d["status"] == "ok")
+
+
+def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
+                    init_block: int, chunk: int, rate_hz: float,
+                    deadline_ms: float | None, log: DecisionLog,
+                    on_chunk=None, resume_poll_s: float = 120.0) -> dict:
+    """Open (or re-attach) a session and stream ``x`` from the server's
+    acked cursor, pacing to ``rate_hz`` (0 = flat out).  Transparent
+    resume: a dropped connection polls the server back to health, reads
+    the acked cursor, and replays from there.  Returns the close reply.
+    """
+    c = x.shape[0]
+    open_body = json.dumps({
+        "session": sid, "hop": hop, "ems_init_block_size": init_block,
+        "deadline_ms": deadline_ms}).encode()
+    reply = _post(base + "/session/open", open_body)
+    pos = int(reply["acked"])
+    t0 = time.perf_counter()
+    sent0 = pos
+    while pos < x.shape[1]:
+        piece = x[:, pos:pos + chunk]
+        if rate_hz > 0:
+            target = t0 + (pos + piece.shape[1] - sent0) / rate_hz
+            delay = target - time.perf_counter()
+            if delay > 0:
+                time.sleep(delay)
+        try:
+            reply = _post(f"{base}/session/{sid}/samples",
+                          piece.astype("<f4").tobytes(),
+                          "application/octet-stream")
+        except urllib.error.HTTPError as err:
+            if err.code != 404:
+                raise  # a real protocol error, not a dead server
+            # Session unknown after a restart (no snapshot survived):
+            # re-open and replay from the server's cursor (zero) — still
+            # deterministic.
+            state = _post(base + "/session/open", open_body)
+            pos = int(state["acked"])
+            t0 = time.perf_counter()
+            sent0 = pos
+            continue
+        except (urllib.error.URLError, ConnectionError, OSError):
+            # Server down (killed / restarting): wait it out, then learn
+            # where to resume from — the acked cursor is the contract.
+            _wait_healthy(base, resume_poll_s)
+            try:
+                state = _get(f"{base}/session/{sid}/state")
+            except urllib.error.HTTPError:
+                # No snapshot survived (killed before the first one):
+                # re-open and replay from zero — still deterministic.
+                state = _post(base + "/session/open", open_body)
+            pos = int(state["acked"])
+            t0 = time.perf_counter()
+            sent0 = pos
+            continue
+        log.add(reply["decisions"])
+        pos += piece.shape[1]
+        if on_chunk is not None:
+            on_chunk(pos)
+    while True:
+        try:
+            final = _post(f"{base}/session/{sid}/close", b"{}")
+            break
+        except urllib.error.HTTPError:
+            raise  # protocol error: the close itself was rejected
+        except (urllib.error.URLError, ConnectionError, OSError):
+            _wait_healthy(base, resume_poll_s)
+    return final
+
+
+# ---------------------------------------------------------------------------
+# Leg 1: paced replay against an in-process ServeApp.
+
+
+def replay_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+               init_block: int, rate_hz: float, chunk: int,
+               root: Path) -> dict:
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    with obs_journal.run(root / "obs_replay", config={}) as jr:
+        app = ServeApp(checkpoint, port=0,
+                       sessions_dir=root / "sessions_replay",
+                       session_snapshot_every=64, journal=jr).start()
+        try:
+            window = app.registry.engine.geometry[1]
+            hop_interval_ms = 1000.0 * hop / rate_hz if rate_hz else None
+            deadline_ms = (4.0 * hop_interval_ms if hop_interval_ms
+                           else None)
+            log = DecisionLog()
+            t0 = time.perf_counter()
+            final = _stream_session(
+                app.url, "replay", x, hop=hop, init_block=init_block,
+                chunk=chunk, rate_hz=rate_hz, deadline_ms=deadline_ms,
+                log=log)
+            wall = time.perf_counter() - t0
+        finally:
+            app.stop()
+    reference = offline_reference(checkpoint, x, window=window, hop=hop,
+                                  init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    lat = log.ok_latencies()
+    record = {
+        "n_samples": int(x.shape[1]), "rate_hz": rate_hz,
+        "chunk_samples": chunk, "hop": hop, "window": window,
+        "wall_s": round(wall, 3),
+        "n_windows": int(final["windows"]),
+        "expired": int(final["expired"]),
+        "deadline_ms": deadline_ms,
+        "hop_interval_ms": (round(hop_interval_ms, 3)
+                            if hop_interval_ms else None),
+        "p50_window_ms": round(_percentile(lat, 0.50), 3),
+        "p95_window_ms": round(_percentile(lat, 0.95), 3),
+        "p99_window_ms": round(_percentile(lat, 0.99), 3),
+        "n_reference_windows": int(len(reference)),
+        "parity": bool(len(streamed) == len(reference)
+                       and np.array_equal(streamed, reference)),
+    }
+    return record
+
+
+# ---------------------------------------------------------------------------
+# Leg 2: SIGKILL mid-stream under a supervisor; resume must be exact.
+
+
+def kill_resume_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+                    init_block: int, chunk: int, root: Path,
+                    snapshot_every: int = 4,
+                    kill_after_frac: float = 0.45) -> dict:
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.obs import schema
+    from eegnetreplication_tpu.resil import preempt, supervise
+    from eegnetreplication_tpu.resil import retry as resil_retry
+
+    port = _free_port()
+    base = f"http://127.0.0.1:{port}"
+    obs_child = root / "obs_child"
+    cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
+           "--checkpoint", str(checkpoint), "--port", str(port),
+           "--metricsDir", str(obs_child),
+           "--sessionsDir", str(root / "sessions_killed"),
+           "--sessionSnapshotEvery", str(snapshot_every)]
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:"
+               f"{os.environ.get('PYTHONPATH', '')}")
+    # Share one persistent compile cache across launches so the relaunch
+    # is not dominated by recompiles.
+    env.setdefault("EEGTPU_COMPILE_CACHE", str(root / "compile_cache"))
+
+    children: list[subprocess.Popen] = []
+
+    def recording_popen(c, **kw):
+        # The supervisor passes its own env (ours + the heartbeat file);
+        # this wrapper only records the child so the kill can target it.
+        proc = subprocess.Popen(c, **kw)
+        children.append(proc)
+        return proc
+
+    policy = supervise.SupervisorPolicy(
+        grace_s=15.0, poll_s=0.1, max_restarts=5, restart_window_s=600.0,
+        thresholds={"startup": 600.0, "serve_idle": 600.0,
+                    "serve_forward": 600.0},
+        backoff=resil_retry.RetryPolicy(max_attempts=1_000_000,
+                                        base_delay_s=0.1, max_delay_s=0.5,
+                                        jitter=0.0))
+    with obs_journal.run(root / "obs_bench", config={}) as jr:
+        sup = supervise.Supervisor(cmd, policy=policy,
+                                   heartbeat_file=root / "heartbeat.json",
+                                   journal=jr, env=env,
+                                   popen=recording_popen)
+        sup_thread = threading.Thread(target=sup.run, daemon=True)
+        sup_thread.start()
+        killed = {"done": False}
+        kill_at = int(kill_after_frac * x.shape[1])
+
+        def maybe_kill(pos: int) -> None:
+            if not killed["done"] and pos >= kill_at and children:
+                killed["done"] = True
+                os.kill(children[-1].pid, signal.SIGKILL)
+
+        try:
+            _wait_healthy(base)
+            log = DecisionLog()
+            final = _stream_session(
+                base, "killres", x, hop=hop, init_block=init_block,
+                chunk=chunk, rate_hz=0.0, deadline_ms=None, log=log,
+                on_chunk=maybe_kill)
+        finally:
+            # Stop supervision: the supervisor forwards SIGTERM (a clean
+            # drain) and does NOT relaunch after its own stop request.
+            preempt.request("stream_bench done")
+            sup_thread.join(timeout=60.0)
+            preempt.clear()
+
+    window = int(final["window"])
+    reference = offline_reference(checkpoint, x, window=window, hop=hop,
+                                  init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    # Child-side telemetry: resumes + snapshots across all launches.
+    resumes = snapshots = 0
+    for run_dir in sorted(obs_child.iterdir()) if obs_child.exists() else []:
+        try:
+            events = schema.read_events(run_dir / "events.jsonl",
+                                        complete=False, lenient_tail=True)
+        except (OSError, schema.SchemaError):
+            continue
+        resumes += sum(1 for e in events if e["event"] == "session_resume")
+        snapshots += sum(1 for e in events
+                         if e["event"] == "session_snapshot")
+    return {
+        "n_samples": int(x.shape[1]), "hop": hop, "window": window,
+        "chunk_samples": chunk, "snapshot_every_windows": snapshot_every,
+        "killed_at_sample": kill_at,
+        "launches": sup.attempt,
+        "restarts": sup.attempt - 1,
+        "session_resumes": resumes,
+        "session_snapshots": snapshots,
+        "n_windows": int(final["windows"]),
+        "n_reference_windows": int(len(reference)),
+        "duplicate_conflicts": len(log.conflicts),
+        "healed_redeliveries": log.healed,
+        "decisions_equal": bool(len(streamed) == len(reference)
+                                and np.array_equal(streamed, reference)),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    # Pin the resolved platform into the env so the supervised serve
+    # child resolves the SAME backend (same convention as serve_bench).
+    platform = select_platform()
+    os.environ.setdefault("EEGTPU_PLATFORM", platform)
+
+    parser = argparse.ArgumentParser(
+        description="Streaming-session bench: paced replay + kill-resume.")
+    parser.add_argument("--out", default=None,
+                        help="Artifact path (default BENCH_STREAM.json in "
+                             "the repo root; selftest defaults to a temp "
+                             "file).")
+    parser.add_argument("--checkpoint", default=None,
+                        help="Serve this checkpoint (default: a synthetic "
+                             "EEGNet — tiny geometry under --selftest, "
+                             "22x257 otherwise).")
+    parser.add_argument("--seconds", type=float, default=None,
+                        help="Recording length at 250 Hz (default 60; "
+                             "selftest 6).")
+    parser.add_argument("--rate", type=float, default=HEADSET_RATE_HZ,
+                        help="Replay pacing in Hz for the replay leg "
+                             "(0 = flat out).  The kill-resume leg always "
+                             "streams flat out.")
+    parser.add_argument("--hop", type=int, default=None,
+                        help="Window hop in samples (default window//4).")
+    parser.add_argument("--chunk", type=int, default=25,
+                        help="Samples per POST (25 = 100 ms at 250 Hz).")
+    parser.add_argument("--selftest", action="store_true",
+                        help="Seconds-sized run; assert the acceptance "
+                             "floors (tier-1).")
+    parser.add_argument("--skip-resume", action="store_true",
+                        help="Run only the replay leg (no supervised "
+                             "child).")
+    args = parser.parse_args(argv)
+
+    import tempfile
+
+    from eegnetreplication_tpu.obs import schema
+
+    root = Path(tempfile.mkdtemp(prefix="eegtpu_stream_bench_"))
+    if args.checkpoint:
+        checkpoint = Path(args.checkpoint)
+        from eegnetreplication_tpu.serve.engine import (
+            load_model_from_checkpoint,
+        )
+
+        model, _, _ = load_model_from_checkpoint(checkpoint)
+        n_channels, window = model.n_channels, model.n_times
+    else:
+        n_channels, window = (4, 64) if args.selftest else (22, 257)
+        checkpoint = make_synthetic_checkpoint(root, n_channels, window)
+    hop = args.hop or max(1, window // 4)
+    seconds = args.seconds or (6.0 if args.selftest else 60.0)
+    n_samples = int(seconds * HEADSET_RATE_HZ)
+    init_block = min(1000, max(window, n_samples // 4))
+    x = make_recording(n_channels, n_samples)
+
+    print(f"[stream_bench] {n_channels}x{n_samples} recording, window "
+          f"{window}, hop {hop}, init block {init_block}, replay at "
+          f"{args.rate:g} Hz", flush=True)
+    record: dict = {
+        "platform": platform, "selftest": bool(args.selftest),
+        "checkpoint": str(checkpoint), "n_channels": n_channels,
+        "window": window, "hop": hop, "rate_hz": args.rate,
+        "ems_init_block_size": init_block,
+    }
+    record["replay"] = replay_leg(
+        checkpoint, x, hop=hop, init_block=init_block, rate_hz=args.rate,
+        chunk=args.chunk, root=root)
+    print(f"[stream_bench] replay: {record['replay']}", flush=True)
+    if not args.skip_resume:
+        record["kill_resume"] = kill_resume_leg(
+            checkpoint, x, hop=hop, init_block=init_block,
+            chunk=args.chunk, root=root)
+        print(f"[stream_bench] kill-resume: {record['kill_resume']}",
+              flush=True)
+
+    out = Path(args.out) if args.out else (
+        root / "BENCH_STREAM_selftest.json"
+        if args.selftest else REPO / "BENCH_STREAM.json")
+    schema.write_json_artifact(out, record, kind="bench", indent=1)
+    print(f"[stream_bench] wrote {out}", flush=True)
+
+    if args.selftest:
+        replay = record["replay"]
+        failures = []
+        if not replay["parity"]:
+            failures.append("replay decisions != offline pipeline")
+        if replay["hop_interval_ms"] and not (
+                replay["p95_window_ms"] < replay["hop_interval_ms"]):
+            failures.append(
+                f"p95 window latency {replay['p95_window_ms']}ms >= hop "
+                f"interval {replay['hop_interval_ms']}ms")
+        if replay["expired"]:
+            failures.append(f"{replay['expired']} window(s) expired in the "
+                            "paced replay")
+        if not args.skip_resume:
+            kr = record["kill_resume"]
+            if not kr["decisions_equal"]:
+                failures.append("resumed decision stream != uninterrupted "
+                                "reference")
+            if kr["duplicate_conflicts"]:
+                failures.append(f"{kr['duplicate_conflicts']} re-decided "
+                                "window(s) disagreed with pre-crash "
+                                "delivery")
+            if kr["restarts"] < 1:
+                failures.append("the child was never restarted (kill leg "
+                                "did not exercise the supervisor)")
+            if kr["session_resumes"] < 1:
+                failures.append("no session_resume journaled by the "
+                                "relaunched child")
+        if failures:
+            print("[stream_bench] SELFTEST FAIL:\n  - "
+                  + "\n  - ".join(failures))
+            return 1
+        print("[stream_bench] SELFTEST PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

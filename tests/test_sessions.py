@@ -1,0 +1,542 @@
+"""Durable streaming sessions (``serve/sessions/`` + the stateful EMS
+carrier in ``ops/ems.py``).
+
+Covers the ISSUE-7 acceptance surface: streaming-vs-offline EMS byte
+parity under arbitrary chunking (including one sample at a time), the
+session window slider and its decided-frontier snapshot semantics, the
+store's stamped/rotated/quarantined snapshot chain, the HTTP session API
+(open/samples/state/close, per-window deadlines with graceful
+degradation), SIGTERM-drain snapshot + ``--resume`` restore with a
+byte-identical continued decision stream, and the ``stream_bench.py
+--selftest`` tier-1 leg (paced 250 Hz replay parity + supervised
+SIGKILL-mid-stream resume).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.obs import journal as obs_journal  # noqa: E402
+from eegnetreplication_tpu.obs import schema  # noqa: E402
+from eegnetreplication_tpu.ops.ems import (  # noqa: E402
+    StreamingEMS,
+    raw_exponential_moving_standardize,
+)
+from eegnetreplication_tpu.resil import inject  # noqa: E402
+from eegnetreplication_tpu.serve.engine import InferenceEngine  # noqa: E402
+from eegnetreplication_tpu.serve.service import ServeApp  # noqa: E402
+from eegnetreplication_tpu.serve.sessions import (  # noqa: E402
+    SessionStore,
+    StreamSession,
+    WindowDecision,
+)
+from eegnetreplication_tpu.training.checkpoint import (  # noqa: E402
+    save_checkpoint,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+C, T = 4, 64
+HOP = 16
+BLOCK = 256
+
+
+@pytest.fixture(scope="module")
+def recording():
+    rng = np.random.RandomState(7)
+    x = rng.randn(C, 2000).astype(np.float32) * 5.0
+    x += 9.0  # DC offset the standardization must absorb
+    return x
+
+
+def _offline_std(x, init_block=BLOCK):
+    return raw_exponential_moving_standardize(
+        x, init_block_size=init_block, method="scan")
+
+
+def _offline_windows(std, window=T, hop=HOP):
+    wins = []
+    k = 0
+    while k * hop + window <= std.shape[1]:
+        wins.append(std[:, k * hop:k * hop + window])
+        k += 1
+    return np.stack(wins) if wins else np.zeros((0, std.shape[0], window),
+                                                np.float32)
+
+
+def _stream(x, chunk_sizes, init_block=BLOCK):
+    ems = StreamingEMS(x.shape[0], init_block_size=init_block)
+    outs, pos, i = [], 0, 0
+    while pos < x.shape[1]:
+        n = chunk_sizes[i % len(chunk_sizes)]
+        i += 1
+        outs.append(ems.push(x[:, pos:pos + n]))
+        pos += min(n, x.shape[1] - pos)
+    return np.concatenate(outs, axis=1), ems
+
+
+class TestStreamingEMS:
+    """ISSUE-7 satellite: streaming-vs-offline EMS parity must be BYTE
+    identical — approximate equality would make mid-stream resume drift
+    from an uninterrupted run."""
+
+    @pytest.mark.parametrize("sizes", [[1], [7], [250], [2000],
+                                       [1, 2, 3, 5, 8, 13, 255]])
+    def test_chunked_byte_identical_to_one_shot(self, recording, sizes):
+        got, _ = _stream(recording, sizes)
+        ref = _offline_std(recording)
+        assert got.shape == ref.shape
+        np.testing.assert_array_equal(got, ref)
+
+    def test_state_roundtrip_continues_byte_identically(self, recording):
+        ems1 = StreamingEMS(C, init_block_size=BLOCK)
+        head = ems1.push(recording[:, :900])
+        # Serialize mid-stream, rebuild, continue on the clone.
+        clone = StreamingEMS.from_state(ems1.state_arrays())
+        tail = clone.push(recording[:, 900:])
+        got = np.concatenate([head, tail], axis=1)
+        np.testing.assert_array_equal(got, _offline_std(recording))
+        assert clone.n_seen == recording.shape[1]
+
+    def test_pre_seed_state_roundtrip(self, recording):
+        """A snapshot taken BEFORE the seed block filled must preserve the
+        raw buffer so seeding happens identically after restore."""
+        ems1 = StreamingEMS(C, init_block_size=BLOCK)
+        assert ems1.push(recording[:, :100]).shape == (C, 0)
+        clone = StreamingEMS.from_state(ems1.state_arrays())
+        assert not clone.seeded and clone.n_seen == 100
+        out = clone.push(recording[:, 100:])
+        np.testing.assert_array_equal(out, _offline_std(recording))
+
+    def test_short_stream_flush_matches_offline(self, recording):
+        """A stream that ends before the seed block fills standardizes via
+        flush() with the offline ``block = min(init_block, T)`` clause."""
+        short = recording[:, :150]
+        ems = StreamingEMS(C, init_block_size=BLOCK)
+        assert ems.push(short).shape == (C, 0)
+        out = ems.flush()
+        np.testing.assert_array_equal(out, _offline_std(short))
+        assert ems.flush().shape == (C, 0)  # idempotent
+
+    def test_bad_inputs(self):
+        ems = StreamingEMS(C)
+        with pytest.raises(ValueError, match="chunk"):
+            ems.push(np.zeros((C + 1, 10), np.float32))
+        with pytest.raises(ValueError, match="chunk"):
+            ems.push(np.zeros(10, np.float32))
+        with pytest.raises(ValueError):
+            StreamingEMS(0)
+
+
+class TestStreamSession:
+    def _decided(self, session, ready, pred=1):
+        for idx, start, _ in ready:
+            session.record(WindowDecision(index=idx, start=start, pred=pred,
+                                          status="ok", latency_ms=1.0))
+
+    def test_window_positions_match_offline_slicing(self, recording):
+        session = StreamSession("s", n_channels=C, window=T, hop=HOP,
+                                ems_init_block_size=BLOCK)
+        ready = []
+        for pos in range(0, recording.shape[1], 33):
+            ready.extend(session.ingest(recording[:, pos:pos + 33]))
+        offline = _offline_windows(_offline_std(recording))
+        assert len(ready) == len(offline)
+        for idx, start, win in ready:
+            assert start == idx * HOP
+            np.testing.assert_array_equal(win, offline[idx])
+
+    def test_record_out_of_order_raises(self):
+        session = StreamSession("s", n_channels=C, window=T, hop=HOP)
+        with pytest.raises(ValueError, match="out of order"):
+            session.record(WindowDecision(index=3, start=48, pred=0,
+                                          status="ok", latency_ms=0.0))
+
+    def test_decision_history_is_bounded(self, recording):
+        """Review hardening: the durable decision record keeps only a
+        bounded tail (cursors stay exact), so a multi-hour stream's
+        periodic snapshots don't grow with stream age."""
+        session = StreamSession("s", n_channels=C, window=T, hop=HOP,
+                                ems_init_block_size=BLOCK,
+                                decision_history=10)
+        ready = session.ingest(recording[:, :1000])
+        self._decided(session, ready)
+        assert session.windows_decided == len(ready) > 10
+        assert len(session.decisions) == 10
+        assert session.preds_offset == len(ready) - 10
+        assert session.decisions[0].index == session.preds_offset
+        restored = StreamSession.from_state("s", session.state_arrays())
+        assert restored.windows_decided == session.windows_decided
+        assert restored.preds_offset == session.preds_offset
+        np.testing.assert_array_equal(restored.preds(), session.preds())
+        w1 = session.ingest(recording[:, 1000:])
+        w2 = restored.ingest(recording[:, 1000:])
+        assert len(w1) == len(w2) > 0
+        for (i1, _, a), (i2, _, b) in zip(w1, w2):
+            assert i1 == i2
+            np.testing.assert_array_equal(a, b)
+
+    def test_snapshot_rolls_back_to_decided_frontier(self, recording):
+        """Windows produced but not yet decided when the state is captured
+        are re-extracted byte-identically after restore — an in-flight
+        window at crash time is re-decided, never lost."""
+        session = StreamSession("s", n_channels=C, window=T, hop=HOP,
+                                ems_init_block_size=BLOCK)
+        ready = session.ingest(recording[:, :600])
+        assert len(ready) > 4
+        self._decided(session, ready[:3])  # 3 decided, rest in flight
+        restored = StreamSession.from_state("s", session.state_arrays())
+        assert restored.windows_decided == 3
+        assert restored.acked == 600
+        again = restored.ingest(np.zeros((C, 0), np.float32))
+        assert [(i, s) for i, s, _ in again] \
+            == [(i, s) for i, s, _ in ready[3:]]
+        for (_, _, w1), (_, _, w2) in zip(ready[3:], again):
+            np.testing.assert_array_equal(w1, w2)
+
+
+class TestSessionStore:
+    def _fill(self, store, x, sid="a", n=800):
+        session, resumed = store.open(sid, n_channels=C, window=T, hop=HOP,
+                                      ems_init_block_size=BLOCK)
+        assert not resumed
+        for idx, start, _ in session.ingest(x[:, :n]):
+            session.record(WindowDecision(index=idx, start=start, pred=2,
+                                          status="ok", latency_ms=1.0))
+        return session
+
+    def test_snapshot_restore_roundtrip(self, tmp_path, recording):
+        store = SessionStore(tmp_path / "sessions.npz")
+        session = self._fill(store, recording)
+        store.snapshot()
+        store.detach()
+
+        store2 = SessionStore(tmp_path / "sessions.npz")
+        assert store2.restore() == ["a"]
+        restored = store2.get("a")
+        assert restored.acked == session.acked
+        assert restored.windows_decided == session.windows_decided
+        np.testing.assert_array_equal(restored.preds(), session.preds())
+        # The continued streams stay byte-identical.
+        w1 = session.ingest(recording[:, 800:])
+        w2 = restored.ingest(recording[:, 800:])
+        assert len(w1) == len(w2) > 0
+        for (_, _, a), (_, _, b) in zip(w1, w2):
+            np.testing.assert_array_equal(a, b)
+        store2.detach()
+
+    def test_corrupt_newest_generation_falls_back(self, tmp_path,
+                                                  recording):
+        """Acceptance: a garbled newest snapshot is quarantined (journaled)
+        and restore resumes from the previous valid generation."""
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            store = SessionStore(tmp_path / "sessions.npz", keep=2,
+                                 journal=jr)
+            session = self._fill(store, recording)
+            store.snapshot()                     # valid fallback gen
+            session.ingest(recording[:, 800:1000])
+            with inject.scoped(inject.FaultSpec(site="session.snapshot",
+                                                times=1)):
+                store.snapshot()                 # garbled newest
+            store.detach()
+            store2 = SessionStore(tmp_path / "sessions.npz", journal=jr)
+            assert store2.restore() == ["a"]
+            assert store2.get("a").acked == 800  # gen1's state, not 1000
+            store2.detach()
+        events = schema.read_events(jr.events_path)
+        kinds = {e["event"] for e in events}
+        assert {"session_snapshot", "checkpoint_quarantine",
+                "session_resume", "fault_injected"} <= kinds
+        assert (tmp_path / "sessions.npz.corrupt").exists()
+        resume = [e for e in events if e["event"] == "session_resume"][-1]
+        assert resume["acked"] == 800
+
+    def test_restore_missing_is_clean_start(self, tmp_path):
+        store = SessionStore(tmp_path / "nope" / "sessions.npz")
+        assert store.restore() == []
+        store.detach()
+
+    def test_close_is_durable(self, tmp_path, recording):
+        store = SessionStore(tmp_path / "sessions.npz")
+        self._fill(store, recording)
+        store.close("a")  # snapshots the now-empty table
+        store.detach()
+        store2 = SessionStore(tmp_path / "sessions.npz")
+        assert store2.restore() == []
+        store2.detach()
+
+    def test_reopen_reattaches(self, tmp_path, recording):
+        store = SessionStore(tmp_path / "sessions.npz")
+        self._fill(store, recording)
+        session, resumed = store.open("a", n_channels=C, window=T, hop=HOP)
+        assert resumed and session.acked == 800
+        store.detach()
+
+    def test_invalid_session_id_rejected(self, tmp_path):
+        store = SessionStore(tmp_path / "sessions.npz")
+        for bad in ("", "a/b", "x" * 65, "sp ace"):
+            with pytest.raises(ValueError, match="session id"):
+                store.open(bad, n_channels=C, window=T, hop=HOP)
+        store.detach()
+
+    def test_in_memory_store_has_no_snapshot(self, recording):
+        store = SessionStore(None)
+        self._fill(store, recording)
+        assert store.snapshot() is None
+        assert store.restore() == []
+        store.detach()
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface.
+
+
+def _checkpoint(tmp_path: Path) -> Path:
+    model = EEGNet(n_channels=C, n_times=T)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, C, T)),
+                           train=False)
+    return save_checkpoint(
+        tmp_path / "m.npz", variables["params"], variables["batch_stats"],
+        metadata={"model": "eegnet", "n_channels": C, "n_times": T,
+                  "F1": model.F1, "D": model.D})
+
+
+def _post(url, data, ctype="application/json"):
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestSessionHTTP:
+    def test_full_roundtrip_matches_offline_pipeline(self, tmp_path,
+                                                     recording):
+        """Open -> raw-bytes samples -> state -> close; the decision
+        stream must equal the offline pipeline (one-shot EMS, same
+        windows, same engine) byte for byte."""
+        ckpt = _checkpoint(tmp_path)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(ckpt, buckets=(1, 8),
+                           sessions_dir=tmp_path / "sess",
+                           session_snapshot_every=16, journal=jr).start()
+            try:
+                opened = _post(app.url + "/session/open", json.dumps(
+                    {"session": "s1", "hop": HOP,
+                     "ems_init_block_size": BLOCK}).encode())
+                assert opened["resumed"] is False
+                assert opened["window"] == T
+                for pos in range(0, recording.shape[1], 130):
+                    chunk = recording[:, pos:pos + 130]
+                    reply = _post(app.url + "/session/s1/samples",
+                                  chunk.astype("<f4").tobytes(),
+                                  "application/octet-stream")
+                assert reply["acked"] == recording.shape[1]
+                state = _get(app.url + "/session/s1/state")
+                assert state["acked"] == recording.shape[1]
+                assert state["seeded"] is True
+                final = _post(app.url + "/session/s1/close", b"{}")
+            finally:
+                app.stop()
+        engine = InferenceEngine.from_checkpoint(ckpt, (1, 8), warm=False)
+        offline = engine.infer(_offline_windows(_offline_std(recording)))
+        np.testing.assert_array_equal(
+            np.asarray(final["preds"], np.int64), offline)
+        assert final["windows"] == len(offline)
+        assert final["expired"] == 0
+        events = schema.read_events(jr.events_path)
+        kinds = {e["event"] for e in events}
+        assert {"session_start", "session_window", "session_snapshot",
+                "session_end"} <= kinds
+        summary = schema.event_summary(events)
+        assert summary["n_sessions"] == 1
+        assert summary["session_windows"] == len(offline)
+        assert summary["windows_expired"] == 0
+        assert summary["window_p95_ms"] > 0
+
+    def test_json_samples_and_errors(self, tmp_path, recording):
+        ckpt = _checkpoint(tmp_path)
+        app = ServeApp(ckpt, buckets=(1, 8),
+                       sessions_dir=tmp_path / "sess").start()
+        try:
+            _post(app.url + "/session/open",
+                  json.dumps({"session": "j1", "hop": HOP}).encode())
+            reply = _post(app.url + "/session/j1/samples", json.dumps(
+                {"samples": recording[:, :50].tolist()}).encode())
+            assert reply["acked"] == 50
+            # Unknown session -> 404.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/nope/samples", b"")
+            assert err.value.code == 404
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(app.url + "/session/nope/state")
+            assert err.value.code == 404
+            # Ragged raw bytes -> 400.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/j1/samples", b"\x00" * 7,
+                      "application/octet-stream")
+            assert err.value.code == 400
+            # Session window must equal the model's input length.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/open", json.dumps(
+                    {"session": "j2", "window": T + 1}).encode())
+            assert err.value.code == 400
+            # Bad session id -> 400.
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/open", json.dumps(
+                    {"session": "no/slash"}).encode())
+            assert err.value.code == 400
+            # A second close of the same session answers a clean 404
+            # (the close claims the session atomically — racing closes
+            # get one winner, never a KeyError 500).
+            _post(app.url + "/session/j1/close", b"{}")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/j1/close", b"{}")
+            assert err.value.code == 404
+        finally:
+            app.stop()
+
+    def test_expired_window_degrades_not_dies(self, tmp_path, recording):
+        """A session whose per-window deadline cannot be met journals
+        ``window_expired`` with ``pred=-1`` — and the stream KEEPS GOING:
+        later ingests still ack and close still answers."""
+        ckpt = _checkpoint(tmp_path)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(ckpt, buckets=(1, 8),
+                           sessions_dir=tmp_path / "sess",
+                           journal=jr).start()
+            try:
+                _post(app.url + "/session/open", json.dumps(
+                    {"session": "d1", "hop": HOP,
+                     "ems_init_block_size": BLOCK,
+                     "deadline_ms": 0.001}).encode())
+                reply = _post(app.url + "/session/d1/samples",
+                              recording[:, :600].astype("<f4").tobytes(),
+                              "application/octet-stream")
+                assert reply["acked"] == 600
+                assert reply["decisions"]  # windows were decided...
+                assert all(d["status"] == "expired" and d["pred"] == -1
+                           for d in reply["decisions"])
+                # ...and the stream is still alive:
+                reply = _post(app.url + "/session/d1/samples",
+                              recording[:, 600:700].astype("<f4").tobytes(),
+                              "application/octet-stream")
+                assert reply["acked"] == 700
+                final = _post(app.url + "/session/d1/close", b"{}")
+                assert final["expired"] == final["windows"] > 0
+            finally:
+                app.stop()
+        events = schema.read_events(jr.events_path)
+        expired = [e for e in events if e["event"] == "window_expired"]
+        assert expired and expired[0]["session"] == "d1"
+        summary = schema.event_summary(events)
+        assert summary["windows_expired"] == summary["session_windows"] > 0
+
+    def test_stop_snapshots_and_resume_continues_stream(self, tmp_path,
+                                                        recording):
+        """The serve drain persists sessions; a new ServeApp with
+        ``resume=True`` restores them, the client resumes from the acked
+        cursor, and the stitched decision stream equals the offline
+        pipeline byte for byte."""
+        ckpt = _checkpoint(tmp_path)
+        sess_dir = tmp_path / "sess"
+        cut = 1100
+        with obs_journal.run(tmp_path / "obs1", config={}) as jr1:
+            app = ServeApp(ckpt, buckets=(1, 8), sessions_dir=sess_dir,
+                           journal=jr1).start()
+            try:
+                _post(app.url + "/session/open", json.dumps(
+                    {"session": "r1", "hop": HOP,
+                     "ems_init_block_size": BLOCK}).encode())
+                _post(app.url + "/session/r1/samples",
+                      recording[:, :cut].astype("<f4").tobytes(),
+                      "application/octet-stream")
+            finally:
+                app.stop()  # SIGTERM-shaped drain: snapshot lands here
+        with obs_journal.run(tmp_path / "obs2", config={}) as jr2:
+            app2 = ServeApp(ckpt, buckets=(1, 8), sessions_dir=sess_dir,
+                            resume=True, journal=jr2).start()
+            try:
+                state = _get(app2.url + "/session/r1/state")
+                assert state["acked"] == cut
+                # The re-open handshake reports resumed=True, cursor intact.
+                reopened = _post(app2.url + "/session/open", json.dumps(
+                    {"session": "r1", "hop": HOP}).encode())
+                assert reopened["resumed"] is True
+                assert reopened["acked"] == cut
+                _post(app2.url + "/session/r1/samples",
+                      recording[:, cut:].astype("<f4").tobytes(),
+                      "application/octet-stream")
+                final = _post(app2.url + "/session/r1/close", b"{}")
+            finally:
+                app2.stop()
+        engine = InferenceEngine.from_checkpoint(ckpt, (1, 8), warm=False)
+        offline = engine.infer(_offline_windows(_offline_std(recording)))
+        np.testing.assert_array_equal(
+            np.asarray(final["preds"], np.int64), offline)
+        ev2 = schema.read_events(jr2.events_path)
+        resumes = [e for e in ev2 if e["event"] == "session_resume"]
+        assert len(resumes) == 1 and resumes[0]["acked"] == cut
+        assert schema.event_summary(ev2)["session_resumes"] == 1
+
+
+class TestLogFileDefault:
+    """ISSUE-7 satellite: the log sink must not land as ``app.log`` in the
+    CWD (repo pollution; supervisor children sharing a CWD collide)."""
+
+    def test_default_under_reports_logs_with_pid(self, monkeypatch):
+        from eegnetreplication_tpu.utils.logging import default_log_file
+
+        monkeypatch.delenv("EEGTPU_LOG_FILE", raising=False)
+        monkeypatch.setenv("EEGTPU_DATA_ROOT", "/some/root")
+        path = Path(default_log_file())
+        assert path.parent == Path("/some/root/reports/logs")
+        assert path.name == f"app-{os.getpid()}.log"
+
+    def test_explicit_override_wins(self, monkeypatch):
+        from eegnetreplication_tpu.utils.logging import default_log_file
+
+        monkeypatch.setenv("EEGTPU_LOG_FILE", "/tmp/custom.log")
+        assert default_log_file() == "/tmp/custom.log"
+
+
+class TestStreamBenchSelftest:
+    def test_selftest_passes(self, tmp_path):
+        """Tier-1 acceptance leg: paced 250 Hz replay with byte-identical
+        decisions and p95 window latency under the hop interval, then
+        SIGKILL-mid-stream under a supervisor with an exact resumed
+        decision stream."""
+        out = tmp_path / "BENCH_STREAM_selftest.json"
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "stream_bench.py"),
+             "--selftest", "--seconds", "4", "--out", str(out)],
+            capture_output=True, text=True, timeout=600,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu"))
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads(out.read_text())
+        replay = record["replay"]
+        assert replay["parity"] is True
+        assert replay["expired"] == 0
+        assert replay["p95_window_ms"] < replay["hop_interval_ms"]
+        resume = record["kill_resume"]
+        assert resume["decisions_equal"] is True
+        assert resume["duplicate_conflicts"] == 0
+        assert resume["restarts"] >= 1
+        assert resume["session_resumes"] >= 1
