@@ -61,6 +61,11 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "request": ("n_trials", "latency_ms", "status"),
     "model_swap": ("checkpoint", "digest"),
     "serve_end": ("n_requests", "rejected", "wall_s"),
+    # Quantized + self-tuning hot path: the int8-vs-fp32 argmax
+    # equivalence verdict (an int8 engine may only serve after a "pass"),
+    # and every LadderTuner bucket-ladder/coalescing-window retune.
+    "quant_gate": ("precision", "outcome", "agreement", "floor"),
+    "ladder_retune": ("old_buckets", "new_buckets", "reason"),
     # Streaming sessions (serve/sessions/): one stream's lifecycle, every
     # window decision, the durable snapshot/restore pair, and the
     # graceful-degradation record of a window that missed its deadline.
@@ -317,6 +322,19 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         if lat:
             out["latency_p50_ms"] = round(lat[int(0.50 * (len(lat) - 1))], 3)
             out["latency_p95_ms"] = round(lat[int(0.95 * (len(lat) - 1))], 3)
+        retunes = [e for e in events if e["event"] == "ladder_retune"]
+        if retunes:
+            out["ladder_retunes"] = len(retunes)
+        serve_starts = [e for e in events if e["event"] == "serve_start"]
+        if serve_starts and serve_starts[-1].get("precision"):
+            out["precision"] = serve_starts[-1]["precision"]
+    # Quantization gate: the last verdict is the one that decided what
+    # serves (reported for any stream that ran the gate — server, CLI,
+    # or bench).
+    gates = [e for e in events if e["event"] == "quant_gate"]
+    if gates:
+        out["quant_gate"] = gates[-1].get("outcome")
+        out["quant_agreement"] = gates[-1].get("agreement")
     # Streaming sessions: stream counts, per-window tail latency,
     # deadline misses, and snapshot/resume activity — only reported for
     # streams that actually served sessions.

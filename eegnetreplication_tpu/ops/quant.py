@@ -1,0 +1,358 @@
+"""Per-channel symmetric int8 weight quantization for the inference path.
+
+The serving hot path is weight-stationary: the same small kernel set
+multiplies every request, so the weights are the one tensor worth storing
+in a cheaper form.  This module quantizes EEGNet's conv/dense kernels to
+int8 with one fp32 scale per *output channel* (symmetric, zero-point-free:
+``w ~= q * scale`` with ``q in [-127, 127]``), leaving BatchNorm
+parameters/statistics and biases in fp32 — they are per-channel affines
+whose precision is what keeps the argmax honest, and they are tiny.
+
+Quantization happens ONCE at engine load; dequantization happens inside
+the jitted forward (``quantized_eval_forward``), so the stored/served
+weight form is int8 and the compute stays fp32 — the scheme that changes
+numerics least (weight rounding only, bounded by ``scale/2`` per element;
+:func:`quantization_error` reports the realized bound per layer, and the
+serving gate in ``serve/engine.py`` refuses any quantization whose argmax
+disagrees with fp32 beyond the configured floor).
+
+For the stock EEGNet the quantized forward is additionally *specialized*:
+block 1 runs the same algebraic fusion as ``ops/fused_eegnet.py`` (derived
+in-kernel from the dequantized kernels, so XLA folds it at compile time),
+block 2's depthwise conv is unrolled into 16 shifted FMAs (XLA:CPU's
+``conv_general_dilated`` carries ~0.15 ms of fixed overhead per call —
+measured dominant at batch-1), the block-2 BatchNorm folds into the
+pointwise matmul, and AvgPool(8)+flatten+classifier collapse into one
+einsum.  Measured on CPU at (22, 257): ~1.7x the fp32 fused forward at
+batch-1 and ~1.35x at batch-32, at argmax agreement 1.0 on a trained
+checkpoint's test set (the gate journals the exact number per subject).
+
+Round-trip persistence: :func:`flatten_qparams` / :func:`unflatten_qparams`
+give the quantized tree a flat ``{key: ndarray}`` form whose
+:func:`~eegnetreplication_tpu.resil.integrity.content_digest` is stable
+across ``save_quantized``/``load_quantized`` npz round trips (int8 payloads
+are exact), so quantized artifacts carry the same embedded-sha256 contract
+as every other checkpoint in the repo.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Mapping
+
+import numpy as np
+
+from eegnetreplication_tpu.resil import integrity
+
+# Symmetric signed-int8 range.  -128 is excluded so the grid is symmetric
+# around zero and |q| * scale can never exceed the calibrated amax.
+QMAX = 127
+
+_Q_KEYS = frozenset(("q", "scale"))
+
+# Parameter leaves that get quantized: every conv/dense weight is named
+# "kernel" by the flax modules (see models/eegnet.py); biases and
+# BatchNorm scale/bias/mean/var keep fp32 by design.
+QUANTIZED_LEAF = "kernel"
+
+
+def is_qleaf(node: Any) -> bool:
+    """True for a quantized-tensor node (``{"q": int8, "scale": f32}``)."""
+    return (isinstance(node, Mapping) and set(node.keys()) == _Q_KEYS
+            and getattr(node["q"], "dtype", None) == np.int8)
+
+
+def quantize_tensor(w: np.ndarray, axis: int = -1) -> dict[str, np.ndarray]:
+    """Per-channel symmetric int8 quantization of one weight tensor.
+
+    ``axis`` names the output-channel axis (last for every flax conv/dense
+    kernel); each output channel gets its own scale ``amax / 127`` so a
+    single large filter cannot crush the resolution of the others.  An
+    all-zero channel keeps scale 1.0 (its q is all-zero anyway — avoids a
+    0/0 at dequantization).
+    """
+    w = np.asarray(w, np.float32)
+    axis = axis % w.ndim
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
+    scale = np.where(amax > 0, amax / QMAX, 1.0).astype(np.float32)
+    q = np.clip(np.rint(w / scale), -QMAX, QMAX).astype(np.int8)
+    return {"q": q, "scale": scale}
+
+
+def dequantize_tensor(qleaf: Mapping[str, Any]):
+    """``q * scale`` as fp32; jnp-safe (usable inside a jitted forward)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(qleaf["q"], jnp.float32) * jnp.asarray(qleaf["scale"])
+
+
+def quantize_params(params: Any) -> dict:
+    """The params tree with every ``kernel`` leaf replaced by a quantized
+    node; all other leaves pass through as fp32 numpy arrays."""
+    def walk(node):
+        if hasattr(node, "items"):
+            return {k: (quantize_tensor(v)
+                        if k == QUANTIZED_LEAF and hasattr(v, "shape")
+                        else walk(v))
+                    for k, v in node.items()}
+        return np.asarray(node)
+
+    return walk(params)
+
+
+def dequantize_params(qparams: Any):
+    """The fp32 tree back from a quantized one (jnp leaves, jit-safe)."""
+    import jax.numpy as jnp
+
+    def walk(node):
+        if is_qleaf(node):
+            return dequantize_tensor(node)
+        if hasattr(node, "items"):
+            return {k: walk(v) for k, v in node.items()}
+        return jnp.asarray(node)
+
+    return walk(qparams)
+
+
+def quantization_error(params: Any, qparams: Any) -> dict[str, dict]:
+    """Per-layer realized round-trip error vs the analytic bound.
+
+    For symmetric round-to-nearest the elementwise error is bounded by
+    ``scale / 2``; the returned record carries the realized ``max_abs_err``
+    and that ``bound`` per quantized layer so tests (and the bench
+    artifact) can pin the contract.
+    """
+    out: dict[str, dict] = {}
+
+    def walk(p, q, path):
+        if is_qleaf(q):
+            w = np.asarray(p, np.float32)
+            dq = np.asarray(q["q"], np.float32) * np.asarray(q["scale"])
+            err = np.abs(w - dq)
+            out["/".join(path)] = {
+                "max_abs_err": float(err.max()) if err.size else 0.0,
+                "bound": float(np.max(q["scale"]) / 2.0),
+                "rel_fro": float(np.linalg.norm(w - dq)
+                                 / max(np.linalg.norm(w), 1e-12)),
+            }
+            return
+        if hasattr(q, "items"):
+            for k in q:
+                walk(p[k], q[k], path + (str(k),))
+
+    walk(params, qparams, ())
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Flat round-trip form (npz persistence with the integrity digest contract).
+# ---------------------------------------------------------------------------
+
+_SEP = "/"
+_Q_SUFFIX = ".q"
+_SCALE_SUFFIX = ".scale"
+
+
+def flatten_qparams(qparams: Any, prefix: str = "qparams/"
+                    ) -> dict[str, np.ndarray]:
+    """Flatten a quantized tree to ``{key: ndarray}``.
+
+    Quantized nodes flatten to two entries (``<path>.q`` int8 and
+    ``<path>.scale`` f32); fp32 leaves keep their plain path.  Flax
+    parameter names never contain ``.``, so the suffixes cannot collide
+    with a real leaf.
+    """
+    flat: dict[str, np.ndarray] = {}
+
+    def walk(node, path: str):
+        if is_qleaf(node):
+            flat[path + _Q_SUFFIX] = np.asarray(node["q"])
+            flat[path + _SCALE_SUFFIX] = np.asarray(node["scale"])
+            return
+        if hasattr(node, "items"):
+            for k, v in node.items():
+                walk(v, path + _SEP + str(k) if path else str(k))
+            return
+        flat[path] = np.asarray(node)
+
+    for k, v in qparams.items():
+        walk(v, prefix + str(k))
+    return flat
+
+
+def unflatten_qparams(flat: Mapping[str, np.ndarray],
+                      prefix: str = "qparams/") -> dict:
+    """Inverse of :func:`flatten_qparams` (keys outside ``prefix`` are
+    ignored, so the flat dict may carry metadata/digest entries)."""
+    tree: dict = {}
+    for key in sorted(flat):
+        if not key.startswith(prefix):
+            continue
+        path = key[len(prefix):]
+        if path.endswith(_Q_SUFFIX):
+            parts, leaf = path[: -len(_Q_SUFFIX)].split(_SEP), "q"
+        elif path.endswith(_SCALE_SUFFIX):
+            parts, leaf = path[: -len(_SCALE_SUFFIX)].split(_SEP), "scale"
+        else:
+            parts, leaf = path.split(_SEP), None
+        node = tree
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        if leaf is None:
+            node[parts[-1]] = np.asarray(flat[key])
+        else:
+            node.setdefault(parts[-1], {})[leaf] = np.asarray(flat[key])
+    return tree
+
+
+def qparams_digest(qparams: Any) -> str:
+    """sha256 content digest of the quantized tree (its flat form) — the
+    identity of what an int8 engine actually multiplies by.  Stable across
+    ``save_quantized``/``load_quantized`` round trips: int8 and f32 npz
+    payloads are byte-exact."""
+    return integrity.content_digest(flatten_qparams(qparams))
+
+
+def save_quantized(path: str | Path, qparams: Any,
+                   metadata: dict | None = None) -> Path:
+    """Persist a quantized tree as an integrity-stamped npz (atomic
+    tmp+rename, same contract as ``training/checkpoint.py``)."""
+    import json
+
+    flat = flatten_qparams(qparams)
+    if metadata:
+        flat["__metadata__"] = np.frombuffer(
+            json.dumps(metadata).encode(), dtype=np.uint8)
+    integrity.stamp(flat)
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(path.suffix + ".tmp")
+    with open(tmp, "wb") as fh:  # file handle: np.savez must not append .npz
+        np.savez(fh, **flat)
+    tmp.replace(path)
+    return path
+
+
+def load_quantized(path: str | Path) -> tuple[dict, dict]:
+    """Load ``(qparams, metadata)`` from :func:`save_quantized` output;
+    raises :class:`~eegnetreplication_tpu.resil.integrity.IntegrityError`
+    on content-digest mismatch."""
+    import json
+
+    with np.load(path) as data:
+        flat = {k: data[k] for k in data.files}
+    integrity.verify(flat, what=f"quantized checkpoint {path}")
+    metadata = {}
+    if "__metadata__" in flat:
+        metadata = json.loads(bytes(flat.pop("__metadata__")).decode())
+    flat.pop(integrity.DIGEST_KEY, None)
+    return unflatten_qparams(flat), metadata
+
+
+# ---------------------------------------------------------------------------
+# The quantized eval forward.
+# ---------------------------------------------------------------------------
+
+def supports_quantized_eval(model) -> bool:
+    """True when the specialized int8 EEGNet program encodes ``model``'s
+    architecture exactly (same gate as the fp32 fused path: stock EEGNet,
+    f32, Precision.HIGHEST).  Other models still serve int8 weights via
+    the generic dequantize-then-apply path."""
+    from eegnetreplication_tpu.ops.fused_eegnet import supports_fused_eval
+
+    return supports_fused_eval(model)
+
+
+def quantized_eval_forward(model, qparams, batch_stats, x):
+    """Eval-mode logits from int8 weights; dequantization is in-program.
+
+    Stock EEGNet routes through :func:`_quantized_eegnet_logits` (the
+    specialized schedule documented in the module docstring); any other
+    model dequantizes the tree and runs its regular eval forward.  Either
+    way the caller jits the whole thing, so the dequantize + fold work is
+    constant-folded at compile time and the runtime program touches only
+    the final operand forms.
+    """
+    if supports_quantized_eval(model):
+        return _quantized_eegnet_logits(model, qparams, batch_stats, x)
+    from eegnetreplication_tpu.training.steps import eval_forward
+
+    return eval_forward(model, dequantize_params(qparams), batch_stats, x,
+                        allow_pallas=False)
+
+
+def _quantized_eegnet_logits(model, qparams, batch_stats, x):
+    """The specialized quantized EEGNet program: fused block 1, unrolled
+    depthwise taps, BN2 folded into the pointwise matmul, pool+classifier
+    as one einsum.  Matches ``eval_forward`` argmax within the quant
+    gate's floor (exact agreement on trained checkpoints in practice)."""
+    import jax
+    import jax.numpy as jnp
+
+    from eegnetreplication_tpu.ops.fused_eegnet import (
+        PAD_LEFT,
+        PAD_RIGHT,
+        TEMPORAL_K,
+        _elu,
+    )
+
+    hi = jax.lax.Precision.HIGHEST
+    eps = model.bn_epsilon
+    w_t = dequantize_tensor(qparams["temporal_conv"]["kernel"])  # (1,K,1,F1)
+    w_s = dequantize_tensor(qparams["spatial_conv"]["kernel"])   # (C,1,1,F2)
+    f1, f2 = w_t.shape[-1], w_s.shape[-1]
+    d = f2 // f1
+
+    def bn_affine(name, n):
+        p, stats = qparams[name], batch_stats[name]
+        scale = jnp.asarray(p["scale"]) / jnp.sqrt(
+            jnp.asarray(stats["var"]) + eps)
+        shift = jnp.asarray(p["bias"]) - jnp.asarray(stats["mean"]) * scale
+        return scale.reshape(n), shift.reshape(n)
+
+    # Block 1: the same algebraic fusion as ops/fused_eegnet.py, derived
+    # from the dequantized kernels (folded by XLA at compile time).
+    a1, b1 = bn_affine("temporal_bn", f1)
+    a2, b2 = bn_affine("spatial_bn", f2)
+    S = jnp.transpose(w_s[:, 0, 0, :])                 # (F2, C)
+    group = jnp.arange(f2) // d
+    W = jnp.transpose(w_t[0, :, 0, :])[group]          # (F2, K)
+    A = a2 * a1[group]
+    B = a2 * (b1[group] * jnp.sum(S, axis=1)) + b2
+    t = x.shape[-1]
+    mixed = jnp.einsum("fc,bct->bft", S, x, precision=hi)
+    padded = jnp.pad(mixed, ((0, 0), (0, 0), (PAD_LEFT, PAD_RIGHT)))
+    acc = jnp.zeros_like(mixed)
+    for k in range(TEMPORAL_K):
+        acc = acc + W[None, :, k:k + 1] * padded[..., k:k + t]
+    act = _elu(A[None, :, None] * acc + B[None, :, None])
+    tp = t // 4
+    h = jnp.mean(act[..., : tp * 4].reshape(*act.shape[:-1], tp, 4), axis=-1)
+
+    # Block 2 depthwise (SAME for k=16 pads (7, 8), matching torch/XLA):
+    # 16 unrolled shifted FMAs instead of lax.conv — the conv op's fixed
+    # XLA:CPU overhead (~0.15 ms) dominates batch-1 latency.
+    w_dw = dequantize_tensor(qparams["separable_depthwise"]["kernel"])
+    wdw = w_dw[0, :, 0, :]                             # (16, F2)
+    hp = jnp.pad(h, ((0, 0), (0, 0), (7, 8)))
+    acc2 = jnp.zeros_like(h)
+    for k in range(16):
+        acc2 = acc2 + wdw[k][None, :, None] * hp[..., k:k + tp]
+
+    # Pointwise conv with block2_bn folded into its weights/bias.
+    w_pw = dequantize_tensor(qparams["separable_pointwise"]["kernel"])[0, 0]
+    s2, sh2 = bn_affine("block2_bn", f2)
+    h3 = jnp.einsum("bft,fg->bgt", acc2, w_pw * s2[None, :],
+                    precision=hi) + sh2[None, :, None]
+    act3 = _elu(h3)                                    # (B, F2, tp)
+
+    # AvgPool(8) + NHWC flatten ((t', f) order) + classifier as ONE einsum:
+    # spread each classifier row over its 8 pooled inputs (/8 = the mean).
+    w_c = dequantize_tensor(qparams["classifier"]["kernel"])   # (t8*F2, n_cls)
+    t8 = tp // 8
+    w_full = jnp.repeat(w_c.reshape(t8, f2, -1), 8, axis=0) / 8.0
+    logits = jnp.einsum("bft,tfk->bk", act3[..., : t8 * 8], w_full,
+                        precision=hi) + jnp.asarray(
+                            qparams["classifier"]["bias"])
+    return logits.astype(jnp.float32)

@@ -37,21 +37,29 @@ from eegnetreplication_tpu.utils.logging import logger
 
 
 def predict_trials(model, params, batch_stats, X: np.ndarray,
-                   batch_size: int = 256) -> np.ndarray:
+                   batch_size: int = 256,
+                   precision: str = "fp32") -> np.ndarray:
     """Class predictions for ``(n, C, T)`` trials (Pallas-fused on TPU).
 
     A thin wrapper over :class:`~eegnetreplication_tpu.serve.engine.InferenceEngine`
     — the same bucketed padded forward the online service runs, capped at
     ``batch_size``, so a CLI prediction and a served prediction are the
     same computation by construction (``scripts/serve_smoke.py`` pins it).
+
+    ``precision="int8"`` routes through the same gated builder as the
+    server (``engine.build_gated_engine``): the quantized engine serves
+    only if its argmax matches fp32 on the deterministic gate set, else
+    this falls back to fp32 — the CLI and the server reach the same
+    verdict on the same checkpoint by construction.
     """
     from eegnetreplication_tpu.serve.engine import (
-        InferenceEngine,
         bucket_ladder,
+        build_gated_engine,
     )
 
-    engine = InferenceEngine(model, params, batch_stats,
-                             bucket_ladder(batch_size))
+    engine, _gate = build_gated_engine(
+        model, params, batch_stats, bucket_ladder(batch_size),
+        precision=precision, warm=False)
     return engine.infer(np.asarray(X, np.float32))
 
 
@@ -101,6 +109,12 @@ def main(argv=None) -> int:
                         choices=["Train", "Eval"],
                         help="Session to use with --subject.")
     parser.add_argument("--batchSize", type=int, default=256)
+    parser.add_argument("--precision", choices=["fp32", "int8"],
+                        default="fp32",
+                        help="Engine weight precision; int8 is gated by "
+                             "the fp32-argmax equivalence check (falls "
+                             "back to fp32 on refusal), exactly like the "
+                             "server.")
     args = parser.parse_args(argv)
 
     model, params, batch_stats = load_model_from_checkpoint(args.checkpoint)
@@ -117,7 +131,8 @@ def main(argv=None) -> int:
 
     t0 = time.perf_counter()
     pred = predict_trials(model, params, batch_stats,
-                          ds.X.astype(np.float32), args.batchSize)
+                          ds.X.astype(np.float32), args.batchSize,
+                          precision=args.precision)
     wall = time.perf_counter() - t0
     _log_inference_throughput(model, len(pred), wall, args.batchSize)
     counts = np.bincount(pred, minlength=len(CLASS_NAMES))

@@ -23,6 +23,12 @@ Serving decomposes into four pieces, each independently testable:
   (``POST /predict``, ``POST /reload``, ``GET /healthz``,
   ``GET /metrics``), graceful SIGTERM drain via ``resil.preempt``, and
   the ``serve.forward`` chaos site under the shared retry policy.
+- :mod:`~eegnetreplication_tpu.serve.tuner` — self-tuning bucket ladder:
+  the LadderTuner watches live ``bucket_fill`` occupancy + arrival rate,
+  warms a revised ladder off the hot path and swaps it atomically
+  (``ladder_retune`` events, zero dropped requests).  The engine also
+  has an int8 weight-quantized variant (``ops/quant.py``) behind a
+  mandatory fp32-argmax equivalence gate.
 - :mod:`~eegnetreplication_tpu.serve.sessions` — durable streaming BCI
   sessions (the paper's live-headset workload): per-stream EMS carry +
   sliding-window state, snapshotted through ``resil.integrity`` with
@@ -39,9 +45,13 @@ pins server-vs-CLI prediction equality.
 from eegnetreplication_tpu.serve.batcher import MicroBatcher, Rejected
 from eegnetreplication_tpu.serve.engine import (
     DEFAULT_BUCKETS,
+    QUANT_AGREEMENT_FLOOR,
     InferenceEngine,
+    QuantGateResult,
     bucket_ladder,
+    build_gated_engine,
     load_model_from_checkpoint,
+    run_quant_gate,
     variables_digest,
 )
 from eegnetreplication_tpu.serve.registry import ModelRegistry
@@ -51,11 +61,15 @@ from eegnetreplication_tpu.serve.sessions import (
     StreamSession,
     WindowDecision,
 )
+from eegnetreplication_tpu.serve.tuner import LadderStats, LadderTuner, Proposal
 
 __all__ = [
     "DEFAULT_BUCKETS", "InferenceEngine", "bucket_ladder",
     "load_model_from_checkpoint", "variables_digest",
+    "QUANT_AGREEMENT_FLOOR", "QuantGateResult", "build_gated_engine",
+    "run_quant_gate",
     "MicroBatcher", "Rejected", "ModelRegistry",
+    "LadderStats", "LadderTuner", "Proposal",
     "ServeApp", "serve_until_preempted",
     "SessionStore", "StreamSession", "WindowDecision",
 ]

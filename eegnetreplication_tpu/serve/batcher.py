@@ -161,6 +161,31 @@ class MicroBatcher:
             self._cv.notify_all()
         return fut
 
+    def reconfigure(self, *, max_batch: int | None = None,
+                    max_wait_ms: float | None = None) -> None:
+        """Adopt a new coalescing cap and/or window, live (the
+        LadderTuner calls this right after the registry swaps onto a new
+        ladder so ``max_batch`` tracks the top bucket).
+
+        Queued requests are untouched; the next ``_coalesce_locked`` pass
+        simply reads the new values.  ``max_batch`` is clamped to
+        ``max_queue_trials`` (the constructor invariant) — a ladder that
+        outgrows the queue bound coalesces at the bound.
+        """
+        with self._cv:
+            if max_batch is not None:
+                mb = int(max_batch)
+                if mb < 1:
+                    raise ValueError(f"max_batch must be >= 1, got {mb}")
+                self.max_batch = min(mb, self.max_queue_trials)
+            if max_wait_ms is not None:
+                ms = float(max_wait_ms)
+                if ms < 0:
+                    raise ValueError(
+                        f"max_wait_ms must be >= 0, got {ms}")
+                self.max_wait_s = ms / 1000.0
+            self._cv.notify_all()
+
     def close(self, drain: bool = True, timeout: float = 30.0) -> None:
         """Stop accepting; drain (default) or fail what is queued, then
         join the worker.  Idempotent."""
@@ -212,7 +237,17 @@ class MicroBatcher:
                          ) -> list[tuple[np.ndarray, Future, float]]:
         """Honor the coalescing window and pop one batch (``self._cv``
         held).  Requests whose deadline passed while queued go onto
-        ``expired`` instead of into the batch."""
+        ``expired`` instead of into the batch.
+
+        Dequeue is GREEDY across requests: a request too large to join
+        the current batch is skipped (kept at the queue front, in order)
+        and the scan continues, so a full bucket's worth of later small
+        requests coalesces NOW instead of trickling out one underfilled
+        forward per misfit — the regression shape is a full top bucket
+        queued behind a smaller head request.  No starvation: a skipped
+        request reaches the head eventually and the head is always taken,
+        oversize or not.
+        """
         # Coalesce: wait until max_batch trials are queued or max_wait
         # has elapsed since the FIRST pending request — bounded added
         # latency, never an idle park.
@@ -226,21 +261,24 @@ class MicroBatcher:
         batch = []
         n = 0
         now = time.monotonic()
-        while self._pending:
-            req_n = len(self._pending[0][0])
-            x, fut, t_enq, deadline = self._pending[0]
+        skipped: list[tuple[np.ndarray, Future, float, float | None]] = []
+        while self._pending and n < self.max_batch:
+            x, fut, t_enq, deadline = self._pending.popleft()
+            req_n = len(x)
             if deadline is not None and now >= deadline:
                 # Expired while queued: drop before the forward.
-                self._pending.popleft()
                 self._pending_trials -= req_n
                 expired.append(fut)
                 self._journal.metrics.inc("requests_expired")
                 continue
             if batch and n + req_n > self.max_batch:
-                break  # FIFO: the tail waits for the next batch
-            self._pending.popleft()
+                skipped.append((x, fut, t_enq, deadline))
+                continue  # greedy: later requests may still fit
             batch.append((x, fut, t_enq))
             n += req_n
+        # Skipped requests return to the FRONT in their arrival order —
+        # they are older than everything behind them.
+        self._pending.extendleft(reversed(skipped))
         self._pending_trials -= n
         self._gauge_depth_locked()
         return batch

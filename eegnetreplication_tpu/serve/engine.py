@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import threading
 import time
+from dataclasses import dataclass, field
 from pathlib import Path
 
 import numpy as np
@@ -36,6 +37,24 @@ from eegnetreplication_tpu.utils.logging import logger
 # cheap (4 compiles), dense enough that occupancy (real/padded trials)
 # never drops below 50% once two requests coalesce.
 DEFAULT_BUCKETS = (1, 8, 32, 128)
+
+# Engine weight precisions: fp32 is the reference path; int8 stores
+# per-channel symmetric quantized kernels (ops/quant.py) and dequantizes
+# inside the jitted forward.  An int8 engine may only serve after the
+# equivalence gate (run_quant_gate) confirmed argmax agreement with fp32.
+PRECISIONS = ("fp32", "int8")
+
+# Minimum per-subject argmax agreement (int8 vs fp32) for the quantized
+# engine to be allowed to serve.  1.0 is the observed value on trained
+# checkpoints; the floor leaves headroom for genuinely tied logits
+# (random-init models measure 0.994-1.0 on synthetic trials).  Any
+# subject below the floor refuses the int8 engine and serving falls back
+# to fp32 — refuse-and-keep-serving, the hot-reload integrity shape.
+QUANT_AGREEMENT_FLOOR = 0.99
+
+# Gate-set size when no real eval data is available (deterministic
+# synthetic trials so the CLI and the server reach the same verdict).
+QUANT_GATE_N = 256
 
 # BCI-IV-2a class labels, index-aligned with the model's logits.  Defined
 # here (the module both the predict CLI and the HTTP service already
@@ -120,8 +139,8 @@ class InferenceEngine:
 
     def __init__(self, model, params, batch_stats,
                  buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
-                 digest: str | None = None, source: str | None = None,
-                 journal=None):
+                 precision: str = "fp32", digest: str | None = None,
+                 source: str | None = None, journal=None):
         import jax
         import jax.numpy as jnp
 
@@ -136,32 +155,58 @@ class InferenceEngine:
             raise ValueError(
                 f"buckets must be strictly increasing positive ints, got "
                 f"{buckets!r}")
+        if precision not in PRECISIONS:
+            raise ValueError(f"precision must be one of {PRECISIONS}, got "
+                             f"{precision!r}")
         self.model = model
         self.params = params
         self.batch_stats = batch_stats
         self.buckets = tuple(int(b) for b in buckets)
+        self.precision = precision
         self.source = source
+        # The digest stays the fp32 variables digest for BOTH precisions:
+        # it is the identity of the weights being served (what /healthz
+        # and the fleet canary compare), and int8 is a derived encoding
+        # of the same weights, not different ones.
         self.digest = digest or variables_digest(params, batch_stats)
+        self.quantized_digest: str | None = None
         self._journal = journal if journal is not None \
             else obs_journal.current()
         self._lock = threading.Lock()
         self._jnp = jnp
-        if supports_fused_eval(model):
-            probe_pallas(model)  # validate/enable the TPU kernel eagerly
-        self._fwd = jax.jit(lambda xx: jnp.argmax(
-            eval_forward(model, params, batch_stats, xx, allow_pallas=True),
-            axis=-1))
+        if precision == "int8":
+            from eegnetreplication_tpu.ops import quant
+
+            self.qparams = quant.quantize_params(params)
+            self.quantized_digest = quant.qparams_digest(self.qparams)
+            qparams, bs = self.qparams, batch_stats
+            self._fwd = jax.jit(lambda xx: jnp.argmax(
+                quant.quantized_eval_forward(model, qparams, bs, xx),
+                axis=-1))
+        else:
+            if supports_fused_eval(model):
+                probe_pallas(model)  # validate/enable the TPU kernel eagerly
+            self._fwd = jax.jit(lambda xx: jnp.argmax(
+                eval_forward(model, params, batch_stats, xx,
+                             allow_pallas=True),
+                axis=-1))
         self._warmed = False
 
     @classmethod
     def from_checkpoint(cls, path: str | Path,
                         buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
-                        warm: bool = True, journal=None) -> "InferenceEngine":
+                        precision: str = "fp32", warm: bool = True,
+                        journal=None) -> "InferenceEngine":
         """Load ``path`` (integrity-verified by the loaders) and optionally
-        pre-compile every bucket before the engine is handed out."""
+        pre-compile every bucket before the engine is handed out.
+
+        NOTE: constructing an int8 engine directly skips the equivalence
+        gate; serving callers go through the registry (or
+        :func:`build_gated_engine`) which refuses an ungated int8 path.
+        """
         model, params, batch_stats = load_model_from_checkpoint(path)
         engine = cls(model, params, batch_stats, buckets,
-                     source=str(path), journal=journal)
+                     precision=precision, source=str(path), journal=journal)
         if warm:
             engine.warmup()
         return engine
@@ -211,8 +256,9 @@ class InferenceEngine:
             # already-warm engine stays a pure no-op (no global jax
             # config mutation when no compile will happen).
             cache_dir = enable_compilation_cache(explicit_only=True)
+            tag = "" if self.precision == "fp32" else f"_{self.precision}"
             for b in self.buckets:
-                what = f"serve_forward_b{b}"
+                what = f"serve_forward{tag}_b{b}"
                 self._journal.event("compile_begin", what=what)
                 probe = compile_cache_probe(cache_dir)
                 t0 = time.perf_counter()
@@ -271,3 +317,145 @@ class InferenceEngine:
                 self._journal.metrics.observe("bucket_fill", k / b,
                                               bucket=str(b))
         return out
+
+
+# ---------------------------------------------------------------------------
+# The int8 equivalence gate: a quantized engine may only serve after its
+# argmax matches the fp32 reference on the gate set.
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class QuantGateResult:
+    """Outcome of one fp32-vs-int8 argmax equivalence check."""
+
+    outcome: str                      # "pass" | "refused"
+    agreement: float                  # overall fraction of agreeing trials
+    per_subject: dict[str, float] = field(default_factory=dict)
+    floor: float = QUANT_AGREEMENT_FLOOR
+    n_trials: int = 0
+    gate_source: str = "synthetic"    # "bci_iv_2a_eval" or "synthetic"
+
+    @property
+    def passed(self) -> bool:
+        return self.outcome == "pass"
+
+
+def default_gate_set(n_channels: int, n_times: int, *,
+                     n_synthetic: int = QUANT_GATE_N
+                     ) -> tuple[str, list[tuple[str, np.ndarray]]]:
+    """The gate set: the full BCI-IV-2a Eval sessions when the processed
+    data is on disk (one entry per subject), else deterministic seeded
+    synthetic trials.
+
+    Deterministic by construction so every consumer of the same checkpoint
+    (the serving registry, the predict CLI, the bench) reaches the SAME
+    pass/refuse verdict — CLI and server cannot drift on precision.
+    """
+    subjects: list[tuple[str, np.ndarray]] = []
+    try:
+        from eegnetreplication_tpu.data.io import load_subject_dataset
+
+        for subject in range(1, 10):
+            try:
+                ds = load_subject_dataset(subject=subject, mode="Eval")
+            except Exception:  # noqa: BLE001 — subject not on disk
+                continue
+            x = np.asarray(ds.X, np.float32)
+            if x.ndim == 3 and x.shape[1:] == (n_channels, n_times):
+                subjects.append((f"A{subject:02d}E", x))
+    except Exception:  # noqa: BLE001 — data layer unavailable entirely
+        pass
+    if subjects:
+        return "bci_iv_2a_eval", subjects
+    rng = np.random.RandomState(20260804)
+    return "synthetic", [("synthetic", rng.randn(
+        n_synthetic, n_channels, n_times).astype(np.float32))]
+
+
+def run_quant_gate(reference: InferenceEngine, candidate: InferenceEngine,
+                   gate_set: list[tuple[str, np.ndarray]] | None = None, *,
+                   floor: float = QUANT_AGREEMENT_FLOOR,
+                   journal=None) -> QuantGateResult:
+    """Mandatory equivalence check before an int8 engine may serve.
+
+    Runs both engines over every gate subject and compares argmax
+    predictions; ANY subject below ``floor`` refuses the candidate.  The
+    verdict (with per-subject agreement) is journaled as a ``quant_gate``
+    event either way — the artifact trail for "unchanged accuracy".
+    """
+    journal = journal if journal is not None else obs_journal.current()
+    c, t = reference.geometry
+    source = "caller"
+    if gate_set is None:
+        source, gate_set = default_gate_set(c, t)
+    per_subject: dict[str, float] = {}
+    agree_total = 0
+    n_total = 0
+    for subject, x in gate_set:
+        ref = reference.infer(x)
+        got = candidate.infer(x)
+        per_subject[subject] = float(np.mean(ref == got))
+        agree_total += int(np.sum(ref == got))
+        n_total += len(x)
+    agreement = agree_total / max(n_total, 1)
+    outcome = "pass" if (n_total and
+                         min(per_subject.values()) >= floor) else "refused"
+    result = QuantGateResult(outcome=outcome, agreement=agreement,
+                             per_subject=per_subject, floor=floor,
+                             n_trials=n_total, gate_source=source)
+    journal.event("quant_gate", precision=candidate.precision,
+                  outcome=outcome, agreement=round(agreement, 6),
+                  per_subject={k: round(v, 6)
+                               for k, v in per_subject.items()},
+                  floor=floor, n_trials=n_total, gate_source=source,
+                  digest=candidate.digest,
+                  quantized_digest=candidate.quantized_digest)
+    journal.metrics.set("quant_gate_agreement", agreement)
+    (logger.info if outcome == "pass" else logger.warning)(
+        "Quant gate %s: int8 vs fp32 argmax agreement %.4f over %d trials "
+        "(%s, floor %.3f)", outcome.upper(), agreement, n_total, source,
+        floor)
+    return result
+
+
+def build_gated_engine(model, params, batch_stats,
+                       buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                       precision: str = "fp32",
+                       floor: float = QUANT_AGREEMENT_FLOOR,
+                       gate_set: list[tuple[str, np.ndarray]] | None = None,
+                       source: str | None = None, warm: bool = True,
+                       journal=None
+                       ) -> tuple[InferenceEngine, QuantGateResult | None]:
+    """The one way serving paths obtain an engine at a requested precision.
+
+    fp32 returns directly.  int8 builds the quantized engine AND the fp32
+    reference, runs :func:`run_quant_gate`, and returns the int8 engine on
+    pass or the (already built) fp32 engine on refusal — refuse-and-keep-
+    serving, never an outage.  Shared by the registry and the predict CLI
+    so their precision decisions are identical by construction.
+    """
+    if precision not in PRECISIONS:
+        # Validate BEFORE branching: "anything not fp32" must not fall
+        # into the int8 path — a typo'd precision is an error, not a
+        # silent request for quantized serving.
+        raise ValueError(f"precision must be one of {PRECISIONS}, got "
+                         f"{precision!r}")
+    fp32 = InferenceEngine(model, params, batch_stats, buckets,
+                           precision="fp32", source=source, journal=journal)
+    if precision == "fp32":
+        if warm:
+            fp32.warmup()
+        return fp32, None
+    int8 = InferenceEngine(model, params, batch_stats, buckets,
+                           precision="int8", digest=fp32.digest,
+                           source=source, journal=journal)
+    gate = run_quant_gate(fp32, int8, gate_set, floor=floor, journal=journal)
+    chosen = int8 if gate.passed else fp32
+    if not gate.passed:
+        logger.warning("int8 engine refused by the quant gate "
+                       "(agreement %.4f < floor %.3f on %s); serving fp32",
+                       min(gate.per_subject.values(), default=0.0),
+                       gate.floor, gate.gate_source)
+    if warm:
+        chosen.warmup()
+    return chosen, gate

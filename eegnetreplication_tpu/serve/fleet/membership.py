@@ -131,6 +131,8 @@ class Replica:
             site=f"fleet.{replica_id}", journal=journal)
         self.state = JOINING
         self.digest: str | None = None
+        self.precision: str | None = None   # from the last health poll
+        self.buckets: tuple[int, ...] | None = None  # active ladder
         self.queue_depth = 0          # requests, from the last health poll
         self.health_failures = 0      # consecutive unreachable polls
         self.last_poll_t = 0.0
@@ -161,6 +163,8 @@ class Replica:
     def snapshot(self) -> dict:
         return {"replica": self.replica_id, "url": self.url,
                 "state": self.state, "digest": self.digest,
+                "precision": self.precision,
+                "buckets": list(self.buckets) if self.buckets else None,
                 "queue_depth": self.queue_depth, "inflight": self.inflight,
                 "circuit": self.breaker.state}
 
@@ -277,6 +281,16 @@ class FleetMembership:
             payload = {}
         replica.digest = payload.get("variables_digest") \
             or payload.get("model_digest") or replica.digest
+        # Each replica's active ladder + serving precision ride on its
+        # /healthz (a LadderTuner retune or quant-gate fallback shows up
+        # at the next poll) and surface in the fleet /healthz snapshot.
+        replica.precision = payload.get("precision") or replica.precision
+        buckets = payload.get("buckets")
+        if isinstance(buckets, list) and buckets:
+            try:
+                replica.buckets = tuple(int(b) for b in buckets)
+            except (TypeError, ValueError):
+                pass  # malformed advert must not poison the whole poll
         depth = payload.get("queue_depth_requests")
         if isinstance(depth, int):
             replica.queue_depth = depth

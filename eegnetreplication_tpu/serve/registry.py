@@ -26,22 +26,48 @@ from pathlib import Path
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
-from eegnetreplication_tpu.serve.engine import DEFAULT_BUCKETS, InferenceEngine
+from eegnetreplication_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    QUANT_AGREEMENT_FLOOR,
+    InferenceEngine,
+    QuantGateResult,
+    build_gated_engine,
+    load_model_from_checkpoint,
+)
 from eegnetreplication_tpu.utils.logging import logger
 
 
 class ModelRegistry:
-    """Holds the live engine; ``load`` once at startup, ``reload`` to swap."""
+    """Holds the live engine; ``load`` once at startup, ``reload`` to swap.
+
+    ``precision="int8"`` requests the quantized engine variant: every
+    load/reload builds the fp32 reference alongside, runs the mandatory
+    argmax-equivalence gate (``engine.run_quant_gate``), and serves int8
+    only on a pass — a refusal journals ``quant_gate`` and keeps serving
+    fp32 (``serving_precision`` tells which one actually answers).
+
+    ``retune`` swaps the live engine onto a NEW bucket ladder with the
+    SAME weights/precision (the LadderTuner's primitive): the incoming
+    engine warms entirely off the hot path, then the reference swaps
+    atomically — in-flight forwards finish on the old engine object.
+    """
 
     def __init__(self, buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
-                 journal=None):
+                 precision: str = "fp32",
+                 quant_floor: float = QUANT_AGREEMENT_FLOOR,
+                 gate_set=None, journal=None):
         self.buckets = tuple(buckets)
+        self.precision = precision          # requested
+        self.quant_floor = float(quant_floor)
+        self._gate_set = gate_set           # None = default_gate_set
+        self.last_gate: QuantGateResult | None = None
         self._journal = journal if journal is not None \
             else obs_journal.current()
         self._lock = threading.Lock()
         self._engine: InferenceEngine | None = None
         self._swaps = 0
-        # Serializes reloads: two concurrent /reload posts must not
+        self._retunes = 0
+        # Serializes reloads/retunes: two concurrent swappers must not
         # interleave their warmups and race the swap order.
         self._reload_lock = threading.Lock()
 
@@ -57,15 +83,36 @@ class ModelRegistry:
         with self._lock:
             return self._swaps
 
+    @property
+    def retunes(self) -> int:
+        with self._lock:
+            return self._retunes
+
+    @property
+    def serving_precision(self) -> str:
+        """The precision actually answering requests (fp32 when the quant
+        gate refused an int8 request)."""
+        return self.engine.precision
+
+    def _build(self, checkpoint: str | Path, buckets: tuple[int, ...],
+               warm: bool) -> InferenceEngine:
+        model, params, batch_stats = load_model_from_checkpoint(checkpoint)
+        engine, gate = build_gated_engine(
+            model, params, batch_stats, buckets,
+            precision=self.precision, floor=self.quant_floor,
+            gate_set=self._gate_set, source=str(checkpoint), warm=warm,
+            journal=self._journal)
+        self.last_gate = gate
+        return engine
+
     def load(self, checkpoint: str | Path, *, warm: bool = True
              ) -> InferenceEngine:
         """Initial load (no swap event); returns the live engine."""
-        engine = InferenceEngine.from_checkpoint(
-            checkpoint, self.buckets, warm=warm, journal=self._journal)
+        engine = self._build(checkpoint, self.buckets, warm)
         with self._lock:
             self._engine = engine
-        logger.info("Registry serving %s (digest %s)", checkpoint,
-                    engine.digest[:12])
+        logger.info("Registry serving %s (digest %s, %s)", checkpoint,
+                    engine.digest[:12], engine.precision)
         return engine
 
     def reload(self, checkpoint: str | Path, *, warm: bool = True
@@ -73,11 +120,14 @@ class ModelRegistry:
         """Build + warm a new engine from ``checkpoint``, then atomically
         swap it in.  Raises (IntegrityError, FileNotFoundError, geometry
         ValueError, ...) WITHOUT touching the current engine on any
-        failure."""
+        failure.  The reload lands on the CURRENT ladder (a prior retune
+        survives model pushes)."""
         with self._reload_lock:
             t0 = time.perf_counter()
-            engine = InferenceEngine.from_checkpoint(
-                checkpoint, self.buckets, warm=warm, journal=self._journal)
+            with self._lock:
+                buckets = (self._engine.buckets if self._engine is not None
+                           else self.buckets)
+            engine = self._build(checkpoint, buckets, warm)
             old = None
             with self._lock:
                 # Geometry gate: requests already validated (and queued)
@@ -99,11 +149,41 @@ class ModelRegistry:
                 "model_swap", checkpoint=str(checkpoint),
                 digest=engine.digest,
                 previous_digest=old.digest if old is not None else None,
+                precision=engine.precision,
                 elapsed_s=round(wall, 3))
             self._journal.metrics.inc("model_swaps")
             logger.info("Model swapped in %.2fs: %s -> %s", wall,
                         old.digest[:12] if old is not None else "none",
                         engine.digest[:12])
+            return engine
+
+    def retune(self, buckets: tuple[int, ...], *, warm: bool = True
+               ) -> InferenceEngine:
+        """Swap the live engine onto a new bucket ladder (same weights,
+        same precision, same digest).
+
+        The incoming engine compiles its buckets entirely off the hot
+        path (``warm=True``), then the reference swaps atomically under
+        the lock — the PR-3 registry pattern, so a retune under load
+        drops zero requests.  No quant gate re-runs: the ladder changes
+        the padded batch geometry, not the weights or the program's
+        numerics (padded rows are dropped after argmax).  The caller (the
+        LadderTuner) journals the ``ladder_retune`` event with the
+        before/after ladders.
+        """
+        with self._reload_lock:
+            current = self.engine
+            engine = InferenceEngine(
+                current.model, current.params, current.batch_stats,
+                tuple(buckets), precision=current.precision,
+                digest=current.digest, source=current.source,
+                journal=self._journal)
+            engine.quantized_digest = current.quantized_digest
+            if warm:
+                engine.warmup()
+            with self._lock:
+                self._engine = engine
+                self._retunes += 1
             return engine
 
     def infer(self, trials: np.ndarray) -> np.ndarray:

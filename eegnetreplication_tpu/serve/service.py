@@ -81,7 +81,11 @@ from eegnetreplication_tpu.serve.batcher import (
     MicroBatcher,
     Rejected,
 )
-from eegnetreplication_tpu.serve.engine import CLASS_NAMES, DEFAULT_BUCKETS
+from eegnetreplication_tpu.serve.engine import (
+    CLASS_NAMES,
+    DEFAULT_BUCKETS,
+    QUANT_AGREEMENT_FLOOR,
+)
 from eegnetreplication_tpu.serve.registry import ModelRegistry
 from eegnetreplication_tpu.serve.sessions import SessionStore, WindowDecision
 from eegnetreplication_tpu.serve.sessions.session import (
@@ -89,6 +93,7 @@ from eegnetreplication_tpu.serve.sessions.session import (
     STATUS_EXPIRED,
     STATUS_OK,
 )
+from eegnetreplication_tpu.serve.tuner import LadderTuner
 from eegnetreplication_tpu.utils.logging import logger
 
 # Short in-process budget: a device hiccup is worth two spaced re-runs of
@@ -152,11 +157,21 @@ class ServeApp:
                  watchdog_thresholds: dict | None = None,
                  sessions_dir: str | Path | None = None,
                  session_snapshot_every: int = 50,
-                 resume: bool = False):
+                 resume: bool = False,
+                 precision: str = "fp32",
+                 quant_floor: float = QUANT_AGREEMENT_FLOOR,
+                 gate_set=None,
+                 tune_every_s: float = 0.0):
         self.journal = journal if journal is not None \
             else obs_journal.current()
         self.checkpoint = str(checkpoint)
-        self.registry = ModelRegistry(tuple(buckets), journal=self.journal)
+        # precision="int8" requests the quantized engine; the registry
+        # runs the mandatory fp32-argmax equivalence gate and falls back
+        # to fp32 on refusal (serving_precision reports the truth).
+        self.registry = ModelRegistry(tuple(buckets), precision=precision,
+                                      quant_floor=quant_floor,
+                                      gate_set=gate_set,
+                                      journal=self.journal)
         self.registry.load(checkpoint)
         # Streaming sessions: durable when sessions_dir is given (the CLI
         # always passes one), in-memory otherwise.  --resume restores the
@@ -188,6 +203,13 @@ class ServeApp:
             max_batch=max_batch if max_batch is not None else buckets[-1],
             max_wait_ms=max_wait_ms, max_queue_trials=max_queue_trials,
             journal=self.journal, heartbeat=self.heartbeat)
+        # Ladder self-tuning: observe bucket occupancy + arrival rate,
+        # retune the compile ladder off the hot path.  Opt-in (0 = off):
+        # the autonomous loop only makes sense for long-lived servers.
+        self.tuner = (LadderTuner(self.registry, self.batcher,
+                                  journal=self.journal,
+                                  interval_s=tune_every_s)
+                      if tune_every_s and tune_every_s > 0 else None)
         self.request_timeout_s = float(request_timeout_s)
         self._host, self._port = host, int(port)
         self._httpd: ThreadingHTTPServer | None = None
@@ -205,6 +227,15 @@ class ServeApp:
         self._inflight = 0
         self._idle = threading.Condition(self._stats_lock)
         self._t_start = time.perf_counter()
+
+    @property
+    def ladder_retunes(self) -> int:
+        """Applied ladder/window retunes: the tuner counts every applied
+        proposal (wait-only ones skip the engine rebuild, so the
+        registry's swap counter alone would undercount them)."""
+        if self.tuner is not None:
+            return self.tuner.retunes
+        return self.registry.retunes
 
     # -- lifecycle --------------------------------------------------------
     @property
@@ -230,19 +261,27 @@ class ServeApp:
         self._listener = threading.Thread(target=self._httpd.serve_forever,
                                           name="serve-http", daemon=True)
         self._listener.start()
+        if self.tuner is not None:
+            self.tuner.start()
+        gate = self.registry.last_gate
         self.journal.event(
             "serve_start", checkpoint=self.checkpoint,
-            buckets=list(self.registry.buckets),
+            buckets=list(self.registry.engine.buckets),
             max_batch=self.batcher.max_batch,
             max_wait_ms=self.batcher.max_wait_s * 1000.0,
             max_queue_trials=self.batcher.max_queue_trials,
             digest=self.registry.engine.digest,
+            precision=self.registry.serving_precision,
+            requested_precision=self.registry.precision,
+            quant_agreement=(round(gate.agreement, 6) if gate else None),
+            ladder_tuning=self.tuner is not None,
             sessions_dir=(str(self.sessions_dir)
                           if self.sessions_dir else None),
             sessions_restored=len(self.sessions.restored),
             host=self.address[0], port=self.address[1])
-        logger.info("Serving %s at %s (buckets %s)", self.checkpoint,
-                    self.url, self.registry.buckets)
+        logger.info("Serving %s at %s (buckets %s, %s)", self.checkpoint,
+                    self.url, self.registry.engine.buckets,
+                    self.registry.serving_precision)
         return self
 
     def stop(self, drain: bool = True, handler_timeout_s: float = 15.0
@@ -261,6 +300,8 @@ class ServeApp:
         if self._stopped:
             return
         self._stopped = True
+        if self.tuner is not None:
+            self.tuner.stop()  # no retunes mid-drain
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -294,7 +335,9 @@ class ServeApp:
                            session_snapshots=self.sessions.snapshots,
                            wall_s=round(time.perf_counter() - self._t_start,
                                         3),
-                           model_swaps=self.registry.swaps)
+                           model_swaps=self.registry.swaps,
+                           ladder_retunes=self.ladder_retunes,
+                           precision=self.registry.serving_precision)
         logger.info("Serve drained and stopped: %d requests "
                     "(%d rejected, %d errors, %d expired, %d refused by "
                     "the open circuit), %d model swap(s), %d breaker "
@@ -482,7 +525,15 @@ class _ServeHandler(JsonRequestHandler):
                 # least-loaded dispatch — no separate endpoint needed.
                 "variables_digest": engine.digest,
                 "geometry": {"n_channels": c, "n_times": t},
+                # The ACTIVE ladder (a retune moves it) + the precision
+                # actually serving — the fleet membership poll mirrors
+                # both into each replica's snapshot.
                 "buckets": list(engine.buckets),
+                "max_batch": app.batcher.max_batch,
+                "max_wait_ms": round(app.batcher.max_wait_s * 1000.0, 3),
+                "precision": engine.precision,
+                "requested_precision": app.registry.precision,
+                "ladder_retunes": app.ladder_retunes,
                 "queue_depth_trials": app.batcher.queue_depth,
                 "queue_depth_requests": app.batcher.queue_depth_requests,
                 "model_swaps": app.registry.swaps})
@@ -839,6 +890,21 @@ def main(argv=None) -> int:
     parser.add_argument("--maxQueue", type=int, default=512,
                         help="Queue bound in trials; beyond it requests "
                              "are rejected with 429.")
+    parser.add_argument("--precision", choices=["fp32", "int8"],
+                        default="fp32",
+                        help="Engine weight precision.  int8 runs the "
+                             "mandatory fp32-argmax equivalence gate at "
+                             "load and falls back to fp32 on refusal.")
+    parser.add_argument("--quantFloor", type=float,
+                        default=QUANT_AGREEMENT_FLOOR,
+                        help="Minimum per-subject int8-vs-fp32 argmax "
+                             "agreement for the quantized engine to "
+                             "serve.")
+    parser.add_argument("--tuneEveryS", type=float, default=0.0,
+                        help="Ladder self-tuning interval in seconds "
+                             "(0 = off): observe bucket occupancy + "
+                             "arrival rate, retune the compile ladder "
+                             "off the hot path.")
     parser.add_argument("--breakerThreshold", type=int, default=5,
                         help="Consecutive serve.forward failures that "
                              "open the circuit breaker (fast 503s until "
@@ -889,7 +955,10 @@ def main(argv=None) -> int:
                        breaker_reset_s=args.breakerResetS,
                        sessions_dir=sessions_dir,
                        session_snapshot_every=args.sessionSnapshotEvery,
-                       resume=args.resume, journal=journal)
+                       resume=args.resume, journal=journal,
+                       precision=args.precision,
+                       quant_floor=args.quantFloor,
+                       tune_every_s=args.tuneEveryS)
         app.start()
         print(f"serving at {app.url}", flush=True)
         serve_until_preempted(app)

@@ -96,6 +96,11 @@ _COLUMNS = (
     # training rows show "-" here and vice versa.
     ("n_requests", "reqs"), ("latency_p95_ms", "p95_ms"),
     ("rejected", "rejected"), ("model_swaps", "swaps"),
+    # Quantized + self-tuning hot path: the serving precision (after any
+    # quant-gate fallback), the gate's argmax agreement, and how many
+    # times the LadderTuner swapped the compile ladder under load.
+    ("precision", "prec"), ("quant_agreement", "quant_agree"),
+    ("ladder_retunes", "retunes"),
     # Supervision & liveness: supervisor restarts/hang detections (from
     # supervisor_* events), expired-deadline drops and circuit-breaker
     # trips (from request/circuit_state events).

@@ -14,6 +14,13 @@ pins that contract at the PRODUCT boundary, end to end:
    the SERVED predictions (accuracy line when the file carries labels,
    class-count line otherwise).
 
+The same three-way byte-match then repeats on the QUANTIZED path
+(``--precision int8`` server, ``predict_trials(precision="int8")``, and
+the CLI subprocess with ``--precision int8``): server and CLI share one
+gated engine builder, so whatever the equivalence gate decides — serve
+int8 or fall back to fp32 — they must decide identically.
+``--skip-int8`` restricts the run to the fp32 legs.
+
 Exit 0 on PASS.  Wired as the ``serve-smoke`` leg of
 ``scripts/rehearsal_product_path.py`` and exercised CI-sized by
 ``tests/test_serve.py``.
@@ -35,11 +42,12 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
-def served_predictions(checkpoint: str, trials_path: Path) -> list[int]:
+def served_predictions(checkpoint: str, trials_path: Path,
+                       precision: str = "fp32") -> list[int]:
     """Round-trip the trials file through a live service instance."""
     from eegnetreplication_tpu.serve.service import ServeApp
 
-    app = ServeApp(checkpoint, port=0).start()
+    app = ServeApp(checkpoint, port=0, precision=precision).start()
     try:
         req = urllib.request.Request(
             app.url + "/predict", data=trials_path.read_bytes(),
@@ -50,11 +58,13 @@ def served_predictions(checkpoint: str, trials_path: Path) -> list[int]:
         app.stop()
 
 
-def cli_stdout_line(checkpoint: str, trials_path: Path) -> str:
+def cli_stdout_line(checkpoint: str, trials_path: Path,
+                    precision: str = "fp32") -> str:
     """Last stdout line of the real predict CLI subprocess."""
     proc = subprocess.run(
         [sys.executable, "-m", "eegnetreplication_tpu.predict",
-         "--checkpoint", checkpoint, "--input", str(trials_path)],
+         "--checkpoint", checkpoint, "--input", str(trials_path),
+         "--precision", precision],
         cwd=REPO, capture_output=True, text=True, timeout=600,
         env={**os.environ,
              "PYTHONPATH": f"{REPO}:{os.environ.get('PYTHONPATH', '')}"})
@@ -86,6 +96,8 @@ def main(argv=None) -> int:
                         help="A -trials.npz file (X, optionally y).")
     parser.add_argument("--skip-cli", action="store_true",
                         help="Skip the subprocess leg (CI-sized runs).")
+    parser.add_argument("--skip-int8", action="store_true",
+                        help="Skip the quantized-path byte-match legs.")
     args = parser.parse_args(argv)
 
     from eegnetreplication_tpu.utils.platform import select_platform
@@ -119,6 +131,31 @@ def main(argv=None) -> int:
             print(f"FAIL: CLI stdout {got!r} != served-derived {want!r}")
             return 1
         print(f"CLI line byte-match: {got!r}")
+
+    if not args.skip_int8:
+        # The quantized path: server and CLI go through the same gated
+        # builder, so their predictions must byte-match each other (and,
+        # when the gate refused int8, match the fp32 legs above).
+        served_q = np.asarray(
+            served_predictions(args.checkpoint, trials_path,
+                               precision="int8"), np.int64)
+        cli_q = predict_trials(model, params, batch_stats, x,
+                               precision="int8")
+        if not np.array_equal(served_q, cli_q):
+            diff = int(np.sum(served_q != cli_q))
+            print(f"FAIL: int8 served predictions differ from int8 "
+                  f"predict_trials on {diff}/{len(x)} trials")
+            return 1
+        print(f"int8 served/CLI byte-match on {len(served_q)} predictions")
+        if not args.skip_cli:
+            got = cli_stdout_line(args.checkpoint, trials_path,
+                                  precision="int8")
+            want = expected_line(served_q, y)
+            if got != want:
+                print(f"FAIL: int8 CLI stdout {got!r} != served-derived "
+                      f"{want!r}")
+                return 1
+            print(f"int8 CLI line byte-match: {got!r}")
 
     print("SERVE SMOKE PASS")
     return 0

@@ -47,6 +47,8 @@ class FakeReplica:
     def __init__(self, digest: str = "d-old", port: int = 0):
         self.digest = digest
         self.healthz_digest = None           # override what /healthz shows
+        self.precision = "fp32"              # what /healthz advertises
+        self.buckets = (1, 8, 32)            # the replica's active ladder
         self.queue_depth = 0
         self.degraded: list[str] = []        # non-empty -> healthz 503
         self.predict_status = 200
@@ -83,6 +85,8 @@ class FakeReplica:
                         "degraded": fake.degraded,
                         "variables_digest": (fake.healthz_digest
                                              or fake.digest),
+                        "precision": fake.precision,
+                        "buckets": list(fake.buckets),
                         "queue_depth_requests": fake.queue_depth,
                         "queue_depth_trials": fake.queue_depth})
                     return
@@ -175,6 +179,29 @@ class TestMembership:
             assert transitions == [("live", "joined"),
                                    ("draining", "circuit_open"),
                                    ("live", "recovered")]
+        finally:
+            fake.stop()
+
+    def test_snapshot_mirrors_ladder_and_precision(self, journal):
+        """ISSUE-8 acceptance: each replica's /healthz-advertised active
+        ladder + serving precision flow into the membership snapshot the
+        fleet /healthz endpoint returns."""
+        fake = FakeReplica()
+        fake.precision = "int8"
+        fake.buckets = (1, 4, 8, 64)
+        try:
+            replicas, membership, _ = _fleet([fake], journal)
+            membership.poll_once()
+            r = replicas[0]
+            assert r.precision == "int8"
+            assert r.buckets == (1, 4, 8, 64)
+            snap = membership.snapshot()[0]
+            assert snap["precision"] == "int8"
+            assert snap["buckets"] == [1, 4, 8, 64]
+            # A retune shows up at the next poll.
+            fake.buckets = (1, 4, 8, 128)
+            membership.poll_once()
+            assert membership.snapshot()[0]["buckets"] == [1, 4, 8, 128]
         finally:
             fake.stop()
 

@@ -1,0 +1,197 @@
+"""int8 weight quantization (``eegnetreplication_tpu/ops/quant.py``).
+
+Covers the ISSUE-8 tentpole surface: per-channel symmetric quantize ->
+dequantize round-trip error bounds per layer, the flat npz round trip
+preserving the ``resil/integrity`` digest contract, the specialized
+quantized EEGNet forward's argmax agreement with fp32, and the generic
+dequantize-then-apply fallback for models the specialization does not
+encode.
+"""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.ops import quant  # noqa: E402
+from eegnetreplication_tpu.resil.integrity import IntegrityError  # noqa: E402
+from eegnetreplication_tpu.training.steps import eval_forward  # noqa: E402
+
+C, T = 4, 64
+
+
+def _variables(seed: int = 0, **model_kw):
+    model = EEGNet(n_channels=C, n_times=T, **model_kw)
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, C, T)),
+                           train=False)
+    return model, variables["params"], variables["batch_stats"]
+
+
+@pytest.fixture(scope="module")
+def stock():
+    return _variables()
+
+
+class TestQuantizeRoundTrip:
+    def test_per_channel_scales_and_int8_range(self, stock):
+        _, params, _ = stock
+        qparams = quant.quantize_params(params)
+        for layer in ("temporal_conv", "spatial_conv",
+                      "separable_depthwise", "separable_pointwise",
+                      "classifier"):
+            leaf = qparams[layer]["kernel"]
+            assert quant.is_qleaf(leaf)
+            w = np.asarray(params[layer]["kernel"])
+            assert leaf["q"].dtype == np.int8
+            assert np.abs(leaf["q"]).max() <= quant.QMAX
+            # One scale per OUTPUT channel (last axis), broadcast shape.
+            assert leaf["scale"].shape[-1] == w.shape[-1]
+            assert leaf["scale"].size == w.shape[-1]
+
+    def test_bn_and_bias_stay_fp32(self, stock):
+        _, params, _ = stock
+        qparams = quant.quantize_params(params)
+        assert not quant.is_qleaf(qparams["temporal_bn"]["scale"])
+        assert qparams["classifier"]["bias"].dtype == np.float32
+        assert np.array_equal(qparams["classifier"]["bias"],
+                              np.asarray(params["classifier"]["bias"]))
+
+    def test_round_trip_error_bounded_per_layer(self, stock):
+        """ISSUE-8 satellite: the quantize->dequantize error per layer is
+        bounded by scale/2 elementwise (symmetric round-to-nearest)."""
+        _, params, _ = stock
+        qparams = quant.quantize_params(params)
+        errs = quant.quantization_error(params, qparams)
+        assert set(errs) == {
+            "temporal_conv/kernel", "spatial_conv/kernel",
+            "separable_depthwise/kernel", "separable_pointwise/kernel",
+            "classifier/kernel"}
+        for layer, rec in errs.items():
+            assert rec["max_abs_err"] <= rec["bound"] + 1e-7, layer
+            assert rec["rel_fro"] < 0.01, layer  # <1% Frobenius drift
+
+    def test_dequantize_restores_structure(self, stock):
+        _, params, _ = stock
+        restored = quant.dequantize_params(quant.quantize_params(params))
+        flat_p = jax.tree_util.tree_leaves_with_path(dict(params))
+        flat_r = jax.tree_util.tree_leaves_with_path(restored)
+        assert len(flat_p) == len(flat_r)
+        for (path_p, leaf_p), (path_r, leaf_r) in zip(flat_p, flat_r):
+            assert path_p == path_r
+            assert leaf_p.shape == leaf_r.shape
+
+    def test_all_zero_channel_keeps_unit_scale(self):
+        w = np.zeros((3, 5), np.float32)
+        w[:, 0] = [1.0, -2.0, 0.5]
+        leaf = quant.quantize_tensor(w)
+        assert np.all(leaf["scale"][:, 1:] == 1.0)
+        assert np.all(leaf["q"][:, 1:] == 0)
+        np.testing.assert_allclose(
+            np.asarray(quant.dequantize_tensor(leaf))[:, 0], w[:, 0],
+            atol=float(leaf["scale"][0, 0]) / 2 + 1e-7)
+
+
+class TestFlatRoundTrip:
+    def test_flatten_unflatten_identity(self, stock):
+        _, params, _ = stock
+        qparams = quant.quantize_params(params)
+        back = quant.unflatten_qparams(quant.flatten_qparams(qparams))
+
+        def assert_equal(a, b, path=()):
+            if quant.is_qleaf(a):
+                assert quant.is_qleaf(b), path
+                np.testing.assert_array_equal(a["q"], b["q"])
+                np.testing.assert_array_equal(a["scale"], b["scale"])
+                return
+            if hasattr(a, "items"):
+                assert set(a) == set(b), path
+                for k in a:
+                    assert_equal(a[k], b[k], path + (k,))
+                return
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+        assert_equal(qparams, back)
+
+    def test_digest_survives_npz_round_trip(self, stock, tmp_path):
+        """ISSUE-8 tentpole clause: the quantized pytree's content digest
+        (resil/integrity contract) is identical across save->load."""
+        _, params, _ = stock
+        qparams = quant.quantize_params(params)
+        digest = quant.qparams_digest(qparams)
+        path = quant.save_quantized(tmp_path / "q.npz", qparams,
+                                    metadata={"n_channels": C})
+        loaded, metadata = quant.load_quantized(path)
+        assert metadata == {"n_channels": C}
+        assert quant.qparams_digest(loaded) == digest
+
+    def test_content_tamper_raises_integrity_error(self, stock, tmp_path):
+        _, params, _ = stock
+        path = quant.save_quantized(tmp_path / "q.npz",
+                                    quant.quantize_params(params))
+        with np.load(path) as data:
+            flat = {k: np.array(data[k]) for k in data.files}
+        # Flip one quantized weight; keep the stale digest entry.
+        key = next(k for k in flat if k.endswith(".q"))
+        flat[key] = flat[key].copy()
+        flat[key].flat[0] = flat[key].flat[0] ^ 0x7F
+        with open(path, "wb") as fh:
+            np.savez(fh, **flat)
+        with pytest.raises(IntegrityError):
+            quant.load_quantized(path)
+
+    def test_quantization_is_deterministic(self, stock):
+        _, params, _ = stock
+        assert quant.qparams_digest(quant.quantize_params(params)) \
+            == quant.qparams_digest(quant.quantize_params(params))
+
+
+class TestQuantizedForward:
+    def test_specialized_forward_argmax_matches_fp32(self, stock):
+        model, params, batch_stats = stock
+        assert quant.supports_quantized_eval(model)
+        qparams = quant.quantize_params(params)
+        x = jnp.asarray(np.random.RandomState(3).randn(
+            256, C, T).astype(np.float32))
+        ref = np.argmax(np.asarray(
+            eval_forward(model, params, batch_stats, x)), axis=-1)
+        got = np.argmax(np.asarray(jax.jit(
+            lambda xx: quant.quantized_eval_forward(
+                model, qparams, batch_stats, xx))(x)), axis=-1)
+        agreement = float(np.mean(ref == got))
+        # The serving gate's floor; random-init weights are the worst
+        # case (trained checkpoints measure 1.0).
+        assert agreement >= 0.99
+
+    def test_generic_fallback_matches_dequantized_eval(self):
+        """A model the specialization does not encode (non-HIGHEST
+        precision EEGNet) serves int8 via dequantize-then-apply, exactly
+        equal to the regular eval forward on the dequantized weights."""
+        model, params, batch_stats = _variables(precision=None)
+        assert not quant.supports_quantized_eval(model)
+        qparams = quant.quantize_params(params)
+        x = jnp.asarray(np.random.RandomState(4).randn(
+            8, C, T).astype(np.float32))
+        got = np.asarray(quant.quantized_eval_forward(
+            model, qparams, batch_stats, x))
+        want = np.asarray(eval_forward(
+            model, quant.dequantize_params(qparams), batch_stats, x,
+            allow_pallas=False))
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
+    def test_wide_variant_supported(self):
+        """The specialization is generic over (F1, D): eegnet_wide's
+        grouping and flatten order agree with the stock forward."""
+        model = EEGNet(n_channels=C, n_times=T, F1=4, D=4)
+        variables = model.init(jax.random.PRNGKey(1),
+                               jnp.zeros((1, C, T)), train=False)
+        params, batch_stats = variables["params"], variables["batch_stats"]
+        qparams = quant.quantize_params(params)
+        x = jnp.asarray(np.random.RandomState(5).randn(
+            64, C, T).astype(np.float32))
+        ref = np.argmax(np.asarray(
+            eval_forward(model, params, batch_stats, x)), axis=-1)
+        got = np.argmax(np.asarray(quant.quantized_eval_forward(
+            model, qparams, batch_stats, x)), axis=-1)
+        assert float(np.mean(ref == got)) >= 0.99

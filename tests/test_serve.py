@@ -77,6 +77,23 @@ class TestEngine:
         assert bucket_ladder(16) == (1, 8, 16)
         assert bucket_ladder(1) == (1,)
 
+    def test_bucket_ladder_edge_cases(self):
+        """ISSUE-8 satellite: max_batch equal to / between / just above
+        base rungs, and validation."""
+        # Equal to a base rung: the rung caps the ladder, no duplicate.
+        assert bucket_ladder(8) == (1, 8)
+        assert bucket_ladder(32) == (1, 8, 32)
+        assert bucket_ladder(128) == (1, 8, 32, 128)
+        # Between rungs: cap inserted, larger base rungs dropped.
+        assert bucket_ladder(20) == (1, 8, 20)
+        assert bucket_ladder(2) == (1, 2)
+        # Just above the top base rung: every base rung kept + the cap.
+        assert bucket_ladder(129) == (1, 8, 32, 128, 129)
+        # Custom base ladders compose the same way.
+        assert bucket_ladder(24, base=(1, 16, 64)) == (1, 16, 24)
+        with pytest.raises(ValueError, match="max_batch"):
+            bucket_ladder(0)
+
     def test_padded_buckets_match_direct_forward(self, small_engine, trials):
         model, params, bs = (small_engine.model, small_engine.params,
                              small_engine.batch_stats)
@@ -879,6 +896,375 @@ class TestPredictCLIIntegration:
 
         assert (predict.load_model_from_checkpoint
                 is serve.load_model_from_checkpoint)
+
+
+class TestBatcherGreedyCoalescing:
+    def test_full_bucket_behind_small_head_dispatches_greedily(self):
+        """ISSUE-8 regression (full-bucket-behind-small-head arrival
+        order): a request too large to join the current batch must not
+        stall coalescing — later requests that DO fit ride along, so the
+        head batch leaves as a full bucket instead of a tiny forward."""
+        first_started = threading.Event()
+        release = threading.Event()
+        sizes = []
+
+        def infer(x):
+            sizes.append(len(x))
+            if len(sizes) == 1:  # only the blocker batch parks
+                first_started.set()
+                release.wait(10)
+            return x[:, 0, 0]
+
+        b = MicroBatcher(infer, max_batch=32, max_wait_ms=0.0,
+                         max_queue_trials=256)
+        try:
+            blocker = b.submit(np.full((1, C, T), 9, np.float32))
+            assert first_started.wait(5)  # worker holds the blocker batch
+            futs = [b.submit(np.full((n, C, T), i, np.float32))
+                    for i, n in enumerate((4, 30, 28), start=1)]
+            release.set()  # finish blocker; next coalesce sees all three
+            got = [f.result(timeout=10) for f in (blocker, *futs)]
+            # Greedy: [4, skip 30, 28] coalesces to one FULL bucket of
+            # 32; the 30 dispatches next.  Pre-fix behavior was [4], 30,
+            # 28 — three underfilled forwards.
+            assert sizes == [1, 32, 30], sizes
+            # Scatter correctness survives the reorder: each future got
+            # its own rows.
+            for i, fut in enumerate(futs, start=1):
+                assert (got[i] == i).all()
+        finally:
+            release.set()
+            b.close()
+
+    def test_full_bucket_behind_small_head_does_not_wait_out_window(self):
+        """With a full top bucket already queued behind a small head, the
+        worker must dispatch NOW, not park for max_wait_ms."""
+        release = threading.Event()
+
+        def infer(x):
+            release.wait(10)
+            return np.zeros(len(x), np.int64)
+
+        b = MicroBatcher(infer, max_batch=32, max_wait_ms=5000.0,
+                         max_queue_trials=256)
+        try:
+            small = b.submit(np.zeros((1, C, T), np.float32))
+            big = b.submit(np.zeros((32, C, T), np.float32))
+            release.set()
+            t0 = time.perf_counter()
+            assert small.result(timeout=10).shape == (1,)
+            assert big.result(timeout=10).shape == (32,)
+            assert time.perf_counter() - t0 < 2.0  # far below max_wait
+        finally:
+            release.set()
+            b.close()
+
+    def test_reconfigure_live(self):
+        b = MicroBatcher(lambda x: np.zeros(len(x), np.int64),
+                         max_batch=8, max_wait_ms=5.0, max_queue_trials=32)
+        try:
+            b.reconfigure(max_batch=16, max_wait_ms=1.0)
+            assert b.max_batch == 16 and b.max_wait_s == 0.001
+            # Clamped to the queue bound (constructor invariant).
+            b.reconfigure(max_batch=1000)
+            assert b.max_batch == 32
+            with pytest.raises(ValueError):
+                b.reconfigure(max_batch=0)
+            with pytest.raises(ValueError):
+                b.reconfigure(max_wait_ms=-1.0)
+            # Still serving after reconfigure.
+            assert b.submit(np.zeros((2, C, T), np.float32)) \
+                .result(timeout=10).shape == (2,)
+        finally:
+            b.close()
+
+
+class TestLadderTuner:
+    def _stats(self, **kw):
+        from eegnetreplication_tpu.serve.tuner import LadderStats
+
+        base = dict(window_s=10.0, dispatches=100, trials=1600.0,
+                    bucket_counts={}, bucket_fill_mean={})
+        base.update(kw)
+        return LadderStats(**base)
+
+    def test_propose_grows_saturated_top(self):
+        from eegnetreplication_tpu.serve.tuner import propose
+
+        stats = self._stats(trials=3200.0,
+                            bucket_counts={16: 80, 1: 20},
+                            bucket_fill_mean={16: 0.97, 1: 1.0})
+        prop = propose(stats, (1, 4, 16), 5.0)
+        assert prop is not None
+        assert prop.buckets == (1, 4, 16, 32)
+        assert "top_saturated" in prop.reason
+
+    def test_propose_inserts_rung_for_underfilled_top(self):
+        from eegnetreplication_tpu.serve.tuner import propose
+
+        stats = self._stats(trials=480.0,
+                            bucket_counts={16: 60, 1: 40},
+                            bucket_fill_mean={16: 0.3, 1: 1.0})
+        prop = propose(stats, (1, 4, 16), 5.0)
+        assert prop is not None
+        assert 8 in prop.buckets  # next_pow2(0.3 * 16) = 8
+        assert "top_underfilled" in prop.reason
+
+    def test_propose_adapts_wait_to_arrival_rate(self):
+        from eegnetreplication_tpu.serve.tuner import propose
+
+        # 16000 trials/s vs a 50 ms window: half a 16-bucket arrives in
+        # 0.5 ms — the window should shrink hard.
+        stats = self._stats(window_s=1.0, trials=16000.0,
+                            bucket_counts={16: 100},
+                            bucket_fill_mean={16: 0.8})
+        prop = propose(stats, (1, 4, 16), 50.0)
+        assert prop is not None
+        assert "wait_adapted" in prop.reason
+        assert prop.max_wait_ms < 50.0
+
+    def test_propose_needs_evidence_and_respects_caps(self):
+        from eegnetreplication_tpu.serve.tuner import propose
+
+        thin = self._stats(dispatches=3, trials=48.0,
+                           bucket_counts={16: 3},
+                           bucket_fill_mean={16: 1.0})
+        assert propose(thin, (1, 4, 16), 5.0) is None
+        # Saturated top at the cap: no growth proposed.
+        capped = self._stats(trials=3200.0, bucket_counts={16: 100},
+                             bucket_fill_mean={16: 1.0})
+        prop = propose(capped, (1, 4, 16), 5.0, max_top=16)
+        assert prop is None or prop.buckets[-1] == 16
+
+    def test_propose_prunes_to_max_rungs(self):
+        from eegnetreplication_tpu.serve.tuner import propose
+
+        stats = self._stats(trials=6400.0,
+                            bucket_counts={32: 90, 1: 5, 2: 5},
+                            bucket_fill_mean={32: 0.95, 1: 1.0, 2: 1.0})
+        prop = propose(stats, (1, 2, 4, 8, 32), 2.0, max_rungs=5)
+        assert prop is not None
+        assert len(prop.buckets) <= 5
+        assert prop.buckets[0] == 1 and prop.buckets[-1] == 64
+
+    def test_collect_diffs_metric_windows(self, tmp_path):
+        from eegnetreplication_tpu.serve.tuner import LadderTuner
+
+        with obs_journal.run(tmp_path, config={}) as jr:
+            tuner = LadderTuner(registry=None, batcher=None, journal=jr)
+            for _ in range(4):
+                jr.metrics.observe("bucket_fill", 0.5, bucket="16")
+                jr.metrics.observe("batch_trials", 8)
+            stats = tuner.collect()
+            assert stats.dispatches == 4
+            assert stats.trials == 32.0
+            assert stats.bucket_fill_mean[16] == pytest.approx(0.5)
+            # Second window: nothing new happened.
+            stats2 = tuner.collect()
+            assert stats2.dispatches == 0
+
+    def test_wait_only_proposal_skips_engine_rebuild(self, tmp_path):
+        """A proposal that only moves max_wait_ms must not recompile the
+        ladder or clobber a caller-set coalescing cap below the top."""
+        from eegnetreplication_tpu.serve.service import make_infer_fn
+        from eegnetreplication_tpu.serve.tuner import LadderTuner, Proposal
+
+        registry = ModelRegistry(buckets=(1, 4, 16))
+        registry.load(_checkpoint(tmp_path), warm=False)
+        b = MicroBatcher(make_infer_fn(registry), max_batch=4,
+                         max_wait_ms=1.0, max_queue_trials=64)
+        try:
+            tuner = LadderTuner(registry, b)
+            engine_before = registry.engine
+            tuner.apply(Proposal(buckets=(1, 4, 16), max_wait_ms=9.0,
+                                 reason="wait_adapted"))
+            assert registry.engine is engine_before  # no rebuild
+            assert registry.retunes == 0
+            assert tuner.retunes == 1  # still counted as applied
+            assert b.max_batch == 4    # caller cap preserved
+            assert b.max_wait_s == 0.009
+        finally:
+            b.close()
+
+    def test_retune_under_concurrent_infer_drops_nothing(self, tmp_path):
+        """ISSUE-8 acceptance: a LadderTuner retune under live load
+        completes with zero dropped/failed requests, swaps the ladder
+        atomically, and journals ladder_retune."""
+        from eegnetreplication_tpu.serve.service import make_infer_fn
+        from eegnetreplication_tpu.serve.tuner import LadderTuner, Proposal
+
+        ck = _checkpoint(tmp_path)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            registry = ModelRegistry(buckets=(1, 4, 16), journal=jr)
+            registry.load(ck)
+            b = MicroBatcher(make_infer_fn(registry), max_batch=16,
+                             max_wait_ms=1.0, max_queue_trials=256,
+                             journal=jr)
+            tuner = LadderTuner(registry, b, journal=jr)
+            x = np.random.RandomState(5).randn(8, C, T).astype(np.float32)
+            failures = []
+            done = [0]
+            lock = threading.Lock()
+
+            def client():
+                for i in range(40):
+                    try:
+                        b.submit(x[i % len(x)][None]).result(timeout=30)
+                    except Exception as exc:  # noqa: BLE001 — the assertion
+                        with lock:
+                            failures.append(repr(exc))
+                    with lock:
+                        done[0] += 1
+
+            threads = [threading.Thread(target=client) for _ in range(6)]
+            try:
+                for t in threads:
+                    t.start()
+                while done[0] < 60:  # mid-load
+                    time.sleep(0.005)
+                tuner.apply(Proposal(buckets=(1, 4, 8, 16),
+                                     max_wait_ms=2.0, reason="test"))
+                for t in threads:
+                    t.join()
+            finally:
+                b.close()
+            assert failures == []
+            assert done[0] == 240
+            assert registry.retunes == 1
+            assert registry.engine.buckets == (1, 4, 8, 16)
+            assert b.max_batch == 16 and b.max_wait_s == 0.002
+        events = obs_journal.schema.read_events(jr.events_path)
+        retunes = [e for e in events if e["event"] == "ladder_retune"]
+        assert len(retunes) == 1
+        assert retunes[0]["old_buckets"] == [1, 4, 16]
+        assert retunes[0]["new_buckets"] == [1, 4, 8, 16]
+        summary = obs_journal.schema.event_summary(events)
+        assert summary.get("ladder_retunes") is None  # no serve stream
+        assert not any("_schema_error" in e for e in events)
+
+
+class TestQuantizedServing:
+    def test_registry_int8_gate_pass_serves_int8(self, tmp_path, trials):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            reg8 = ModelRegistry(buckets=(1, 4, 16), precision="int8",
+                                 journal=jr)
+            reg8.load(_checkpoint(tmp_path))
+            reg32 = ModelRegistry(buckets=(1, 4, 16), journal=jr)
+            reg32.load(_checkpoint(tmp_path, name="m2.npz"))
+            assert reg8.serving_precision == "int8"
+            assert reg8.last_gate is not None and reg8.last_gate.passed
+            assert reg8.engine.quantized_digest is not None
+            assert reg8.engine.digest == reg32.engine.digest  # identity
+            agree = float(np.mean(
+                reg8.infer(trials) == reg32.infer(trials)))
+            assert agree >= 0.99
+        events = obs_journal.schema.read_events(jr.events_path)
+        gates = [e for e in events if e["event"] == "quant_gate"]
+        assert len(gates) == 1
+        assert gates[0]["outcome"] == "pass"
+        assert gates[0]["agreement"] >= 0.99
+        assert not any("_schema_error" in e for e in events)
+
+    def test_gate_refusal_falls_back_to_fp32(self, tmp_path, trials,
+                                             monkeypatch):
+        """Refuse-and-keep-serving: a quantization that breaks argmax is
+        refused by the gate, the registry serves fp32, and the refusal is
+        journaled — same shape as the hot-reload integrity gate."""
+        from eegnetreplication_tpu.ops import quant
+
+        real_forward = quant.quantized_eval_forward
+
+        def broken_forward(model, qparams, batch_stats, x):
+            # A quantization bug that rotates every prediction by one
+            # class: guaranteed full disagreement with fp32.
+            return jnp.roll(real_forward(model, qparams, batch_stats, x),
+                            1, axis=-1)
+
+        monkeypatch.setattr(quant, "quantized_eval_forward",
+                            broken_forward)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            reg = ModelRegistry(buckets=(1, 4), precision="int8",
+                                journal=jr)
+            reg.load(_checkpoint(tmp_path))
+            assert reg.precision == "int8"          # requested
+            assert reg.serving_precision == "fp32"  # gate refused
+            assert reg.last_gate is not None
+            assert reg.last_gate.outcome == "refused"
+            # Still answers correctly (on the fp32 engine).
+            assert reg.infer(trials[:3]).shape == (3,)
+        events = obs_journal.schema.read_events(jr.events_path)
+        gates = [e for e in events if e["event"] == "quant_gate"]
+        assert gates and gates[0]["outcome"] == "refused"
+
+    def test_healthz_reports_precision_and_active_ladder(self, tmp_path,
+                                                         trials):
+        from eegnetreplication_tpu.serve.service import ServeApp
+        from eegnetreplication_tpu.serve.tuner import Proposal
+
+        ck = _checkpoint(tmp_path)
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(ck, port=0, buckets=(1, 4, 16), max_wait_ms=1.0,
+                           precision="int8", tune_every_s=3600.0,
+                           journal=jr).start()
+            try:
+                health = json.loads(urllib.request.urlopen(
+                    app.url + "/healthz", timeout=10).read())
+                assert health["precision"] == "int8"
+                assert health["requested_precision"] == "int8"
+                assert health["buckets"] == [1, 4, 16]
+                assert health["ladder_retunes"] == 0
+                assert health["max_batch"] == 16
+                assert health["max_wait_ms"] == pytest.approx(1.0)
+                # A retune moves the ACTIVE ladder /healthz reports.
+                app.tuner.apply(Proposal(buckets=(1, 8, 16),
+                                         max_wait_ms=2.5, reason="test"))
+                health = json.loads(urllib.request.urlopen(
+                    app.url + "/healthz", timeout=10).read())
+                assert health["buckets"] == [1, 8, 16]
+                assert health["ladder_retunes"] == 1
+                assert health["max_wait_ms"] == pytest.approx(2.5)
+                # Traffic still flows on the retuned int8 engine.
+                resp = _post(app.url + "/predict",
+                             {"trials": trials[:2].tolist()})
+                assert len(resp["predictions"]) == 2
+            finally:
+                app.stop()
+        events = obs_journal.schema.read_events(jr.events_path)
+        end = [e for e in events if e["event"] == "serve_end"][0]
+        assert end["ladder_retunes"] == 1
+        assert end["precision"] == "int8"
+        summary = obs_journal.schema.event_summary(events)
+        assert summary["precision"] == "int8"
+        assert summary["ladder_retunes"] == 1
+        assert summary["quant_gate"] == "pass"
+
+    def test_unknown_precision_is_an_error_not_int8(self):
+        """A typo'd precision must raise, not silently quantize."""
+        from eegnetreplication_tpu.serve.engine import build_gated_engine
+
+        model, params, bs = _variables()
+        with pytest.raises(ValueError, match="precision"):
+            build_gated_engine(model, params, bs, (1, 4),
+                               precision="fp16", warm=False)
+        with pytest.raises(ValueError, match="precision"):
+            InferenceEngine(model, params, bs, buckets=(1,),
+                            precision="INT8")
+
+    def test_predict_trials_precision_routes_through_gated_engine(
+            self, trials):
+        """ISSUE-8 satellite: the CLI path and the server build the int8
+        engine through the same gate, so their predictions agree."""
+        from eegnetreplication_tpu.predict import predict_trials
+        from eegnetreplication_tpu.serve.engine import build_gated_engine
+
+        model, params, bs = _variables()
+        engine, gate = build_gated_engine(model, params, bs, (1, 4, 16),
+                                          precision="int8", warm=False)
+        assert gate is not None
+        np.testing.assert_array_equal(
+            predict_trials(model, params, bs, trials, batch_size=16,
+                           precision="int8"),
+            engine.infer(trials))
 
 
 class TestServeBenchSelftest:
