@@ -8,13 +8,29 @@ paths (stdlib log lines, ``StepTimer`` sums, hand-built JSON dicts):
 - :mod:`~eegnetreplication_tpu.obs.metrics` — counters/gauges/histograms
   flushed to ``metrics.json``, optional TensorBoard scalar mirror;
 - :mod:`~eegnetreplication_tpu.obs.schema` — validation + the shared
-  atomic artifact writer (``BENCH_*.json`` goes through it too).
+  atomic artifact writer (``BENCH_*.json`` goes through it too);
+- :mod:`~eegnetreplication_tpu.obs.trace` — request-scoped distributed
+  tracing: contextvar-carried trace contexts propagated over HTTP, spans
+  as journal events, head-based sampling with anomaly tail-capture, and
+  cross-process stitching (``scripts/trace_report.py`` renders it);
+- :mod:`~eegnetreplication_tpu.obs.slo` — declarative SLO specs
+  evaluated over sliding windows of registry deltas, journaled
+  ``slo_breach``/``slo_recovered`` transitions feeding ``/healthz``;
+- :mod:`~eegnetreplication_tpu.obs.stats` — the shared percentile
+  estimator every reader and bench reports with.
 
 Entry points open a run with :func:`journal.run`; library code reaches the
 active journal via :func:`journal.current` (a no-op outside a run).
 """
 
-from eegnetreplication_tpu.obs import journal, metrics, schema
+from eegnetreplication_tpu.obs import (
+    journal,
+    metrics,
+    schema,
+    slo,
+    stats,
+    trace,
+)
 from eegnetreplication_tpu.obs.journal import (
     NullJournal,
     RunJournal,
@@ -22,7 +38,11 @@ from eegnetreplication_tpu.obs.journal import (
     new_run_id,
     run,
 )
-from eegnetreplication_tpu.obs.metrics import MetricsRegistry
+from eegnetreplication_tpu.obs.metrics import (
+    MetricsRegistry,
+    to_prometheus_text,
+)
+from eegnetreplication_tpu.obs.stats import percentile
 from eegnetreplication_tpu.obs.schema import (
     SCHEMA_VERSION,
     SchemaError,
@@ -36,9 +56,9 @@ from eegnetreplication_tpu.obs.schema import (
 )
 
 __all__ = [
-    "journal", "metrics", "schema",
+    "journal", "metrics", "schema", "slo", "stats", "trace",
     "RunJournal", "NullJournal", "MetricsRegistry",
-    "current", "run", "new_run_id",
+    "current", "run", "new_run_id", "percentile", "to_prometheus_text",
     "SCHEMA_VERSION", "SchemaError",
     "read_events", "read_metrics",
     "validate_bench", "validate_event", "validate_events",

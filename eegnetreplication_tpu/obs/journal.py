@@ -115,6 +115,12 @@ class RunJournal:
         # Serving journals from HTTP-handler and batcher threads
         # concurrently; one lock keeps every events.jsonl line whole.
         self._write_lock = threading.Lock()
+        # Persistent append handle: spans made the journal a hot path
+        # (thousands of events/s under sampled tracing), and an open()
+        # per event costs more than the write itself.  Crash-safety is
+        # unchanged — append mode plus a flush per line, so a SIGKILL
+        # still loses at most the line being written.
+        self._fh = None
 
     # -- event emission ---------------------------------------------------
     @property
@@ -143,12 +149,22 @@ class RunJournal:
                                or v is None else repr(v)
                                for k, v in record.items()})
         try:
-            with self._write_lock, open(self.events_path, "a") as fh:
-                fh.write(line + "\n")
-                fh.flush()
+            with self._write_lock:
+                if self._fh is None or self._fh.closed:
+                    self._fh = open(self.events_path, "a")
+                self._fh.write(line + "\n")
+                self._fh.flush()
         except OSError as exc:
             # Full/read-only filesystem hours into a run: drop the event,
-            # never the run (the module contract).
+            # never the run (the module contract).  Drop the handle too so
+            # the next event retries a fresh open (the path may heal).
+            with self._write_lock:
+                try:
+                    if self._fh is not None:
+                        self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
             logger.warning("Telemetry event %r dropped (cannot write %s: "
                            "%s)", event, self.events_path, exc)
         return record
@@ -178,6 +194,13 @@ class RunJournal:
             fields["error"] = error[:500]
         self.metrics.set("wall_seconds", round(wall, 3))
         self.event("run_end", **fields)
+        with self._write_lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+                self._fh = None
         try:
             self.flush_metrics()
         except OSError as exc:

@@ -7,19 +7,27 @@ the registry gives them one aggregation model:
 - **counter** — monotonically accumulating total (``fold_epochs_total``,
   ``device_fault_retries``, ``fault_retry_wall_s``);
 - **gauge** — last-written value (``hbm_bytes_in_use``, ``wall_seconds``);
-- **histogram** — count/sum/min/max/mean of observations
-  (``chunk_wall_s``, ``compile_seconds``).
+- **histogram** — count/sum/min/max/mean PLUS fixed log-spaced bucket
+  counts (``chunk_wall_s``, ``compile_seconds``, ``request_latency_ms``),
+  so p50/p95/p99 are answerable from the LIVE registry — ``/healthz``
+  degradation, the LadderTuner, and the SLO monitor read real-time tails
+  instead of sorting journal events after the fact.
 
 Every metric name holds a family of series keyed by labels
 (``inc("hbm_bytes_in_use", v, device="0")``), Prometheus-style.  The
 registry is flushed to a ``metrics.json`` summary validated by
-:mod:`eegnetreplication_tpu.obs.schema`; scalars can additionally be
-mirrored as TensorBoard scalars next to the ``--profileDir`` traces when a
-summary-writer backend is importable (best-effort — no hard dependency).
+:mod:`eegnetreplication_tpu.obs.schema`, and :func:`to_prometheus_text`
+renders the same snapshot in the Prometheus text exposition format
+(``GET /metrics`` content-negotiates between the two); scalars can
+additionally be mirrored as TensorBoard scalars next to the
+``--profileDir`` traces when a summary-writer backend is importable
+(best-effort — no hard dependency).
 """
 
 from __future__ import annotations
 
+import bisect
+import re
 import threading
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -32,24 +40,95 @@ def _label_key(labels: dict[str, str]) -> tuple[tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+# Fixed log-spaced histogram bucket upper bounds (Prometheus ``le``
+# semantics: a bucket counts observations <= its bound).  Quarter-decade
+# spacing (x1.78 per step) from 10 ms-scale microbenches up past 10^5, so
+# one ladder covers latencies in ms, wall seconds, batch sizes, and fill
+# fractions — a quantile estimated from these buckets lands within one
+# bucket width (< 2x) of the exact order statistic, tight enough for SLO
+# verdicts and the acceptance cross-check against journal-derived tails.
+DEFAULT_BUCKET_BOUNDS: tuple[float, ...] = tuple(
+    round(10.0 ** (k / 4.0), 6) for k in range(-8, 21))
+
+
+def quantile_from_buckets(bounds: tuple[float, ...] | list[float],
+                          counts: tuple[int, ...] | list[int],
+                          q: float, *, lo: float | None = None,
+                          hi: float | None = None) -> float:
+    """Estimate the ``q``-quantile from bucketed counts (``counts`` has
+    one entry per bound plus the +Inf overflow bucket).
+
+    Linear interpolation within the containing bucket; the observed
+    ``lo``/``hi`` (when given) clamp the first/last buckets so an
+    estimate can never leave the observed range.  Returns 0.0 for an
+    empty histogram.
+    """
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be within [0, 1], got {q}")
+    target = q * total
+    cum = 0.0
+    for i, n in enumerate(counts):
+        if n <= 0:
+            continue
+        if cum + n >= target:
+            lower = bounds[i - 1] if i > 0 else 0.0
+            upper = bounds[i] if i < len(bounds) else (
+                hi if hi is not None else bounds[-1])
+            # The observed range clamps BOTH ends in every bucket: no
+            # observation lies below lo or above hi, so interpolating
+            # from the raw bucket bound would understate a distribution
+            # concentrated in one bucket (e.g. constant latency).
+            if lo is not None:
+                lower = max(lower, lo)
+            if hi is not None:
+                upper = min(upper, hi)
+            if upper < lower:
+                upper = lower
+            frac = (target - cum) / n
+            return lower + frac * (upper - lower)
+        cum += n
+    return float(hi) if hi is not None else float(bounds[-1])
+
+
 @dataclass
 class _Histogram:
     count: int = 0
     sum: float = 0.0
     min: float = float("inf")
     max: float = float("-inf")
+    bounds: tuple[float, ...] = DEFAULT_BUCKET_BOUNDS
+    buckets: list[int] = field(default_factory=list)
+
+    def __post_init__(self):
+        if not self.buckets:
+            self.buckets = [0] * (len(self.bounds) + 1)
 
     def observe(self, value: float) -> None:
         self.count += 1
         self.sum += value
         self.min = min(self.min, value)
         self.max = max(self.max, value)
+        # Prometheus le semantics: bucket i counts observations <=
+        # bounds[i]; the final slot is the +Inf overflow.
+        self.buckets[bisect.bisect_left(self.bounds, value)] += 1
+
+    def quantile(self, q: float) -> float:
+        """The live q-quantile estimate from the bucket counts."""
+        return quantile_from_buckets(self.bounds, self.buckets, q,
+                                     lo=self.min if self.count else None,
+                                     hi=self.max if self.count else None)
 
     def to_dict(self, labels: dict) -> dict:
         return {"labels": labels, "count": self.count,
                 "sum": round(self.sum, 6),
                 "min": round(self.min, 6), "max": round(self.max, 6),
-                "mean": round(self.sum / self.count, 6) if self.count else 0.0}
+                "mean": round(self.sum / self.count, 6) if self.count
+                else 0.0,
+                "bounds": list(self.bounds),
+                "buckets": list(self.buckets)}
 
 
 @dataclass
@@ -109,6 +188,17 @@ class MetricsRegistry:
                     return store[name][key]
         return None
 
+    def quantile(self, name: str, q: float, **labels: str) -> float | None:
+        """Live quantile estimate for the histogram ``name{labels}``
+        (None when the series is absent) — the real-time tail read
+        ``/healthz`` and the SLO monitor use instead of journal scans."""
+        key = _label_key(labels)
+        with self._lock:
+            series = self._histograms.get(name)
+            if not series or key not in series:
+                return None
+            return series[key].quantile(q)
+
     def snapshot(self, run_id: str = "standalone") -> dict:
         """The registry's full state as a schema-valid metrics record."""
         with self._lock:
@@ -131,6 +221,102 @@ class MetricsRegistry:
         """Write the validated ``metrics.json`` summary atomically."""
         return schema.write_json_artifact(path, self.snapshot(run_id),
                                           kind="metrics", indent=1)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition (content-negotiated by GET /metrics).
+# ---------------------------------------------------------------------------
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+_LABEL_SANITIZE = re.compile(r"[^a-zA-Z0-9_]")
+
+# Accept-header fragments that select the text format over the JSON
+# snapshot (what a Prometheus scraper actually sends).
+PROMETHEUS_ACCEPT_HINTS = ("text/plain", "openmetrics")
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def wants_prometheus(accept_header: str | None) -> bool:
+    """Content negotiation: the JSON snapshot stays the default; only an
+    Accept header that names the text format (``text/plain`` or an
+    OpenMetrics type) selects Prometheus exposition.  A client that also
+    names ``application/json`` (e.g. axios' default
+    ``application/json, text/plain, */*``) keeps JSON — it listed the
+    text type as a fallback, not a preference."""
+    accept = (accept_header or "").lower()
+    if "application/json" in accept:
+        return False
+    return any(hint in accept for hint in PROMETHEUS_ACCEPT_HINTS)
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_SANITIZE.sub("_", str(name))
+    return "_" + name if name[:1].isdigit() else (name or "_")
+
+
+def _prom_label_value(value) -> str:
+    """Escape per the exposition format: backslash, double quote, and
+    newline are the three characters with escapes."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _prom_labels(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(
+        f'{_LABEL_SANITIZE.sub("_", str(k))}="{_prom_label_value(v)}"'
+        for k, v in sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+def _prom_number(value: float) -> str:
+    if value == float("inf"):
+        return "+Inf"
+    if value == float("-inf"):
+        return "-Inf"
+    return repr(float(value)) if isinstance(value, float) else str(value)
+
+
+def to_prometheus_text(snapshot: dict) -> str:
+    """Render a registry snapshot (:meth:`MetricsRegistry.snapshot`) in
+    the Prometheus text exposition format: counters and gauges as-is,
+    histograms as cumulative ``_bucket{le=...}`` series plus ``_sum`` and
+    ``_count`` — what any standard scraper ingests, covering exactly what
+    the JSON snapshot covers."""
+    lines: list[str] = []
+    for section, prom_type in (("counters", "counter"), ("gauges", "gauge")):
+        for name, series in sorted(snapshot.get(section, {}).items()):
+            pname = _prom_name(name)
+            lines.append(f"# TYPE {pname} {prom_type}")
+            for entry in series:
+                lines.append(f"{pname}{_prom_labels(entry['labels'])} "
+                             f"{_prom_number(entry['value'])}")
+    for name, series in sorted(snapshot.get("histograms", {}).items()):
+        pname = _prom_name(name)
+        lines.append(f"# TYPE {pname} histogram")
+        for entry in series:
+            labels = entry["labels"]
+            bounds = entry.get("bounds") or []
+            buckets = entry.get("buckets") or []
+            cum = 0
+            for bound, count in zip(bounds, buckets):
+                cum += count
+                lines.append(
+                    f"{pname}_bucket"
+                    f"{_prom_labels(labels, {'le': _prom_number(bound)})} "
+                    f"{cum}")
+            lines.append(
+                f"{pname}_bucket{_prom_labels(labels, {'le': '+Inf'})} "
+                f"{entry['count']}")
+            lines.append(f"{pname}_sum{_prom_labels(labels)} "
+                         f"{_prom_number(entry['sum'])}")
+            lines.append(f"{pname}_count{_prom_labels(labels)} "
+                         f"{entry['count']}")
+    return "\n".join(lines) + "\n"
 
 
 class TensorBoardMirror:

@@ -98,6 +98,16 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "fleet_shadow": ("replica", "reference", "n_trials", "agree"),
     "fleet_reload": ("status", "checkpoint"),
     "fleet_end": ("n_requests", "wall_s"),
+    # Distributed tracing (obs/trace.py): one event per finished span.
+    # trace_id groups spans across the per-process journals of a fleet
+    # run; parent_span_id (optional: absent on roots) links the tree;
+    # start is a wall-clock epoch for cross-process alignment and dur_ms
+    # comes from monotonic clocks.  scripts/trace_report.py stitches.
+    "span": ("name", "trace_id", "span_id", "start", "dur_ms"),
+    # SLO monitoring (obs/slo.py): ok->breach and breach->ok transitions
+    # of one declared objective over the sliding evaluation window.
+    "slo_breach": ("objective", "value", "threshold"),
+    "slo_recovered": ("objective", "threshold"),
     "run_end": ("status", "wall_s"),
 }
 
@@ -300,8 +310,10 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
     out["device_fault_retries"] = len(faults)
     if requests or swaps or any(e["event"] == "serve_start" for e in events):
         # Serving run: request count, tail latency, rejected/error split.
-        # p95 comes from the per-request journal events (the metrics
-        # histogram keeps only count/sum/min/max/mean by design).
+        # p95 here is the EXACT order statistic from the per-request
+        # journal events — the post-hoc cross-check of the live bucketed
+        # registry estimate (MetricsRegistry.quantile), which /healthz
+        # and the SLO monitor read in real time.
         out["n_requests"] = len(requests)
         out["rejected"] = sum(1 for e in requests
                               if e.get("status") == "rejected")
@@ -316,12 +328,17 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
             if e.get("status") not in ("ok", "rejected", "expired",
                                        "circuit_open"))
         out["model_swaps"] = len(swaps)
-        lat = sorted(e["latency_ms"] for e in requests
-                     if e.get("status") == "ok"
-                     and isinstance(e.get("latency_ms"), numbers.Real))
+        lat = [e["latency_ms"] for e in requests
+               if e.get("status") == "ok"
+               and isinstance(e.get("latency_ms"), numbers.Real)]
         if lat:
-            out["latency_p50_ms"] = round(lat[int(0.50 * (len(lat) - 1))], 3)
-            out["latency_p95_ms"] = round(lat[int(0.95 * (len(lat) - 1))], 3)
+            # The shared obs percentile (linear interpolation) — the same
+            # estimator the bench scripts report, so a run's journal row
+            # and its BENCH artifact cannot disagree on the same sample.
+            from eegnetreplication_tpu.obs.stats import percentile
+
+            out["latency_p50_ms"] = round(percentile(lat, 0.50), 3)
+            out["latency_p95_ms"] = round(percentile(lat, 0.95), 3)
         retunes = [e for e in events if e["event"] == "ladder_retune"]
         if retunes:
             out["ladder_retunes"] = len(retunes)
@@ -350,12 +367,46 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         out["session_resumes"] = len(session_resumes)
         out["session_snapshots"] = sum(
             1 for e in events if e["event"] == "session_snapshot")
-        wlat = sorted(e["latency_ms"] for e in windows
-                      if e.get("status") == "ok"
-                      and isinstance(e.get("latency_ms"), numbers.Real))
+        wlat = [e["latency_ms"] for e in windows
+                if e.get("status") == "ok"
+                and isinstance(e.get("latency_ms"), numbers.Real)]
         if wlat:
-            out["window_p50_ms"] = round(wlat[int(0.50 * (len(wlat) - 1))], 3)
-            out["window_p95_ms"] = round(wlat[int(0.95 * (len(wlat) - 1))], 3)
+            from eegnetreplication_tpu.obs.stats import percentile
+
+            out["window_p50_ms"] = round(percentile(wlat, 0.50), 3)
+            out["window_p95_ms"] = round(percentile(wlat, 0.95), 3)
+    # Tracing: how many sampled (or anomaly-flushed) traces this stream
+    # holds — the obs_report "traces" column; stitch with trace_report.
+    spans = [e for e in events if e["event"] == "span"]
+    if spans:
+        out["trace_spans"] = len(spans)
+        out["traces"] = len({e["trace_id"] for e in spans})
+    # SLO monitoring: breach count + the worst breach (largest relative
+    # exceedance), and whether every breached objective later recovered.
+    breaches = [e for e in events if e["event"] == "slo_breach"]
+    if breaches or any(e["event"] == "slo_recovered" for e in events):
+        out["slo_breaches"] = len(breaches)
+
+        def exceedance(ev) -> float:
+            value, threshold = ev.get("value"), ev.get("threshold")
+            if not isinstance(value, numbers.Real) \
+                    or not isinstance(threshold, numbers.Real):
+                return 0.0
+            if ev.get("metric", "").startswith("avail") \
+                    or ">" in str(ev.get("objective", "")):
+                return threshold / max(abs(value), 1e-12)
+            return value / max(abs(threshold), 1e-12)
+
+        if breaches:
+            worst = max(breaches, key=exceedance)
+            out["worst_slo"] = worst.get("objective")
+        last_state: dict[str, str] = {}
+        for ev in events:
+            if ev["event"] in ("slo_breach", "slo_recovered"):
+                last_state[ev.get("objective", "?")] = ev["event"]
+        still = sorted(o for o, s in last_state.items()
+                       if s == "slo_breach")
+        out["slo_breached_now"] = still
     if injected:
         out["faults_injected"] = len(injected)
     if retries:

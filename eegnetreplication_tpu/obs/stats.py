@@ -1,0 +1,35 @@
+"""Shared descriptive statistics for telemetry readers and benches.
+
+One percentile implementation, used by ``obs.schema.event_summary``, the
+metrics registry's bucketed-histogram quantile estimate cross-checks, and
+the load-generator scripts (``scripts/serve_bench.py``,
+``scripts/stream_bench.py``).  Before this module each consumer carried
+its own index arithmetic (``scripts/serve_bench.py`` and the inline
+truncating-``int()`` indexing in ``event_summary``), which produced
+subtly different estimates for the same sample — the exact drift a shared
+obs layer exists to prevent.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    """The ``q``-quantile (``0 <= q <= 1``) of ``values`` with linear
+    interpolation between closest ranks (numpy's default method).
+
+    Accepts any iterable; sorts a copy, so callers holding an already
+    sorted list pay one cheap re-sort rather than risking a silently
+    wrong answer on unsorted input.  Returns 0.0 for an empty sample.
+    """
+    data = sorted(float(v) for v in values)
+    if not data:
+        return 0.0
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be within [0, 1], got {q}")
+    pos = q * (len(data) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(data) - 1)
+    frac = pos - lo
+    return data[lo] + (data[hi] - data[lo]) * frac

@@ -44,6 +44,7 @@ from typing import Callable
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import trace
 from eegnetreplication_tpu.resil import heartbeat as hb
 from eegnetreplication_tpu.resil import inject
 from eegnetreplication_tpu.utils.logging import logger
@@ -92,10 +93,14 @@ class MicroBatcher:
         # worker from an idle one.  Default: the process emitter.
         self.heartbeat = heartbeat if heartbeat is not None else hb.emitter()
         self._cv = threading.Condition()
-        # Entries: (trials, future, t_enqueued, deadline-or-None) where
-        # the deadline is a time.monotonic() instant.
+        # Entries: (trials, future, t_enqueued, deadline-or-None, trace
+        # ctx-or-None) where the deadline is a time.monotonic() instant.
+        # The trace context is captured at submit so the worker can emit
+        # queue-wait/forward/scatter spans under the REQUEST's trace even
+        # though it runs in its own (construction-time) contextvars.
         self._pending: deque[
-            tuple[np.ndarray, Future, float, float | None]] = deque()
+            tuple[np.ndarray, Future, float, float | None,
+                  trace.TraceContext | None]] = deque()
         self._pending_trials = 0
         self._closed = False
         # Run the worker inside a copy of the constructing thread's
@@ -155,7 +160,8 @@ class MicroBatcher:
                 raise Rejected(
                     f"queue full ({self._pending_trials} trials pending, "
                     f"limit {self.max_queue_trials})")
-            self._pending.append((x, fut, time.perf_counter(), deadline))
+            self._pending.append((x, fut, time.perf_counter(), deadline,
+                                  trace.current()))
             self._pending_trials += n
             self._gauge_depth_locked()
             self._cv.notify_all()
@@ -193,7 +199,7 @@ class MicroBatcher:
             self._closed = True
             if not drain:
                 while self._pending:
-                    _, fut, _, _ = self._pending.popleft()
+                    _, fut, _, _, _ = self._pending.popleft()
                     fut.set_exception(Rejected("serving is shutting down"))
                 self._pending_trials = 0
                 self._gauge_depth_locked()
@@ -205,12 +211,14 @@ class MicroBatcher:
                                timeout)
 
     # -- worker side ------------------------------------------------------
-    def _take_batch(self) -> list[tuple[np.ndarray, Future, float]] | None:
+    def _take_batch(self) -> list[
+            tuple[np.ndarray, Future, float,
+                  trace.TraceContext | None]] | None:
         """Block for work, honor the coalescing window, pop one batch.
         Returns ``None`` when closed and fully drained.  Requests whose
         deadline already passed are dropped HERE — before the forward —
         with :class:`DeadlineExceeded` on their future."""
-        expired: list[Future] = []
+        expired: list[tuple[Future, float, trace.TraceContext | None]] = []
         try:
             while True:
                 with self._cv:
@@ -226,15 +234,24 @@ class MicroBatcher:
                 self.heartbeat.beat("serve_idle")
         finally:
             # Resolve expired futures outside the lock: their handler
-            # threads wake straight into journaling.
-            for fut in expired:
+            # threads wake straight into journaling.  The queue-wait span
+            # lands FIRST (status "expired") so the handler's anomaly
+            # flush finds it already buffered.
+            for fut, t_enq, ctx in expired:
+                trace.emit_span(
+                    ctx, "queue.wait",
+                    dur_s=time.perf_counter() - t_enq,
+                    journal=self._journal, status="expired")
                 if not fut.cancelled():
                     fut.set_exception(DeadlineExceeded(
                         "request deadline expired while queued; dropped "
                         "before inference"))
 
-    def _coalesce_locked(self, expired: list[Future]
-                         ) -> list[tuple[np.ndarray, Future, float]]:
+    def _coalesce_locked(
+            self,
+            expired: list[tuple[Future, float, trace.TraceContext | None]]
+    ) -> list[tuple[np.ndarray, Future, float,
+                    trace.TraceContext | None]]:
         """Honor the coalescing window and pop one batch (``self._cv``
         held).  Requests whose deadline passed while queued go onto
         ``expired`` instead of into the batch.
@@ -261,20 +278,21 @@ class MicroBatcher:
         batch = []
         n = 0
         now = time.monotonic()
-        skipped: list[tuple[np.ndarray, Future, float, float | None]] = []
+        skipped: list[tuple[np.ndarray, Future, float, float | None,
+                            trace.TraceContext | None]] = []
         while self._pending and n < self.max_batch:
-            x, fut, t_enq, deadline = self._pending.popleft()
+            x, fut, t_enq, deadline, ctx = self._pending.popleft()
             req_n = len(x)
             if deadline is not None and now >= deadline:
                 # Expired while queued: drop before the forward.
                 self._pending_trials -= req_n
-                expired.append(fut)
+                expired.append((fut, t_enq, ctx))
                 self._journal.metrics.inc("requests_expired")
                 continue
             if batch and n + req_n > self.max_batch:
-                skipped.append((x, fut, t_enq, deadline))
+                skipped.append((x, fut, t_enq, deadline, ctx))
                 continue  # greedy: later requests may still fit
-            batch.append((x, fut, t_enq))
+            batch.append((x, fut, t_enq, ctx))
             n += req_n
         # Skipped requests return to the FRONT in their arrival order —
         # they are older than everything behind them.
@@ -294,9 +312,27 @@ class MicroBatcher:
                 return
             if not batch:  # every queued request expired: nothing to run
                 continue
-            xs = [x for x, _, _ in batch]
+            xs = [x for x, _, _, _ in batch]
             x = np.concatenate(xs) if len(xs) > 1 else xs[0]
             now = time.perf_counter()
+            # Queue-wait spans land at dequeue (enqueue -> here), one per
+            # traced request, under each REQUEST's own context.
+            for bx, _, t_enq, ctx in batch:
+                trace.emit_span(ctx, "queue.wait",
+                                dur_s=now - t_enq, journal=self._journal,
+                                n_trials=len(bx))
+            # ONE shared forward span for the whole coalesced batch: it
+            # lives in the first sampled request's trace (else the first
+            # traced one) and names every other coalesced trace in
+            # link_traces, so the stitcher can attach it to their trees.
+            ctxs = [ctx for _, _, _, ctx in batch if ctx is not None]
+            primary = next((c for c in ctxs if c.sampled),
+                           ctxs[0] if ctxs else None)
+            link_traces = sorted({c.trace_id for c in ctxs
+                                  if primary is not None
+                                  and c.trace_id != primary.trace_id})
+            forward_span = None
+            t_fwd = time.perf_counter()
             try:
                 self.heartbeat.beat("serve_forward", n_trials=len(x))
                 # Chaos hang site (action="sleep"): a silent stall inside
@@ -304,22 +340,41 @@ class MicroBatcher:
                 # then nothing, which is exactly the wedged-worker shape
                 # /healthz staleness and the supervisor watchdog detect.
                 inject.fire("serve.hang", n_trials=len(x))
-                preds = np.asarray(self._infer_fn(x))
+                if primary is not None:
+                    with trace.use(primary), \
+                            trace.span("batch.forward",
+                                       journal=self._journal,
+                                       n_trials=len(x),
+                                       n_requests=len(batch),
+                                       link_traces=link_traces) as sp:
+                        preds = np.asarray(self._infer_fn(x))
+                        forward_span = sp.span_id if sp else None
+                else:
+                    preds = np.asarray(self._infer_fn(x))
             except BaseException as exc:  # noqa: BLE001 — routed to futures
-                for _, fut, _ in batch:
+                for _, fut, _, _ in batch:
                     if not fut.cancelled():
                         fut.set_exception(exc)
                 continue
             # Scatter rows back in arrival order: request i owns
             # preds[off : off + len(request i)].
+            t_scatter = time.perf_counter()
             off = 0
-            for bx, fut, t_enq in batch:
+            for bx, fut, t_enq, ctx in batch:
                 k = len(bx)
                 if not fut.cancelled():
                     fut.set_result(preds[off:off + k])
                 off += k
                 self._journal.metrics.observe(
                     "queue_wait_ms", (now - t_enq) * 1000.0)
+                # Per-request scatter span: dequeue -> result delivered,
+                # linked to the shared forward it rode.
+                trace.emit_span(
+                    ctx, "batch.scatter",
+                    dur_s=time.perf_counter() - t_fwd,
+                    journal=self._journal, n_trials=k,
+                    link_span=forward_span,
+                    forward_ms=round((t_scatter - t_fwd) * 1000.0, 3))
             self._journal.metrics.observe("batch_trials", len(x))
             self._journal.metrics.observe("batch_requests", len(batch))
             self.heartbeat.beat("serve_idle")

@@ -31,6 +31,7 @@ from pathlib import Path
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import trace
 from eegnetreplication_tpu.utils.logging import logger
 
 # The padded-batch compilation ladder.  Small enough that warmup stays
@@ -305,14 +306,22 @@ class InferenceEngine:
                 chunk = x[start:start + top]
                 k = len(chunk)
                 b = self.bucket_for(k)
-                if k < b:
-                    # Replicate the last real row: eval mode is
-                    # row-independent, so padding content is irrelevant —
-                    # but a real trial keeps the compiler's value profile
-                    # honest (no denormal/zero fast paths).
-                    chunk = np.concatenate(
-                        [chunk, np.repeat(chunk[-1:], b - k, axis=0)])
-                preds = np.asarray(self._fwd(self._jnp.asarray(chunk)))
+                # The engine-forward span (a child of the batcher's shared
+                # batch span when dispatched through it) carries the
+                # pad/coalesce picture: which bucket compiled program ran,
+                # how many real rows it served, at which precision.
+                with trace.span("engine.forward", journal=self._journal,
+                                bucket=b, n_real=k, padded=b - k,
+                                precision=self.precision):
+                    if k < b:
+                        # Replicate the last real row: eval mode is
+                        # row-independent, so padding content is
+                        # irrelevant — but a real trial keeps the
+                        # compiler's value profile honest (no
+                        # denormal/zero fast paths).
+                        chunk = np.concatenate(
+                            [chunk, np.repeat(chunk[-1:], b - k, axis=0)])
+                    preds = np.asarray(self._fwd(self._jnp.asarray(chunk)))
                 out[start:start + k] = preds[:k]
                 self._journal.metrics.observe("bucket_fill", k / b,
                                               bucket=str(b))

@@ -133,6 +133,7 @@ class Replica:
         self.digest: str | None = None
         self.precision: str | None = None   # from the last health poll
         self.buckets: tuple[int, ...] | None = None  # active ladder
+        self.slo_breached: list[str] = []   # breached SLO objectives
         self.queue_depth = 0          # requests, from the last health poll
         self.health_failures = 0      # consecutive unreachable polls
         self.last_poll_t = 0.0
@@ -165,6 +166,7 @@ class Replica:
                 "state": self.state, "digest": self.digest,
                 "precision": self.precision,
                 "buckets": list(self.buckets) if self.buckets else None,
+                "slo_breached": list(self.slo_breached),
                 "queue_depth": self.queue_depth, "inflight": self.inflight,
                 "circuit": self.breaker.state}
 
@@ -294,6 +296,13 @@ class FleetMembership:
         depth = payload.get("queue_depth_requests")
         if isinstance(depth, int):
             replica.queue_depth = depth
+        # Per-replica SLO state rides /healthz too: the fleet endpoint
+        # aggregates which members are breaching which objectives.
+        slo = payload.get("slo")
+        if isinstance(slo, dict):
+            breached = slo.get("breached")
+            replica.slo_breached = ([str(b) for b in breached]
+                                    if isinstance(breached, list) else [])
         if replica.state == CANARY:
             return  # the rolling-reload controller owns this transition
         # The heartbeat verdict is computed FIRST and gates the rejoin:

@@ -37,6 +37,7 @@ import time
 from collections import deque
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import trace
 from eegnetreplication_tpu.serve.fleet import membership as ms
 from eegnetreplication_tpu.utils.logging import logger
 
@@ -104,8 +105,21 @@ class FleetRouter:
                  headers: dict | None = None) -> tuple[int, bytes, str]:
         """Route one ``/predict`` body; returns ``(status, body,
         replica_id)``.  Raises :class:`NoLiveReplicas` /
-        :class:`AllReplicasBusy` when the fleet cannot take it."""
-        send_headers = dict(headers or {})
+        :class:`AllReplicasBusy` when the fleet cannot take it.
+
+        Tracing: under an active trace context, the whole routing
+        decision is one ``router.dispatch`` span; every failover retry is
+        a ``router.retry`` CHILD span (replica + reason), and each
+        attempt propagates ``X-Trace-Id``/``X-Parent-Span`` so the
+        replica's spans parent onto the attempt that actually reached it.
+        """
+        with trace.span("router.dispatch", journal=self._journal) as sp:
+            result = self._dispatch_traced(body, content_type,
+                                           dict(headers or {}), sp)
+        return result
+
+    def _dispatch_traced(self, body: bytes, content_type: str,
+                         send_headers: dict, sp) -> tuple[int, bytes, str]:
         send_headers["Content-Type"] = content_type
         with self._ring_lock:
             self._ring.append((body, content_type))
@@ -114,6 +128,7 @@ class FleetRouter:
         tried: set[str] = set()
         last_busy: tuple[int, bytes, str] | None = None
         last_error: tuple[int, bytes, str] | None = None
+        attempt = 0
         while True:
             replica = self._pick(tried)
             if replica is None:
@@ -124,20 +139,11 @@ class FleetRouter:
                     return last_error  # every live replica failed: honest 5xx
                 raise NoLiveReplicas("no live replicas in the fleet")
             tried.add(replica.replica_id)
-            replica.begin()
-            try:
-                status, data = replica.client.request(
-                    "POST", "/predict", body=body, headers=send_headers,
-                    timeout_s=self.predict_timeout_s)
-            except (OSError, http.client.HTTPException) as exc:
-                replica.breaker.record_failure()
-                if isinstance(exc, _DEAD_CONNECTION):
-                    self.membership.mark_unreachable(
-                        replica, f"dispatch: {type(exc).__name__}")
-                self._failover(replica, f"{type(exc).__name__}: {exc}")
+            outcome = self._attempt(replica, body, send_headers, attempt)
+            attempt += 1
+            if outcome[0] == "transport":
                 continue
-            finally:
-                replica.done()
+            status, data = outcome[1], outcome[2]
             if status == 429:
                 # Backpressure is not a fault: release any half-open probe
                 # slot allow() claimed (no outcome will be recorded) and
@@ -151,7 +157,47 @@ class FleetRouter:
                 self._failover(replica, f"http {status}")
                 continue
             replica.breaker.record_success()
+            if sp is not None:
+                sp.set(replica=replica.replica_id, attempts=attempt)
             return status, data, replica.replica_id
+
+    def _attempt(self, replica: ms.Replica, body: bytes,
+                 send_headers: dict, attempt: int):
+        """One dispatch attempt.  Failover attempts (> 0) are traced as
+        ``router.retry`` child spans; every attempt carries the trace
+        propagation headers with the CURRENT span as the parent, so the
+        replica's tree hangs off the attempt that reached it."""
+        def run():
+            replica.begin()
+            try:
+                status, data = replica.client.request(
+                    "POST", "/predict", body=body,
+                    headers={**send_headers, **trace.headers()},
+                    timeout_s=self.predict_timeout_s)
+            except (OSError, http.client.HTTPException) as exc:
+                replica.breaker.record_failure()
+                if isinstance(exc, _DEAD_CONNECTION):
+                    self.membership.mark_unreachable(
+                        replica, f"dispatch: {type(exc).__name__}")
+                self._failover(replica, f"{type(exc).__name__}: {exc}")
+                return ("transport", None, None)
+            finally:
+                replica.done()
+            return ("http", status, data)
+
+        if attempt == 0 or trace.current() is None:
+            return run()
+        with trace.span("router.retry", journal=self._journal,
+                        replica=replica.replica_id, attempt=attempt) as sp:
+            outcome = run()
+            # run() converts failures into return values (the failover
+            # loop's contract), so no exception reaches the span: mark
+            # failed attempts explicitly or every retry reads "ok" in
+            # the waterfall.
+            if sp is not None and (outcome[0] == "transport"
+                                   or (outcome[1] or 0) >= 500):
+                sp.status = "error"
+            return outcome
 
     def dispatch_to(self, replica: ms.Replica, body: bytes,
                     content_type: str = "application/json",

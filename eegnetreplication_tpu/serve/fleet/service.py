@@ -37,6 +37,7 @@ from http.server import ThreadingHTTPServer
 from pathlib import Path
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import trace
 from eegnetreplication_tpu.resil import preempt, supervise
 from eegnetreplication_tpu.serve.service import JsonRequestHandler
 from eegnetreplication_tpu.serve.fleet import membership as ms
@@ -73,6 +74,7 @@ class FleetApp:
                  host: str = "127.0.0.1", port: int = 0,
                  poll_s: float = 0.25, predict_timeout_s: float = 60.0,
                  shadow_n: int = 16, agree_floor: float = 0.0,
+                 trace_sample: float = trace.DEFAULT_SAMPLE_RATE,
                  on_checkpoint_change=None, journal=None):
         self.journal = journal if journal is not None \
             else obs_journal.current()
@@ -90,6 +92,10 @@ class FleetApp:
                                   journal=self.journal)
         self.shadow_n = int(shadow_n)
         self.agree_floor = float(agree_floor)
+        # The router is the TRACE EDGE: the head-based sampling decision
+        # for the whole request tree is made here and propagated to the
+        # replica over the X-Trace-Id/X-Parent-Span headers.
+        self.trace_sample = float(trace_sample)
         self._host, self._port = host, int(port)
         self._httpd: ThreadingHTTPServer | None = None
         self._listener: threading.Thread | None = None
@@ -183,6 +189,12 @@ class FleetApp:
         self.journal.metrics.inc("requests_total", status=status)
         if status == "ok":
             self.journal.metrics.observe("request_latency_ms", latency_ms)
+        # Anomaly tail-capture mirrors the replica rule; a fleet with NO
+        # live replica is the anomaly most worth a trace of all.
+        if status == "no_replicas":
+            trace.flush(journal=self.journal)
+        else:
+            trace.flush_if_anomalous(status, journal=self.journal)
 
     # -- rolling reload ----------------------------------------------------
     def rolling_reload(self, checkpoint: str, *,
@@ -232,16 +244,24 @@ class _FleetHandler(JsonRequestHandler):
             n_live = sum(1 for r in snapshot if r["state"] == ms.LIVE)
             digests = sorted({r["digest"] for r in snapshot
                               if r["state"] == ms.LIVE and r["digest"]})
+            # Aggregate per-replica SLO state (mirrored from each
+            # replica's /healthz by the membership poll): which members
+            # are currently breaching which objectives.  A breaching
+            # replica answers 503 and is drained by membership, so the
+            # aggregate also explains WHY a member left rotation.
+            slo_breached = {r["replica"]: r["slo_breached"]
+                            for r in snapshot if r.get("slo_breached")}
             self._reply(200 if n_live else 503, {
                 "status": "ok" if n_live else "no_live_replicas",
                 "n_replicas": len(snapshot), "n_live": n_live,
                 "checkpoint": app.checkpoint,
                 "serving_digests": digests,
+                "slo": {"replicas_breached": slo_breached,
+                        "any_breached": bool(slo_breached)},
                 "replicas": snapshot})
             return
         if self.path == "/metrics":
-            self._reply(200, app.journal.metrics.snapshot(
-                run_id=app.journal.run_id))
+            self._reply_metrics(app.journal)
             return
         self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -265,6 +285,17 @@ class _FleetHandler(JsonRequestHandler):
             app.end_request()
 
     def _predict(self) -> None:
+        # The trace is born HERE (or inherited from an upstream edge):
+        # the router's sampling verdict rides the dispatch headers to the
+        # replica, so one decision governs the whole cross-process tree.
+        app = self.app
+        ctx = trace.maybe_start(self.headers, app.trace_sample)
+        with trace.use(ctx), trace.span("router.request",
+                                        journal=app.journal,
+                                        route="/predict"):
+            self._predict_traced()
+
+    def _predict_traced(self) -> None:
         app = self.app
         t0 = time.perf_counter()
         body = self._read_body()
@@ -411,20 +442,49 @@ def main(argv=None) -> int:
     parser.add_argument("--maxWaitMs", type=float, default=5.0)
     parser.add_argument("--maxQueue", type=int, default=512)
     parser.add_argument("--buckets", default=None)
+    parser.add_argument("--traceSample", type=float,
+                        default=trace.DEFAULT_SAMPLE_RATE,
+                        help="Head-based trace sampling rate at the "
+                             "router edge; replicas inherit the verdict "
+                             "via X-Trace-Id/X-Parent-Span headers.")
+    parser.add_argument("--slo", type=str, default=None,
+                        help="Per-replica SLO spec (forwarded to every "
+                             "replica's --slo); breaches degrade replica "
+                             "healthz and surface in the fleet's "
+                             "aggregate /healthz.")
     parser.add_argument("--metricsDir", type=str, default=None)
     parser.add_argument("--startupTimeoutS", type=float, default=300.0)
     args = parser.parse_args(argv)
     if args.replicas < 1:
         parser.error("--replicas must be >= 1")
+    if args.slo:
+        # Validate HERE, not in each replica: a malformed spec forwarded
+        # blind would argparse-exit every child and spin the supervisor's
+        # relaunch loop until the startup timeout gives up.
+        from eegnetreplication_tpu.obs import slo as obs_slo
+
+        try:
+            obs_slo.parse_slo_spec(args.slo)
+        except ValueError as exc:
+            parser.error(f"--slo: {exc}")
 
     from eegnetreplication_tpu.config import Paths
 
     metrics_dir = (Path(args.metricsDir) if args.metricsDir
                    else Paths.from_here().reports / "obs")
     serve_args = ["--maxWaitMs", str(args.maxWaitMs),
-                  "--maxQueue", str(args.maxQueue)]
+                  "--maxQueue", str(args.maxQueue),
+                  # Replicas inherit the edge's sampling verdict via the
+                  # propagated headers for ROUTED traffic; forwarding the
+                  # rate governs their own head sampling of direct
+                  # (headerless) requests — without it, --traceSample 0
+                  # would still leave every replica sampling at its own
+                  # default.
+                  "--traceSample", str(args.traceSample)]
     if args.buckets:
         serve_args += ["--buckets", args.buckets]
+    if args.slo:
+        serve_args += ["--slo", args.slo]
     with obs_journal.run(metrics_dir, config=vars(args),
                          role="fleet") as journal, preempt.guard():
         sup, replicas = spawn_replica_fleet(
@@ -436,6 +496,7 @@ def main(argv=None) -> int:
         app = FleetApp(replicas, args.checkpoint, host=args.host,
                        port=args.port, poll_s=args.pollS,
                        shadow_n=args.shadowN, agree_floor=args.agreeFloor,
+                       trace_sample=args.traceSample,
                        on_checkpoint_change=lambda ck:
                        update_child_checkpoints(sup, ck),
                        journal=journal)

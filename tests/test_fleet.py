@@ -55,7 +55,9 @@ class FakeReplica:
         self.predictions = [0, 1, 2]         # served to every /predict
         # reload_fn(checkpoint) -> (status, digest-or-error)
         self.reload_fn = lambda ck: (200, "d-new")
+        self.slo_breached: list[str] = []     # advertised on /healthz
         self.log: list[tuple[str, bytes]] = []
+        self.headers_log: list[dict] = []     # per-/predict request headers
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -87,6 +89,7 @@ class FakeReplica:
                                              or fake.digest),
                         "precision": fake.precision,
                         "buckets": list(fake.buckets),
+                        "slo": {"breached": list(fake.slo_breached)},
                         "queue_depth_requests": fake.queue_depth,
                         "queue_depth_trials": fake.queue_depth})
                     return
@@ -97,6 +100,7 @@ class FakeReplica:
                 body = self.rfile.read(n) if n else b""
                 fake.log.append((self.path, body))
                 if self.path == "/predict":
+                    fake.headers_log.append(dict(self.headers.items()))
                     if fake.predict_status != 200:
                         self._reply(fake.predict_status,
                                     {"error": "scripted"})
@@ -511,7 +515,8 @@ class TestFleetSelftest:
         out = tmp_path / "BENCH_FLEET_selftest.json"
         proc = subprocess.run(
             [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
-             "--fleet", "4", "--selftest", "--out", str(out)],
+             "--fleet", "4", "--selftest", "--out", str(out),
+             "--traceSample", "0.25"],
             capture_output=True, text=True, timeout=420,
             env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
                      EEGTPU_PLATFORM="cpu"))
@@ -526,3 +531,115 @@ class TestFleetSelftest:
         assert record["failed_canary_leg"]["digests_unchanged"] is True
         assert record["journal"]["fleet_shadow_events"] >= 1
         assert record["http_smoke"]["ok"] is True
+        # ISSUE-9 acceptance: sampled requests through the real 4-replica
+        # fleet reconstruct as complete cross-process trace trees from
+        # the router + replica journals alone.
+        assert record["trace"]["complete_traces"] >= 1
+
+
+class TestFleetTracing:
+    """PR 9: the router is the trace edge — spans journal under one
+    trace id, failover retries become child spans, and propagation
+    headers reach the replica that actually served the request."""
+
+    def _spans(self, jr):
+        return [e for e in schema.read_events(jr.events_path,
+                                              complete=False)
+                if e["event"] == "span"]
+
+    def test_dispatch_propagates_trace_headers(self, journal):
+        from eegnetreplication_tpu.obs import trace
+
+        fake = FakeReplica()
+        try:
+            _, membership, router = _fleet([fake], journal)
+            membership.poll_once()
+            ctx = trace.TraceContext(trace.new_trace_id(), sampled=True)
+            with trace.use(ctx):
+                status, _, _ = router.dispatch(b"{}")
+            assert status == 200
+            sent = fake.headers_log[-1]
+            assert sent["X-Trace-Id"] == ctx.trace_id
+            assert sent["X-Trace-Sampled"] == "1"
+            spans = self._spans(journal)
+            dispatch = [s for s in spans
+                        if s["name"] == "router.dispatch"][0]
+            # The replica's parent is the dispatch span (no failover).
+            assert sent["X-Parent-Span"] == dispatch["span_id"]
+            assert dispatch["replica"] == "r0"
+            assert dispatch["attempts"] == 1
+        finally:
+            fake.stop()
+
+    def test_untraced_dispatch_sends_no_headers_no_spans(self, journal):
+        fake = FakeReplica()
+        try:
+            _, membership, router = _fleet([fake], journal)
+            membership.poll_once()
+            status, _, _ = router.dispatch(b"{}")
+            assert status == 200
+            sent = fake.headers_log[-1]
+            assert "X-Trace-Id" not in sent
+            assert self._spans(journal) == []
+        finally:
+            fake.stop()
+
+    def test_failover_produces_retry_child_span_same_trace(self, journal):
+        """ISSUE-9 satellite: a failover dispatch yields a router.retry
+        CHILD span on the same trace_id, and the surviving replica's
+        propagated parent is the RETRY span (the attempt that reached
+        it)."""
+        from eegnetreplication_tpu.obs import trace
+
+        dying, healthy = FakeReplica(), FakeReplica()
+        try:
+            replicas, membership, router = _fleet([dying, healthy],
+                                                  journal)
+            membership.poll_once()
+            dying.queue_depth = 0
+            healthy.queue_depth = 10  # force the dying one to be tried
+            dying.stop()              # dies AFTER membership saw it live
+            ctx = trace.TraceContext(trace.new_trace_id(), sampled=True)
+            with trace.use(ctx):
+                status, _, replica_id = router.dispatch(b"{}")
+            assert status == 200 and replica_id == "r1"
+            spans = self._spans(journal)
+            by_name = {s["name"]: s for s in spans}
+            dispatch = by_name["router.dispatch"]
+            retry = by_name["router.retry"]
+            assert retry["trace_id"] == dispatch["trace_id"] \
+                == ctx.trace_id
+            assert retry["parent_span_id"] == dispatch["span_id"]
+            assert retry["replica"] == "r1"
+            # The replica that answered saw the retry span as parent.
+            sent = healthy.headers_log[-1]
+            assert sent["X-Parent-Span"] == retry["span_id"]
+            assert sent["X-Trace-Id"] == ctx.trace_id
+            # And the stitcher reconstructs dispatch -> retry as a tree.
+            trees = trace.build_traces(spans)
+            tree = trees[ctx.trace_id]
+            assert [s["name"] for s in tree.roots] == ["router.dispatch"]
+            assert [s["name"] for s in
+                    tree.children[dispatch["span_id"]]] == ["router.retry"]
+        finally:
+            healthy.stop()
+
+
+class TestFleetSLOAggregation:
+    def test_replica_slo_state_mirrors_into_snapshot(self, journal):
+        """Each replica's /healthz-advertised SLO breaches flow through
+        the membership poll into the snapshot the fleet /healthz
+        aggregates."""
+        fake = FakeReplica()
+        fake.slo_breached = ["p95_latency_ms<50"]
+        try:
+            replicas, membership, _ = _fleet([fake], journal)
+            membership.poll_once()
+            assert replicas[0].slo_breached == ["p95_latency_ms<50"]
+            snap = membership.snapshot()[0]
+            assert snap["slo_breached"] == ["p95_latency_ms<50"]
+            fake.slo_breached = []
+            membership.poll_once()
+            assert membership.snapshot()[0]["slo_breached"] == []
+        finally:
+            fake.stop()

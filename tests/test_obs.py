@@ -264,3 +264,344 @@ class TestProtocolTelemetry:
         assert summary["status"] == "ok"
         assert summary["n_epoch_events"] == 3
         assert summary["fold_epochs_total"] == 12
+
+
+class TestHistogramBuckets:
+    """PR 9: the registry's histograms carry fixed log-spaced buckets so
+    live quantiles exist without journal scans."""
+
+    def test_bucket_boundary_le_semantics(self):
+        from eegnetreplication_tpu.obs.metrics import _Histogram
+
+        h = _Histogram(bounds=(1.0, 10.0, 100.0))
+        for v in (0.5, 1.0, 1.0001, 10.0, 99.0, 100.0, 100.0001):
+            h.observe(v)
+        # Prometheus le semantics: a bucket counts observations <= bound
+        # (exact boundary values land in the bucket they bound).
+        assert h.buckets == [2, 2, 2, 1]
+        assert sum(h.buckets) == h.count == 7
+
+    def test_quantile_within_one_bucket_width(self):
+        from eegnetreplication_tpu.obs.metrics import (
+            DEFAULT_BUCKET_BOUNDS,
+            _Histogram,
+        )
+        from eegnetreplication_tpu.obs.stats import percentile
+
+        rng = np.random.RandomState(0)
+        values = (rng.lognormal(mean=2.0, sigma=1.0, size=5000)
+                  .astype(float).tolist())
+        h = _Histogram()
+        for v in values:
+            h.observe(v)
+        bounds = list(DEFAULT_BUCKET_BOUNDS)
+        for q in (0.5, 0.95, 0.99):
+            exact = percentile(values, q)
+            est = h.quantile(q)
+            # Within one bucket width: the estimate and the exact order
+            # statistic share a bucket or an adjacent boundary.
+            import bisect
+
+            i = bisect.bisect_left(bounds, exact)
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else h.max
+            assert lo * 0.999 <= est <= hi * 1.001, (q, exact, est, lo, hi)
+
+    def test_empty_and_single_observation(self):
+        from eegnetreplication_tpu.obs.metrics import _Histogram
+
+        h = _Histogram()
+        assert h.quantile(0.95) == 0.0
+        h.observe(42.0)
+        assert h.quantile(0.0) <= 42.0 <= h.max
+        # The estimate is clamped to the observed range.
+        assert h.quantile(0.99) <= 42.0 * 1.0001
+
+    def test_registry_quantile_and_snapshot_roundtrip(self, tmp_path):
+        reg = MetricsRegistry()
+        for v in range(1, 101):
+            reg.observe("latency_ms", float(v))
+        p95 = reg.quantile("latency_ms", 0.95)
+        assert p95 is not None and 80.0 <= p95 <= 100.0
+        assert reg.quantile("nope", 0.5) is None
+        snap = reg.snapshot()
+        entry = snap["histograms"]["latency_ms"][0]
+        assert sum(entry["buckets"]) == entry["count"] == 100
+        assert len(entry["buckets"]) == len(entry["bounds"]) + 1
+        # The flushed artifact still validates against the schema.
+        schema.validate_metrics(snap)
+
+
+class TestPrometheusExposition:
+    def _snapshot(self):
+        reg = MetricsRegistry()
+        reg.inc("requests_total", 3, status="ok")
+        reg.inc("requests_total", 1, status='we"ird\nlabel\\x')
+        reg.set("queue_depth", 7.0)
+        reg.observe("latency_ms", 2.0)
+        reg.observe("latency_ms", 50.0)
+        return reg.snapshot()
+
+    def test_text_format_sections(self):
+        from eegnetreplication_tpu.obs.metrics import to_prometheus_text
+
+        text = to_prometheus_text(self._snapshot())
+        assert "# TYPE requests_total counter" in text
+        assert 'requests_total{status="ok"} 3' in text
+        assert "# TYPE queue_depth gauge" in text
+        assert "# TYPE latency_ms histogram" in text
+        assert "latency_ms_count 2" in text
+        assert "latency_ms_sum 52" in text
+        assert 'latency_ms_bucket{le="+Inf"} 2' in text
+
+    def test_label_escaping(self):
+        from eegnetreplication_tpu.obs.metrics import to_prometheus_text
+
+        text = to_prometheus_text(self._snapshot())
+        # Backslash, double quote, and newline are escaped per the
+        # exposition format; the raw forms must not appear.
+        assert 'status="we\\"ird\\nlabel\\\\x"' in text
+        assert "\nlabel" not in text.replace("\\n", "")
+
+    def test_histogram_buckets_cumulative(self):
+        from eegnetreplication_tpu.obs.metrics import to_prometheus_text
+
+        text = to_prometheus_text(self._snapshot())
+        counts = []
+        for line in text.splitlines():
+            if line.startswith("latency_ms_bucket"):
+                counts.append(int(line.rsplit(" ", 1)[1]))
+        assert counts == sorted(counts)  # cumulative, monotonically up
+        assert counts[-1] == 2           # +Inf equals the count
+
+    def test_content_negotiation_helper(self):
+        from eegnetreplication_tpu.obs.metrics import wants_prometheus
+
+        assert not wants_prometheus(None)
+        assert not wants_prometheus("application/json")
+        assert not wants_prometheus("*/*")
+        assert wants_prometheus("text/plain; version=0.0.4")
+        assert wants_prometheus(
+            "application/openmetrics-text;version=1.0.0,text/plain")
+
+
+class TestTrace:
+    def test_span_nesting_and_parentage(self, tmp_path):
+        from eegnetreplication_tpu.obs import trace
+
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            ctx = trace.TraceContext(trace.new_trace_id(), sampled=True)
+            with trace.use(ctx):
+                with trace.span("outer", journal=jr) as outer:
+                    with trace.span("inner", journal=jr) as inner:
+                        pass
+        events = schema.read_events(jr.events_path)
+        spans = {e["name"]: e for e in events if e["event"] == "span"}
+        assert spans["inner"]["parent_span_id"] == outer.span_id
+        assert spans["outer"]["parent_span_id"] is None
+        assert spans["inner"]["trace_id"] == spans["outer"]["trace_id"]
+        assert spans["inner"]["span_id"] == inner.span_id
+        # inner closed first: journal order is inner, outer.
+        names = [e["name"] for e in events if e["event"] == "span"]
+        assert names == ["inner", "outer"]
+        assert not any("_schema_error" in e for e in events)
+
+    def test_unsampled_buffers_and_anomaly_flush(self, tmp_path):
+        from eegnetreplication_tpu.obs import trace
+
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            ctx = trace.TraceContext(trace.new_trace_id(), sampled=False)
+            with trace.use(ctx):
+                with trace.span("buffered", journal=jr):
+                    pass
+                assert not [e for e in schema.read_events(
+                    jr.events_path, complete=False)
+                    if e["event"] == "span"]
+                # A non-anomalous status flushes nothing...
+                assert trace.flush_if_anomalous("ok", journal=jr) == 0
+                # ...an anomalous one writes the buffer and latches the
+                # trace so later spans journal directly.
+                assert trace.flush_if_anomalous("error", journal=jr) == 1
+                with trace.span("after_flush", journal=jr):
+                    pass
+        spans = [e for e in schema.read_events(jr.events_path)
+                 if e["event"] == "span"]
+        assert [s["name"] for s in spans] == ["buffered", "after_flush"]
+
+    def test_header_roundtrip(self):
+        from eegnetreplication_tpu.obs import trace
+
+        ctx = trace.TraceContext(trace.new_trace_id(),
+                                 span_id=trace.new_span_id(), sampled=True)
+        headers = trace.headers(ctx)
+        back = trace.from_headers(headers)
+        assert back.trace_id == ctx.trace_id
+        assert back.span_id == ctx.span_id
+        assert back.sampled is True
+        assert trace.from_headers({}) is None
+        # maybe_start: propagated context wins; rate 0 disables tracing.
+        assert trace.maybe_start(headers, 0.0).trace_id == ctx.trace_id
+        assert trace.maybe_start({}, 0.0) is None
+        assert trace.maybe_start({}, 1.0).sampled is True
+
+    def test_sampling_rate_zero_and_one(self):
+        from eegnetreplication_tpu.obs import trace
+
+        assert not trace.start(0.0).sampled
+        assert trace.start(1.0).sampled
+
+    def test_stitch_cross_process_trees(self, tmp_path):
+        """Two 'processes' (journals) sharing one trace id stitch into a
+        single tree with the cross-process parent link intact."""
+        from eegnetreplication_tpu.obs import trace
+
+        trace_id = trace.new_trace_id()
+        with obs.run(tmp_path / "router_obs", config={}) as rj:
+            ctx = trace.TraceContext(trace_id, sampled=True)
+            with trace.use(ctx):
+                with trace.span("router.dispatch", journal=rj) as root:
+                    pass
+        with obs.run(tmp_path / "replica_obs", config={}) as pj:
+            child = trace.TraceContext(trace_id, span_id=root.span_id,
+                                       sampled=True)
+            with trace.use(child):
+                with trace.span("replica.request", journal=pj):
+                    with trace.span("queue.wait", journal=pj):
+                        pass
+        trees = trace.build_traces(trace.read_spans(
+            [tmp_path / "router_obs", tmp_path / "replica_obs"]))
+        assert len(trees) == 1
+        tree = trees[trace_id]
+        assert tree.span_names == {"router.dispatch", "replica.request",
+                                   "queue.wait"}
+        assert len(tree.processes) == 2
+        assert tree.cross_process_complete()
+        assert [s["name"] for s in tree.roots] == ["router.dispatch"]
+        # Chrome export covers every span plus metadata records.
+        events = trace.chrome_trace_events(trees)
+        xs = [e for e in events if e.get("ph") == "X"]
+        assert len(xs) == 3
+        assert {e["pid"] for e in xs} == {1, 2}
+
+    def test_trace_report_cli(self, tmp_path):
+        from eegnetreplication_tpu.obs import trace
+
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            ctx = trace.TraceContext(trace.new_trace_id(), sampled=True)
+            with trace.use(ctx):
+                with trace.span("solo", journal=jr):
+                    pass
+        out = tmp_path / "chrome.json"
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "trace_report.py"),
+             str(tmp_path / "obs"), "--chrome", str(out)],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1"))
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        assert "solo" in proc.stdout
+        assert json.loads(out.read_text())["traceEvents"]
+        # The cross-process gate fails on a single-process trace.
+        gate = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "trace_report.py"),
+             str(tmp_path / "obs"), "--require-cross-process"],
+            capture_output=True, text=True, timeout=120,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1"))
+        assert gate.returncode == 1
+
+
+class TestSLO:
+    def _monitor(self, jr, spec, **kw):
+        from eegnetreplication_tpu.obs import slo
+
+        clock = [0.0]
+        kw.setdefault("window_s", 10.0)
+        mon = slo.SLOMonitor(jr.metrics, spec, interval_s=0.0,
+                             journal=jr, clock=lambda: clock[0], **kw)
+        return mon, clock
+
+    def test_parse_spec(self):
+        from eegnetreplication_tpu.obs import slo
+
+        objs = slo.parse_slo_spec(
+            "p95_latency_ms<50,error_rate<0.01,availability>0.999")
+        assert [o.metric for o in objs] == ["p95_latency_ms", "error_rate",
+                                           "availability"]
+        assert objs[0].threshold == 50.0 and objs[0].op == "<"
+        with pytest.raises(ValueError):
+            slo.parse_slo_spec("bogus_metric<1")
+        with pytest.raises(ValueError):
+            slo.parse_slo_spec("p95_latency_ms=50")
+        with pytest.raises(ValueError):
+            slo.parse_slo_spec("")
+
+    def test_breach_and_recover_error_rate(self, tmp_path):
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            mon, clock = self._monitor(jr, "error_rate<0.5")
+            # Window 1: all errors -> breach.
+            for _ in range(4):
+                jr.metrics.inc("requests_total", status="error")
+            clock[0] = 1.0
+            states = mon.evaluate()
+            assert mon.breached == ["error_rate<0.5"]
+            assert states["error_rate<0.5"].value == 1.0
+            # Healthy traffic arrives; the bad minute ages out of the
+            # sliding window -> recovered.
+            for _ in range(50):
+                jr.metrics.inc("requests_total", status="ok")
+            clock[0] = 12.0
+            mon.evaluate()
+            clock[0] = 13.0
+            mon.evaluate()
+            assert mon.breached == []
+        events = schema.read_events(jr.events_path)
+        kinds = [e["event"] for e in events
+                 if e["event"].startswith("slo_")]
+        assert kinds == ["slo_breach", "slo_recovered"]
+        breach = [e for e in events if e["event"] == "slo_breach"][0]
+        assert breach["objective"] == "error_rate<0.5"
+        assert breach["value"] == 1.0
+        summary = schema.event_summary(events)
+        assert summary["slo_breaches"] == 1
+        assert summary["worst_slo"] == "error_rate<0.5"
+        assert summary["slo_breached_now"] == []
+        assert not any("_schema_error" in e for e in events)
+
+    def test_latency_percentile_objective(self, tmp_path):
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            mon, clock = self._monitor(jr, "p95_latency_ms<50")
+            for _ in range(40):
+                jr.metrics.observe("request_latency_ms", 5.0)
+            clock[0] = 1.0
+            mon.evaluate()
+            assert mon.breached == []
+            for _ in range(100):
+                jr.metrics.observe("request_latency_ms", 400.0)
+            clock[0] = 2.0
+            states = mon.evaluate()
+            assert mon.breached == ["p95_latency_ms<50"]
+            assert states["p95_latency_ms<50"].value > 50.0
+
+    def test_no_evidence_is_vacuously_ok(self, tmp_path):
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            mon, clock = self._monitor(jr, "error_rate<0.01,"
+                                           "availability>0.99")
+            clock[0] = 1.0
+            mon.evaluate()
+            assert mon.breached == []
+            state = mon.state()
+            assert state["breached"] == []
+            assert all(o["value"] is None for o in state["objectives"])
+
+    def test_availability_ignores_backpressure(self, tmp_path):
+        """429s are load shedding, not unavailability: only admitted
+        requests count against the availability objective."""
+        with obs.run(tmp_path / "obs", config={}) as jr:
+            mon, clock = self._monitor(jr, "availability>0.9")
+            for _ in range(20):
+                jr.metrics.inc("requests_total", status="ok")
+            for _ in range(80):
+                jr.metrics.inc("requests_total", status="rejected")
+            clock[0] = 1.0
+            states = mon.evaluate()
+            assert mon.breached == []
+            assert states["availability>0.9"].value == 1.0

@@ -1272,9 +1272,11 @@ class TestServeBenchSelftest:
         """Tier-1 acceptance leg: dynamic batching beats sequential by the
         ISSUE floor and a hot-reload under load drops nothing."""
         out = tmp_path / "BENCH_SERVE_selftest.json"
+        trace_out = tmp_path / "BENCH_TRACE_selftest.json"
         proc = subprocess.run(
             [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
-             "--selftest", "--out", str(out)],
+             "--selftest", "--out", str(out),
+             "--traceOut", str(trace_out)],
             capture_output=True, text=True, timeout=600,
             env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
                      EEGTPU_PLATFORM="cpu"))
@@ -1287,6 +1289,13 @@ class TestServeBenchSelftest:
         assert record["swap_leg"]["failures"] == 0
         assert record["http_smoke"]["ok"] is True
         assert record["model_swaps"] >= 1
+        # ISSUE-9: tracing at 10% sampling keeps >= 0.95x the untraced
+        # rps, and one sampled request stitches router -> queue ->
+        # forward -> scatter across the two process journals.
+        trace_record = json.loads(trace_out.read_text())
+        assert trace_record["overhead_ratio"] >= 0.95
+        assert trace_record["stitched"]["ok"] is True
+        assert trace_record["stitched"]["complete_traces"] >= 1
 
 
 @pytest.mark.slow
@@ -1312,3 +1321,242 @@ class TestServeBenchFull:
         # sanity bound (measured ~2.8x at 22x257 on this host).
         assert record["bucket32_speedup"] >= 3.0
         assert record["batching_speedup"] >= 2.0
+
+
+class TestTracingServing:
+    """PR 9: request-scoped tracing through the serving path — spans land
+    in the journal, propagate over headers, and flush on anomalies."""
+
+    def _traced_app(self, tmp_path, jr, **kw):
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        return ServeApp(_checkpoint(tmp_path), port=0, buckets=(1, 4),
+                        max_wait_ms=0.0, journal=jr, **kw).start()
+
+    def _spans(self, jr, complete=True):
+        events = obs_journal.schema.read_events(jr.events_path,
+                                                complete=complete)
+        return [e for e in events if e["event"] == "span"]
+
+    def test_sampled_request_emits_full_span_chain(self, tmp_path, trials):
+        from eegnetreplication_tpu.obs import trace
+
+        x = trials[:2]
+        trace_id = trace.new_trace_id()
+        parent = trace.new_span_id()
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = self._traced_app(tmp_path, jr, trace_sample=0.0)
+            try:
+                req = urllib.request.Request(
+                    app.url + "/predict",
+                    data=json.dumps({"trials": x.tolist()}).encode(),
+                    headers={"Content-Type": "application/json",
+                             trace.TRACE_HEADER: trace_id,
+                             trace.PARENT_HEADER: parent,
+                             trace.SAMPLED_HEADER: "1"})
+                body = json.loads(
+                    urllib.request.urlopen(req, timeout=30).read())
+                assert len(body["predictions"]) == 2
+            finally:
+                app.stop()
+        spans = self._spans(jr)
+        by_name = {s["name"]: s for s in spans}
+        for name in ("replica.request", "http.parse", "queue.wait",
+                     "batch.forward", "engine.forward", "batch.scatter"):
+            assert name in by_name, (name, sorted(by_name))
+            assert by_name[name]["trace_id"] == trace_id
+        # Cross-process parentage: the replica root hangs off the span id
+        # the upstream edge sent in X-Parent-Span.
+        assert by_name["replica.request"]["parent_span_id"] == parent
+        assert by_name["http.parse"]["parent_span_id"] \
+            == by_name["replica.request"]["span_id"]
+        assert by_name["engine.forward"]["parent_span_id"] \
+            == by_name["batch.forward"]["span_id"]
+        assert by_name["engine.forward"]["bucket"] == 4
+        assert by_name["engine.forward"]["precision"] == "fp32"
+        assert by_name["batch.scatter"]["link_span"] \
+            == by_name["batch.forward"]["span_id"]
+        summary = obs_journal.schema.event_summary(
+            obs_journal.schema.read_events(jr.events_path))
+        assert summary["traces"] == 1
+        assert not any("_schema_error" in s for s in spans)
+
+    def test_unsampled_ok_request_journals_no_spans(self, tmp_path,
+                                                    trials):
+        from eegnetreplication_tpu.obs import trace
+
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = self._traced_app(tmp_path, jr, trace_sample=0.0)
+            try:
+                req = urllib.request.Request(
+                    app.url + "/predict",
+                    data=json.dumps({"trials": trials[:1].tolist()}
+                                    ).encode(),
+                    headers={"Content-Type": "application/json",
+                             trace.TRACE_HEADER: trace.new_trace_id(),
+                             trace.SAMPLED_HEADER: "0"})
+                urllib.request.urlopen(req, timeout=30).read()
+            finally:
+                app.stop()
+        assert self._spans(jr) == []
+
+    def test_unsampled_error_flushes_buffered_spans(self, tmp_path,
+                                                    trials):
+        """Anomaly tail-capture: an UNSAMPLED trace whose forward fails
+        still lands its spans in the journal."""
+        from eegnetreplication_tpu.obs import trace
+        from eegnetreplication_tpu.resil import inject
+
+        trace_id = trace.new_trace_id()
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = self._traced_app(tmp_path, jr, trace_sample=0.0)
+            try:
+                inject.arm("serve.forward", times=1, exc="ValueError",
+                           message="fatal by classification")
+                req = urllib.request.Request(
+                    app.url + "/predict",
+                    data=json.dumps({"trials": trials[:1].tolist()}
+                                    ).encode(),
+                    headers={"Content-Type": "application/json",
+                             trace.TRACE_HEADER: trace_id,
+                             trace.SAMPLED_HEADER: "0"})
+                with pytest.raises(urllib.error.HTTPError) as err:
+                    urllib.request.urlopen(req, timeout=30)
+                assert err.value.code == 500
+            finally:
+                app.stop()
+        spans = self._spans(jr)
+        assert spans, "anomalous request left no spans"
+        assert {s["trace_id"] for s in spans} == {trace_id}
+        names = {s["name"] for s in spans}
+        assert "queue.wait" in names and "batch.forward" in names
+        assert any(s.get("status") == "error" for s in spans)
+
+
+class TestPrometheusServing:
+    def test_metrics_content_negotiation(self, serve_app, trials):
+        app, jr, _ = serve_app
+        _post(app.url + "/predict", {"trials": trials[:1].tolist()})
+        # Default stays the schema-valid JSON snapshot.
+        default = json.loads(urllib.request.urlopen(
+            app.url + "/metrics", timeout=10).read())
+        obs_journal.schema.validate_metrics(default)
+        # A scraper's Accept header selects the text exposition format.
+        req = urllib.request.Request(
+            app.url + "/metrics",
+            headers={"Accept": "text/plain; version=0.0.4"})
+        resp = urllib.request.urlopen(req, timeout=10)
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = resp.read().decode()
+        assert "# TYPE requests_total counter" in text
+        assert 'requests_total{status="ok"}' in text
+        assert "request_latency_ms_bucket" in text
+        assert 'request_latency_ms_bucket{le="+Inf"}' in text
+
+    def test_registry_p95_agrees_with_journal_within_bucket(self, tmp_path,
+                                                            trials):
+        """ISSUE-9 acceptance: the live bucketed histogram's p95 and the
+        journal-derived p95 agree within one bucket width."""
+        import bisect
+
+        from eegnetreplication_tpu.obs.metrics import DEFAULT_BUCKET_BOUNDS
+        from eegnetreplication_tpu.obs.stats import percentile
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(_checkpoint(tmp_path), port=0, buckets=(1, 4),
+                           max_wait_ms=0.0, journal=jr).start()
+            try:
+                for _ in range(60):
+                    _post(app.url + "/predict",
+                          {"trials": trials[:1].tolist()})
+                registry_p95 = jr.metrics.quantile("request_latency_ms",
+                                                   0.95)
+            finally:
+                app.stop()
+        events = obs_journal.schema.read_events(jr.events_path)
+        lat = [e["latency_ms"] for e in events if e["event"] == "request"
+               and e["status"] == "ok"]
+        assert len(lat) == 60
+        journal_p95 = percentile(lat, 0.95)
+        bounds = list(DEFAULT_BUCKET_BOUNDS)
+        i = bisect.bisect_left(bounds, journal_p95)
+        lo = bounds[i - 1] if i > 0 else 0.0
+        hi = bounds[i] if i < len(bounds) else max(lat)
+        assert lo * 0.999 <= registry_p95 <= hi * 1.001, \
+            (registry_p95, journal_p95, lo, hi)
+        summary = obs_journal.schema.event_summary(events)
+        # event_summary rounds to 3 decimals; same estimator otherwise.
+        assert summary["latency_p95_ms"] == round(journal_p95, 3)
+
+
+class TestSLOServing:
+    def test_breach_degrades_healthz_and_recovers(self, tmp_path, trials):
+        """ISSUE-9 acceptance: injected serve.forward faults breach the
+        error-rate SLO (journaled, healthz degraded); once the fault
+        clears and the bad window slides out, the SLO recovers."""
+        from eegnetreplication_tpu.resil import inject
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        x = trials[:1]
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(_checkpoint(tmp_path), port=0, buckets=(1, 4),
+                           max_wait_ms=0.0, journal=jr,
+                           slo_spec="error_rate<0.5,availability>0.5",
+                           slo_window_s=0.5,
+                           slo_interval_s=0.0,  # healthz drives evaluation
+                           breaker_threshold=100).start()
+            try:
+                def get_health():
+                    try:
+                        resp = urllib.request.urlopen(
+                            app.url + "/healthz", timeout=10)
+                        return resp.status, json.loads(resp.read())
+                    except urllib.error.HTTPError as err:
+                        return err.code, json.loads(err.read())
+
+                def predict_once():
+                    try:
+                        _post(app.url + "/predict", {"trials": x.tolist()})
+                        return 200
+                    except urllib.error.HTTPError as err:
+                        err.read()
+                        return err.code
+
+                code, health = get_health()
+                assert code == 200 and health["slo"]["breached"] == []
+                # Fatal-classified faults: every predict fails.
+                inject.arm("serve.forward", times=4, exc="ValueError",
+                           message="fatal by classification")
+                assert [predict_once() for _ in range(4)] == [500] * 4
+                code, health = get_health()
+                assert code == 503
+                assert "slo:error_rate<0.5" in health["degraded"]
+                assert "slo:availability>0.5" in health["degraded"]
+                assert set(health["slo"]["breached"]) == {
+                    "error_rate<0.5", "availability>0.5"}
+                # Fault cleared: healthy traffic ages the breach out of
+                # the sliding window.
+                deadline = time.monotonic() + 10.0
+                code = None
+                while time.monotonic() < deadline:
+                    assert predict_once() == 200
+                    time.sleep(0.15)
+                    code, health = get_health()
+                    if code == 200:
+                        break
+                assert code == 200, health
+                assert health["slo"]["breached"] == []
+                assert health["latency_ms"]["p95"] is not None
+            finally:
+                app.stop()
+        events = obs_journal.schema.read_events(jr.events_path)
+        kinds = [e["event"] for e in events if e["event"].startswith("slo_")]
+        assert "slo_breach" in kinds and "slo_recovered" in kinds
+        # Every breached objective recovered before shutdown.
+        summary = obs_journal.schema.event_summary(events)
+        assert summary["slo_breached_now"] == []
+        assert summary["slo_breaches"] >= 2
+        end = [e for e in events if e["event"] == "serve_end"][0]
+        assert end["slo_breaches"] >= 2
+        assert not any("_schema_error" in e for e in events)
