@@ -98,6 +98,15 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "fleet_shadow": ("replica", "reference", "n_trials", "agree"),
     "fleet_reload": ("status", "checkpoint"),
     "fleet_end": ("n_requests", "wall_s"),
+    # Gray-failure defenses (ISSUE 10): latency-outlier ejection /
+    # half-open re-admission of a degraded replica, every hedged
+    # dispatch, and adaptive-admission decisions (AIMD limit moves +
+    # throttled shed records).
+    "replica_ejected": ("replica", "p95_ms", "fleet_p50_ms"),
+    "replica_readmitted": ("replica",),
+    "hedge": ("primary", "winner"),
+    "admission_change": ("old_limit", "new_limit", "reason"),
+    "shed": ("n_shed",),
     # Distributed tracing (obs/trace.py): one event per finished span.
     # trace_id groups spans across the per-process journals of a fleet
     # run; parent_span_id (optional: absent on roots) links the tree;
@@ -323,10 +332,14 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
                              if e.get("status") == "expired")
         out["circuit_refusals"] = sum(1 for e in requests
                                       if e.get("status") == "circuit_open")
+        # Adaptive-admission sheds are load-shedding decisions too (a
+        # 429 by policy while the hard queue still had room), not errors.
+        out["shed"] = sum(1 for e in requests
+                          if e.get("status") == "shed")
         out["request_errors"] = sum(
             1 for e in requests
             if e.get("status") not in ("ok", "rejected", "expired",
-                                       "circuit_open"))
+                                       "circuit_open", "shed"))
         out["model_swaps"] = len(swaps)
         lat = [e["latency_ms"] for e in requests
                if e.get("status") == "ok"
@@ -455,6 +468,32 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
             if agree:
                 out["fleet_shadow_agree"] = round(
                     sum(agree) / len(agree), 4)
+    # Gray-failure defenses: outlier ejections/readmissions, hedged
+    # dispatches (and how many the hedge won), and AIMD admission moves —
+    # only reported when the machinery actually acted, so other rows stay
+    # compact.
+    ejections = [e for e in events if e["event"] == "replica_ejected"]
+    readmissions = [e for e in events
+                    if e["event"] == "replica_readmitted"]
+    if ejections or readmissions:
+        out["replica_ejections"] = len(ejections)
+        out["replica_readmissions"] = len(readmissions)
+    hedge_events = [e for e in events if e["event"] == "hedge"]
+    if hedge_events:
+        out["hedges_fired"] = len(hedge_events)
+        out["hedges_won"] = sum(1 for e in hedge_events
+                                if e.get("winner") == "hedge")
+    admission_moves = [e for e in events
+                       if e["event"] == "admission_change"]
+    shed_events = [e for e in events if e["event"] == "shed"]
+    if admission_moves or shed_events:
+        out["admission_changes"] = len(admission_moves)
+        # The throttled shed records carry deltas; their sum is the
+        # journal's count of refused-by-policy requests (the request
+        # events' status="shed" tally above is the per-request view).
+        out.setdefault("shed", 0)
+        out["shed_journaled"] = sum(e.get("n_shed", 0)
+                                    for e in shed_events)
     cache_events = [e for e in events if e["event"] == "compile"
                     and e.get("cache_hit") is not None]
     if cache_events:

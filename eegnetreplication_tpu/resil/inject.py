@@ -50,7 +50,27 @@ site                  action     effect
 ``session.restore``   raise      ``OSError`` while restoring sessions at
                                  startup (transient read fault — the
                                  restore path must survive or degrade)
+``serve.degrade``     slow       BOUNDED extra latency (``slow=SECONDS``)
+                                 added to the serving forward dispatch —
+                                 the replica stays alive and correct but
+                                 drags the tail: the gray failure the
+                                 outlier ejector and hedged dispatch
+                                 exist to absorb.  ``every=N`` makes only
+                                 every Nth forward slow; ``if_tag=``
+                                 confines the fault to one tagged replica
+                                 in a multi-replica process.
+``replica.network``   truncate   the HTTP reply is cut off mid-body and
+                                 the connection closed — the
+                                 half-answered-socket shape a gray
+                                 network produces; the fleet router must
+                                 treat it as a transport failure and
+                                 fail over
 ====================  =========  ==========================================
+
+Unlike ``sleep=`` (an unbounded silent stall — the watchdog/supervisor
+shape), ``slow=`` is a *bounded per-call* delay that returns normally:
+the call succeeds, just late, which no liveness check catches — only
+latency-aware machinery does.
 
 Chaos plans (the ``--chaos`` flag) are comma-separated site specs with
 colon-separated options::
@@ -63,6 +83,7 @@ or ``--chaos @plan.json`` where the file holds a list of spec dicts.
 from __future__ import annotations
 
 import json
+import math
 import threading
 from contextlib import contextmanager
 from dataclasses import dataclass, fields
@@ -77,14 +98,26 @@ from eegnetreplication_tpu.utils.logging import logger
 # instead of silently never firing.
 SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
          "host.preempt", "train.chunk", "serve.forward", "train.hang",
-         "serve.hang", "session.snapshot", "session.restore")
+         "serve.hang", "session.snapshot", "session.restore",
+         "serve.degrade", "replica.network")
 
-ACTIONS = ("raise", "corrupt", "preempt", "sleep")
+ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate")
 
 # Default hang duration for action="sleep" when the spec sets none: long
 # enough that any sane watchdog budget expires first, short enough that a
 # plan armed without a watchdog eventually releases the process.
 DEFAULT_HANG_S = 60.0
+
+# Default bounded degradation for action="slow" when the spec sets none:
+# far above any healthy forward on every backend, far below any deadline
+# or watchdog budget — slow, not stuck.
+DEFAULT_SLOW_S = 0.25
+
+
+class ResponseTruncated(Exception):
+    """Control-flow signal raised by ``action="truncate"``: the
+    instrumented reply path catches it and sends a cut-off body over a
+    closed connection instead of the real response."""
 
 _EXC_TYPES: dict[str, type[Exception]] = {
     "RuntimeError": RuntimeError,
@@ -120,6 +153,10 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                          "injected fault: session.snapshot (hit {hit})"),
     "session.restore": ("raise", "OSError",
                         "injected fault: session.restore (hit {hit})"),
+    "serve.degrade": ("slow", None,
+                      "injected degradation: serve.degrade (hit {hit})"),
+    "replica.network": ("truncate", None,
+                        "injected truncation: replica.network (hit {hit})"),
 }
 
 
@@ -140,6 +177,9 @@ class FaultSpec:
     message: str | None = None  # may contain "{hit}"
     if_folds_over: int | None = None  # train.step: only programs > N folds
     sleep: float | None = None  # action="sleep": hang duration in seconds
+    slow: float | None = None   # action="slow": added latency in seconds
+    every: int | None = None    # fire only on every Nth due hit
+    if_tag: str | None = None   # only hits whose ctx tag= matches
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -158,15 +198,28 @@ class FaultSpec:
             raise ValueError(
                 f"after/times must be >= 0, got after={self.after} "
                 f"times={self.times}")
-        if self.sleep is not None:
+        if self.every is not None and self.every < 1:
+            raise ValueError(f"every must be >= 1, got {self.every}")
+        # Durations validate at plan-parse time with the same strictness
+        # after=/times= get: a malformed drill plan must fail before the
+        # drill starts, not minutes in when the site first fires.  NaN and
+        # inf are rejected too — a NaN sleeps 0 silently and an inf hangs
+        # forever, both of which misreport what the plan claims to do.
+        for field_name in ("sleep", "slow"):
+            value = getattr(self, field_name)
+            if value is None:
+                continue
             try:
-                self.sleep = float(self.sleep)
+                value = float(value)
             except (TypeError, ValueError):
                 raise ValueError(
-                    f"sleep must be a number of seconds, got "
-                    f"{self.sleep!r}") from None
-            if self.sleep < 0:
-                raise ValueError(f"sleep must be >= 0, got {self.sleep}")
+                    f"{field_name} must be a number of seconds, got "
+                    f"{getattr(self, field_name)!r}") from None
+            if not math.isfinite(value) or value < 0:
+                raise ValueError(
+                    f"{field_name} must be a non-negative finite number "
+                    f"of seconds, got {value}")
+            setattr(self, field_name, value)
 
 
 class ArmedFault:
@@ -239,6 +292,11 @@ def _eligible(spec: FaultSpec, ctx: dict) -> bool:
         n_folds = ctx.get("n_folds")
         if n_folds is None or int(n_folds) <= spec.if_folds_over:
             return False
+    if spec.if_tag is not None and ctx.get("tag") != spec.if_tag:
+        # Tag-gated chaos: one armed spec degrades exactly ONE tagged
+        # caller (e.g. a single replica of an in-process fleet drill)
+        # while its siblings in the same process stay healthy.
+        return False
     return True
 
 
@@ -275,6 +333,8 @@ def fire(site: str, **ctx) -> None:
             h.hits += 1
             if to_fire is not None or h.hits <= h.spec.after:
                 continue
+            if h.spec.every and (h.hits - h.spec.after - 1) % h.spec.every:
+                continue  # every=N: only every Nth post-skip hit is due
             if h.spec.times and h.fired >= h.spec.times:
                 continue
             h.fired += 1
@@ -322,6 +382,18 @@ def fire(site: str, **ctx) -> None:
 
         _time.sleep(spec.sleep if spec.sleep is not None else DEFAULT_HANG_S)
         return
+    if action == "slow":
+        # Bounded per-call degradation, NOT a hang: the call completes
+        # normally after the delay.  Nothing liveness-shaped (heartbeat,
+        # /healthz, breaker) ever notices — this is the gray-failure
+        # reproduction latency-outlier ejection and hedging are tested
+        # against.
+        import time as _time
+
+        _time.sleep(spec.slow if spec.slow is not None else DEFAULT_SLOW_S)
+        return
+    if action == "truncate":
+        raise ResponseTruncated(message)
     exc_cls = _EXC_TYPES[spec.exc or d_exc or "RuntimeError"]
     raise exc_cls(message)
 

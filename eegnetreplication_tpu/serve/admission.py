@@ -1,0 +1,178 @@
+"""Adaptive overload control: AIMD admission instead of a static cliff.
+
+Before this module the only overload defense was ``max_queue_trials`` — a
+static cliff: below it every request is admitted no matter how stale the
+queue already is (clients burn their deadlines waiting, the device burns
+forwards on answers nobody will read), above it everything bounces 429.
+Under sustained overload that converts saturation into *collapse*: almost
+nothing completes inside its deadline even though the device never
+idles.
+
+:class:`AdmissionController` turns the cliff into a brownout.  It owns a
+live **admission limit** (in queued trials) between ``min_limit`` and the
+hard ``max_limit``, adjusted by the classic AIMD rule against the one
+signal that directly measures overload — observed queue wait versus a
+latency target:
+
+- queue-wait p95 over the last ``interval_s`` window above
+  ``target_wait_ms`` → **multiplicative decrease** (``limit *=
+  backoff``): shed load now, latency is compounding;
+- comfortably below target → **additive increase** (``limit +=
+  increase``): reclaim throughput one step at a time.
+
+Every change journals an ``admission_change`` event, so the sawtooth is
+replayable from the run journal.
+
+Shedding is **two-class**: the batcher applies the adaptive limit only to
+bulk traffic (``/predict``).  Priority submitters — streaming-session
+windows, anything marked ``X-Priority`` — pass the adaptive limit
+entirely and only hit the hard ``max_limit`` cliff, so health/control and
+session traffic is never shed before bulk.  A shed raises :class:`Shed`
+(a :class:`~eegnetreplication_tpu.serve.batcher.Rejected` subtype: same
+429 to the client, distinguishable in telemetry), counts the
+``requests_shed`` metric, and journals a throttled ``shed`` event.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs.stats import percentile
+from eegnetreplication_tpu.utils.logging import logger
+
+# At most one `shed` journal event per this many seconds: under a flood
+# the journal must record that (and how much) shedding happened, not one
+# line per refused request.
+SHED_JOURNAL_INTERVAL_S = 0.25
+
+
+class AdmissionController:
+    """AIMD admitted-queue-depth limit driven by observed queue wait.
+
+    Thread-safe; wired into :class:`~eegnetreplication_tpu.serve.batcher.MicroBatcher`:
+    ``submit`` consults :meth:`admit`, the worker feeds :meth:`observe_wait`
+    at every dequeue.
+    """
+
+    def __init__(self, *, target_wait_ms: float, min_limit: int,
+                 max_limit: int, increase: int | None = None,
+                 backoff: float = 0.5, interval_s: float = 0.25,
+                 journal=None, clock=time.monotonic):
+        if target_wait_ms <= 0:
+            raise ValueError(
+                f"target_wait_ms must be > 0, got {target_wait_ms}")
+        if not 1 <= min_limit <= max_limit:
+            raise ValueError(
+                f"need 1 <= min_limit <= max_limit, got "
+                f"{min_limit}/{max_limit}")
+        if not 0.0 < backoff < 1.0:
+            raise ValueError(f"backoff must be in (0, 1), got {backoff}")
+        self.target_wait_ms = float(target_wait_ms)
+        self.min_limit = int(min_limit)
+        self.max_limit = int(max_limit)
+        # Default additive step: one min_limit (≈ one full bucket) per
+        # interval.  Conservative on purpose — the additive half of AIMD
+        # must probe BELOW the service rate's backlog equilibrium, not
+        # leap past it; a span-proportional step re-overshoots a deep
+        # queue bound every climb and turns the controller into a
+        # sawtooth between "shed everything" and "400 ms of queue".
+        self.increase = (int(increase) if increase is not None
+                         else max(1, self.min_limit))
+        self.backoff = float(backoff)
+        self.interval_s = float(interval_s)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._clock = clock
+        self._lock = threading.Lock()
+        # Optimistic start at the hard cap: the first overloaded interval
+        # backs it off; an unloaded service never sheds at all.
+        self._limit = float(self.max_limit)
+        self._waits_ms: list[float] = []
+        self._next_adjust = self._clock() + self.interval_s
+        self.n_shed = 0
+        self.n_changes = 0
+        self._last_shed_journal = 0.0
+        self._shed_since_journal = 0
+
+    @property
+    def limit(self) -> int:
+        with self._lock:
+            return int(self._limit)
+
+    # -- admission (batcher submit path) -----------------------------------
+    def admit(self, pending_trials: int, n_new: int) -> bool:
+        """Whether a BULK request of ``n_new`` trials may join a queue of
+        ``pending_trials`` under the current adaptive limit (the hard
+        ``max_limit`` cliff is the batcher's own check, applied to every
+        class)."""
+        with self._lock:
+            return pending_trials + n_new <= int(self._limit)
+
+    def record_shed(self) -> None:
+        """One bulk request refused under the adaptive limit."""
+        journal_now = None
+        with self._lock:
+            self.n_shed += 1
+            self._shed_since_journal += 1
+            now = self._clock()
+            if now - self._last_shed_journal >= SHED_JOURNAL_INTERVAL_S:
+                journal_now = (self._shed_since_journal, int(self._limit))
+                self._last_shed_journal = now
+                self._shed_since_journal = 0
+        self._journal.metrics.inc("requests_shed")
+        if journal_now is not None:
+            self._journal.event("shed", n_shed=journal_now[0],
+                                total_shed=self.n_shed,
+                                limit=journal_now[1])
+
+    # -- the AIMD loop (batcher worker path) -------------------------------
+    def observe_wait(self, wait_ms: float) -> None:
+        """One request's observed queue wait at dequeue; runs the AIMD
+        step when the interval has elapsed."""
+        adjust = None
+        with self._lock:
+            self._waits_ms.append(float(wait_ms))
+            now = self._clock()
+            if now < self._next_adjust:
+                return
+            self._next_adjust = now + self.interval_s
+            waits, self._waits_ms = self._waits_ms, []
+            p95 = percentile(waits, 0.95)
+            old = int(self._limit)
+            if p95 > self.target_wait_ms:
+                self._limit = max(float(self.min_limit),
+                                  self._limit * self.backoff)
+                reason = "backoff"
+            elif p95 < 0.5 * self.target_wait_ms \
+                    and self._limit < self.max_limit:
+                self._limit = min(float(self.max_limit),
+                                  self._limit + self.increase)
+                reason = "increase"
+            else:
+                return  # inside the comfort band: hold
+            new = int(self._limit)
+            if new == old:
+                return
+            self.n_changes += 1
+            adjust = (old, new, reason, p95)
+        old, new, reason, p95 = adjust
+        self._journal.event("admission_change", old_limit=old,
+                            new_limit=new, reason=reason,
+                            wait_p95_ms=round(p95, 3),
+                            target_wait_ms=self.target_wait_ms)
+        self._journal.metrics.set("admission_limit_trials", new)
+        log = logger.warning if reason == "backoff" else logger.info
+        log("Admission limit %s: %d -> %d trials (queue-wait p95 "
+            "%.1fms vs target %.1fms)", reason, old, new, p95,
+            self.target_wait_ms)
+
+    def snapshot(self) -> dict:
+        """The /healthz view of the controller."""
+        with self._lock:
+            return {"limit_trials": int(self._limit),
+                    "target_wait_ms": self.target_wait_ms,
+                    "min_limit": self.min_limit,
+                    "max_limit": self.max_limit,
+                    "shed": self.n_shed, "changes": self.n_changes}

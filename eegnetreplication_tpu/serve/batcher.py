@@ -55,6 +55,14 @@ class Rejected(RuntimeError):
     shutdown) — the 429-shaped signal, distinct from an inference error."""
 
 
+class Shed(Rejected):
+    """A BULK request refused under the *adaptive* admission limit
+    (:mod:`~eegnetreplication_tpu.serve.admission`) while the hard queue
+    bound still had room — the brownout signal.  Same 429 to the client
+    as :class:`Rejected`; distinct in telemetry (status ``shed``) because
+    it means "load-shedding by policy", not "queue physically full"."""
+
+
 class DeadlineExceeded(RuntimeError):
     """The request's deadline expired before its forward ran (dropped at
     dequeue) or before its response could be used — the 504-shaped
@@ -74,7 +82,8 @@ class MicroBatcher:
     def __init__(self, infer_fn: Callable[[np.ndarray], np.ndarray], *,
                  max_batch: int = 128, max_wait_ms: float = 5.0,
                  max_queue_trials: int = 512, journal=None,
-                 heartbeat: hb.Heartbeat | None = None):
+                 heartbeat: hb.Heartbeat | None = None,
+                 admission=None):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue_trials < max_batch:
@@ -87,6 +96,10 @@ class MicroBatcher:
         self.max_queue_trials = int(max_queue_trials)
         self._journal = journal if journal is not None \
             else obs_journal.current()
+        # Adaptive overload control (None = the legacy static cliff):
+        # submit consults its AIMD limit for BULK traffic, the worker
+        # feeds it every observed queue wait.
+        self.admission = admission
         # Worker liveness: beats phase "serve_idle" while polling and
         # "serve_forward" around each dispatch, so /healthz (and an
         # external watchdog via EEGTPU_HEARTBEAT_FILE) can tell a wedged
@@ -136,13 +149,18 @@ class MicroBatcher:
                                   len(self._pending))
 
     def submit(self, trials: np.ndarray,
-               deadline: float | None = None) -> Future:
+               deadline: float | None = None,
+               priority: bool = False) -> Future:
         """Enqueue ``(n, C, T)`` trials; the future resolves to their
         ``(n,)`` predictions.  Raises :class:`Rejected` when the queue is
-        full or the batcher is shut down.  ``deadline`` (a
+        full or the batcher is shut down, :class:`Shed` when the adaptive
+        admission limit refuses a bulk request.  ``deadline`` (a
         ``time.monotonic()`` instant) marks when the caller stops caring:
         a request still queued past it is dropped at dequeue with
-        :class:`DeadlineExceeded` instead of wasting a forward."""
+        :class:`DeadlineExceeded` instead of wasting a forward.
+        ``priority=True`` marks control/session traffic: it bypasses the
+        adaptive limit (never shed before bulk) and only the hard
+        ``max_queue_trials`` cliff applies."""
         x = np.asarray(trials, np.float32)
         if x.ndim == 2:
             x = x[None]
@@ -152,6 +170,7 @@ class MicroBatcher:
             fut.set_result(np.zeros(0, np.int64))
             return fut
         fut = Future()
+        shed_pending = None
         with self._cv:
             if self._closed:
                 raise Rejected("serving is shutting down")
@@ -160,11 +179,24 @@ class MicroBatcher:
                 raise Rejected(
                     f"queue full ({self._pending_trials} trials pending, "
                     f"limit {self.max_queue_trials})")
-            self._pending.append((x, fut, time.perf_counter(), deadline,
-                                  trace.current()))
-            self._pending_trials += n
-            self._gauge_depth_locked()
-            self._cv.notify_all()
+            if (self.admission is not None and not priority
+                    and not self.admission.admit(self._pending_trials, n)):
+                # Shed verdict noted here, recorded BELOW: record_shed
+                # may write a throttled journal line, and disk I/O under
+                # _cv would stall the worker + every submitter at the
+                # exact moment the service is overloaded.
+                shed_pending = self._pending_trials
+            else:
+                self._pending.append((x, fut, time.perf_counter(),
+                                      deadline, trace.current()))
+                self._pending_trials += n
+                self._gauge_depth_locked()
+                self._cv.notify_all()
+        if shed_pending is not None:
+            self.admission.record_shed()
+            raise Shed(
+                f"shed under adaptive admission ({shed_pending} trials "
+                f"pending, limit {self.admission.limit})")
         return fut
 
     def reconfigure(self, *, max_batch: int | None = None,
@@ -238,10 +270,15 @@ class MicroBatcher:
             # lands FIRST (status "expired") so the handler's anomaly
             # flush finds it already buffered.
             for fut, t_enq, ctx in expired:
+                wait_s = time.perf_counter() - t_enq
                 trace.emit_span(
-                    ctx, "queue.wait",
-                    dur_s=time.perf_counter() - t_enq,
+                    ctx, "queue.wait", dur_s=wait_s,
                     journal=self._journal, status="expired")
+                if self.admission is not None:
+                    # An expired wait is the strongest overload evidence
+                    # there is — it must feed the AIMD loop, not just the
+                    # completions that squeaked through.
+                    self.admission.observe_wait(wait_s * 1000.0)
                 if not fut.cancelled():
                     fut.set_exception(DeadlineExceeded(
                         "request deadline expired while queued; dropped "
@@ -367,6 +404,8 @@ class MicroBatcher:
                 off += k
                 self._journal.metrics.observe(
                     "queue_wait_ms", (now - t_enq) * 1000.0)
+                if self.admission is not None:
+                    self.admission.observe_wait((now - t_enq) * 1000.0)
                 # Per-request scatter span: dequeue -> result delivered,
                 # linked to the shared forward it rode.
                 trace.emit_span(

@@ -31,14 +31,21 @@ from eegnetreplication_tpu.serve.fleet.membership import (
     Replica,
     ReplicaClient,
 )
-from eegnetreplication_tpu.serve.fleet.router import FleetRouter, NoLiveReplicas
+from eegnetreplication_tpu.serve.fleet.outlier import OutlierEjector
+from eegnetreplication_tpu.serve.fleet.router import (
+    FleetRouter,
+    HedgePolicy,
+    NoLiveReplicas,
+)
 from eegnetreplication_tpu.serve.fleet.service import FleetApp
 
 __all__ = [
     "FleetApp",
     "FleetMembership",
     "FleetRouter",
+    "HedgePolicy",
     "NoLiveReplicas",
+    "OutlierEjector",
     "Replica",
     "ReplicaClient",
     "RollingReload",

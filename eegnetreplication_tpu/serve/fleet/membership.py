@@ -21,6 +21,13 @@ under a supervisor, cross-checks its heartbeat file through the shared
   automatically.
 - ``canary`` — parked out of normal rotation by the rolling-reload
   controller while it serves shadow traffic.
+- ``degraded`` — ejected by the latency-outlier detector
+  (:mod:`~eegnetreplication_tpu.serve.fleet.outlier`): alive and passing
+  every health poll, but its tail latency marks it a gray failure.  No
+  NEW dispatches (in-flight ones drain normally); after the cooldown the
+  ejector re-admits it through half-open probe dispatches.  The health
+  poller leaves this state alone (the ejector owns re-admission — a
+  healthy-looking ``/healthz`` is exactly what a gray replica shows).
 
 Every transition is journaled as a ``fleet_member`` event, so the fleet's
 membership history reads from one stream.
@@ -46,8 +53,11 @@ LIVE = "live"
 DRAINING = "draining"
 OUT = "out"
 CANARY = "canary"
+DEGRADED = "degraded"
 
-# States the router may pick a dispatch target from.
+# States the router may pick a dispatch target from.  DEGRADED is not
+# here: an ejected replica only sees traffic through the outlier
+# ejector's explicit probe slots.
 DISPATCHABLE = (LIVE,)
 
 
@@ -252,7 +262,8 @@ class FleetMembership:
         """A dispatch hit a dead connection: don't wait for the poller's
         fail_threshold — the process is gone, pull it now.  The next
         healthy poll (post-restart) rejoins it."""
-        self.set_state(replica, OUT, reason, only_from=(LIVE, DRAINING))
+        self.set_state(replica, OUT, reason,
+                       only_from=(LIVE, DRAINING, DEGRADED))
 
     # -- polling -----------------------------------------------------------
     def poll_once(self) -> None:
@@ -274,7 +285,7 @@ class FleetMembership:
             if replica.health_failures >= self.fail_threshold:
                 self.set_state(replica, OUT,
                                f"unreachable: {type(exc).__name__}",
-                               only_from=(LIVE, DRAINING, CANARY))
+                               only_from=(LIVE, DRAINING, CANARY, DEGRADED))
             return
         replica.health_failures = 0
         try:
@@ -303,8 +314,12 @@ class FleetMembership:
             breached = slo.get("breached")
             replica.slo_breached = ([str(b) for b in breached]
                                     if isinstance(breached, list) else [])
-        if replica.state == CANARY:
-            return  # the rolling-reload controller owns this transition
+        if replica.state in (CANARY, DEGRADED):
+            # The rolling-reload controller owns CANARY; the outlier
+            # ejector owns DEGRADED — a gray replica passes this very
+            # health poll, so re-LIVE-ing it here would undo the
+            # ejection every poll_s.
+            return
         # The heartbeat verdict is computed FIRST and gates the rejoin:
         # checking it only after re-LIVE-ing a healthy-healthz replica
         # would flap live <-> draining every poll while the worker stays

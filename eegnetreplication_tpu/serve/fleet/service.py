@@ -42,9 +42,11 @@ from eegnetreplication_tpu.resil import preempt, supervise
 from eegnetreplication_tpu.serve.service import JsonRequestHandler
 from eegnetreplication_tpu.serve.fleet import membership as ms
 from eegnetreplication_tpu.serve.fleet.canary import RollingReload
+from eegnetreplication_tpu.serve.fleet.outlier import OutlierEjector
 from eegnetreplication_tpu.serve.fleet.router import (
     AllReplicasBusy,
     FleetRouter,
+    HedgePolicy,
     NoLiveReplicas,
 )
 from eegnetreplication_tpu.utils.logging import logger
@@ -75,6 +77,8 @@ class FleetApp:
                  poll_s: float = 0.25, predict_timeout_s: float = 60.0,
                  shadow_n: int = 16, agree_floor: float = 0.0,
                  trace_sample: float = trace.DEFAULT_SAMPLE_RATE,
+                 outlier_k: float = 0.0, outlier_cooldown_s: float = 5.0,
+                 hedge_budget: float = 0.0,
                  on_checkpoint_change=None, journal=None):
         self.journal = journal if journal is not None \
             else obs_journal.current()
@@ -87,9 +91,17 @@ class FleetApp:
         self._on_checkpoint_change = on_checkpoint_change
         self.membership = ms.FleetMembership(replicas, poll_s=poll_s,
                                              journal=self.journal)
+        # Gray-failure defenses (both opt-in, 0 = off): the latency-
+        # outlier ejector and the hedged-dispatch policy.
+        self.outlier = (OutlierEjector(
+            self.membership, k=outlier_k, cooldown_s=outlier_cooldown_s,
+            journal=self.journal) if outlier_k and outlier_k > 0 else None)
+        hedge = (HedgePolicy(budget_fraction=hedge_budget)
+                 if hedge_budget and hedge_budget > 0 else None)
         self.router = FleetRouter(self.membership,
                                   predict_timeout_s=predict_timeout_s,
-                                  journal=self.journal)
+                                  journal=self.journal,
+                                  outlier=self.outlier, hedge=hedge)
         self.shadow_n = int(shadow_n)
         self.agree_floor = float(agree_floor)
         # The router is the TRACE EDGE: the head-based sampling decision
@@ -161,9 +173,16 @@ class FleetApp:
                                handler_timeout_s)
             counts = dict(self._counts)
         self.membership.close()
+        self.router.close()
         self.journal.event(
             "fleet_end", n_requests=sum(counts.values()), **counts,
             failovers=self.router.n_failovers,
+            hedges_fired=self.router.n_hedges,
+            hedges_won=self.router.n_hedge_wins,
+            replica_ejections=(self.outlier.n_ejected
+                               if self.outlier else 0),
+            replica_readmissions=(self.outlier.n_readmitted
+                                  if self.outlier else 0),
             wall_s=round(time.perf_counter() - self._t_start, 3))
         logger.info("Fleet stopped: %s (%d failovers)", counts,
                     self.router.n_failovers)
@@ -258,6 +277,13 @@ class _FleetHandler(JsonRequestHandler):
                 "serving_digests": digests,
                 "slo": {"replicas_breached": slo_breached,
                         "any_breached": bool(slo_breached)},
+                # Gray-failure defenses: the ejector's per-replica rolling
+                # latency view + who is currently degraded, and how often
+                # hedged dispatch fired/won (null/zero when disabled).
+                "outlier": (app.outlier.snapshot()
+                            if app.outlier is not None else None),
+                "hedges": {"fired": app.router.n_hedges,
+                           "won": app.router.n_hedge_wins},
                 "replicas": snapshot})
             return
         if self.path == "/metrics":
@@ -304,6 +330,11 @@ class _FleetHandler(JsonRequestHandler):
         passthrough = {}
         if self.headers.get("X-Deadline-Ms"):
             passthrough["X-Deadline-Ms"] = self.headers["X-Deadline-Ms"]
+        if self.headers.get("X-Priority"):
+            # Two-class admission rides through the fleet: without this
+            # a control-class client behind the router would be shed as
+            # bulk by the replica's adaptive limit.
+            passthrough["X-Priority"] = self.headers["X-Priority"]
         try:
             status, data, replica_id = app.router.dispatch(
                 body, content_type, headers=passthrough)
@@ -378,6 +409,7 @@ def update_child_checkpoints(sup: supervise.MultiSupervisor,
 def spawn_replica_fleet(checkpoint: str, n: int, *, run_dir: Path,
                         host: str = "127.0.0.1",
                         serve_args: list[str] | None = None,
+                        per_replica_args: dict[str, list[str]] | None = None,
                         policy: supervise.SupervisorPolicy | None = None,
                         journal=None) -> tuple[supervise.MultiSupervisor,
                                                list[ms.Replica]]:
@@ -400,6 +432,9 @@ def spawn_replica_fleet(checkpoint: str, n: int, *, run_dir: Path,
                "--port", str(port),
                "--metricsDir", str(run_dir / "replica_obs")]
         cmd += list(serve_args or [])
+        # Per-replica extras (keyed by child name): how a gray drill arms
+        # --chaos on exactly one member while its siblings stay clean.
+        cmd += list((per_replica_args or {}).get(f"r{i}", []))
         specs.append(supervise.ChildSpec(name=f"r{i}", cmd=cmd,
                                          heartbeat_file=hb_file))
         urls.append(f"http://{host}:{port}")
@@ -442,6 +477,25 @@ def main(argv=None) -> int:
     parser.add_argument("--maxWaitMs", type=float, default=5.0)
     parser.add_argument("--maxQueue", type=int, default=512)
     parser.add_argument("--buckets", default=None)
+    parser.add_argument("--outlierK", type=float, default=0.0,
+                        help="Latency-outlier ejection: eject a replica "
+                             "whose rolling p95 exceeds K x the fleet "
+                             "median latency (0 = off).  Ejected "
+                             "replicas drain, cool down, and re-admit "
+                             "through half-open probe dispatches.")
+    parser.add_argument("--outlierCooldownS", type=float, default=5.0,
+                        help="Cooldown before an ejected replica gets "
+                             "its first re-admission probe.")
+    parser.add_argument("--hedgeBudget", type=float, default=0.0,
+                        help="Hedged dispatch: after a p95-derived "
+                             "delay, fire one speculative attempt at a "
+                             "sibling, first response wins.  The value "
+                             "is the HARD cap on extra dispatches as a "
+                             "fraction of total (e.g. 0.05; 0 = off).")
+    parser.add_argument("--admissionTargetMs", type=float, default=0.0,
+                        help="Forwarded to every replica: adaptive AIMD "
+                             "admission targeting this queue-wait "
+                             "(0 = static queue cliff).")
     parser.add_argument("--traceSample", type=float,
                         default=trace.DEFAULT_SAMPLE_RATE,
                         help="Head-based trace sampling rate at the "
@@ -485,6 +539,8 @@ def main(argv=None) -> int:
         serve_args += ["--buckets", args.buckets]
     if args.slo:
         serve_args += ["--slo", args.slo]
+    if args.admissionTargetMs > 0:
+        serve_args += ["--admissionTargetMs", str(args.admissionTargetMs)]
     with obs_journal.run(metrics_dir, config=vars(args),
                          role="fleet") as journal, preempt.guard():
         sup, replicas = spawn_replica_fleet(
@@ -497,6 +553,9 @@ def main(argv=None) -> int:
                        port=args.port, poll_s=args.pollS,
                        shadow_n=args.shadowN, agree_floor=args.agreeFloor,
                        trace_sample=args.traceSample,
+                       outlier_k=args.outlierK,
+                       outlier_cooldown_s=args.outlierCooldownS,
+                       hedge_budget=args.hedgeBudget,
                        on_checkpoint_change=lambda ck:
                        update_child_checkpoints(sup, ck),
                        journal=journal)

@@ -83,10 +83,12 @@ from eegnetreplication_tpu.resil import heartbeat as hb
 from eegnetreplication_tpu.resil import inject, preempt
 from eegnetreplication_tpu.resil import retry as resil_retry
 from eegnetreplication_tpu.resil.breaker import CircuitBreaker
+from eegnetreplication_tpu.serve.admission import AdmissionController
 from eegnetreplication_tpu.serve.batcher import (
     DeadlineExceeded,
     MicroBatcher,
     Rejected,
+    Shed,
 )
 from eegnetreplication_tpu.serve.engine import (
     CLASS_NAMES,
@@ -116,7 +118,7 @@ SERVE_WATCHDOG_THRESHOLDS = {"serve_idle": 10.0, "serve_forward": 60.0}
 
 
 def make_infer_fn(registry: ModelRegistry, breaker: CircuitBreaker | None
-                  = None):
+                  = None, chaos_tag: str | None = None):
     """The batcher's inference callable: chaos site + retry + registry,
     with dispatch outcomes fed to the circuit ``breaker`` (when given).
 
@@ -125,9 +127,15 @@ def make_infer_fn(registry: ModelRegistry, breaker: CircuitBreaker | None
     backoff are the shared ``resil.retry`` policy.  The breaker sees the
     POST-retry outcome: a transient blip the retry absorbed is a success,
     only an exhausted budget counts against the circuit.
+
+    ``serve.degrade`` fires alongside (default action ``slow=`` — a
+    bounded, non-raising delay): the gray-replica reproduction.  It
+    carries ``chaos_tag`` so an ``if_tag=`` spec degrades exactly one
+    tagged replica of an in-process fleet drill.
     """
     def dispatch(x: np.ndarray) -> np.ndarray:
         inject.fire("serve.forward", n_trials=len(x))
+        inject.fire("serve.degrade", n_trials=len(x), tag=chaos_tag)
         return registry.infer(x)
 
     def infer_fn(x: np.ndarray) -> np.ndarray:
@@ -172,7 +180,9 @@ class ServeApp:
                  trace_sample: float = trace.DEFAULT_SAMPLE_RATE,
                  slo_spec: str | None = None,
                  slo_window_s: float = obs_slo.DEFAULT_WINDOW_S,
-                 slo_interval_s: float = 1.0):
+                 slo_interval_s: float = 1.0,
+                 admission_target_ms: float = 0.0,
+                 chaos_tag: str | None = None):
         self.journal = journal if journal is not None \
             else obs_journal.current()
         self.checkpoint = str(checkpoint)
@@ -209,11 +219,27 @@ class ServeApp:
             failure_threshold=breaker_threshold,
             reset_after_s=breaker_reset_s, site="serve.forward",
             journal=self.journal)
+        # The chaos tag names THIS replica at the serve.degrade /
+        # replica.network injection sites, so one armed if_tag= spec can
+        # gray exactly one member of an in-process fleet drill.
+        self.chaos_tag = chaos_tag
+        # Adaptive overload control (opt-in: target 0 keeps the legacy
+        # static cliff): AIMD admission between one full bucket and the
+        # hard queue bound, driven by observed queue wait.
+        resolved_max_batch = (max_batch if max_batch is not None
+                              else buckets[-1])
+        self.admission = (AdmissionController(
+            target_wait_ms=admission_target_ms,
+            min_limit=min(resolved_max_batch, max_queue_trials),
+            max_limit=max_queue_trials, journal=self.journal)
+            if admission_target_ms and admission_target_ms > 0 else None)
         self.batcher = MicroBatcher(
-            make_infer_fn(self.registry, self.breaker),
-            max_batch=max_batch if max_batch is not None else buckets[-1],
+            make_infer_fn(self.registry, self.breaker,
+                          chaos_tag=chaos_tag),
+            max_batch=resolved_max_batch,
             max_wait_ms=max_wait_ms, max_queue_trials=max_queue_trials,
-            journal=self.journal, heartbeat=self.heartbeat)
+            journal=self.journal, heartbeat=self.heartbeat,
+            admission=self.admission)
         # Ladder self-tuning: observe bucket occupancy + arrival rate,
         # retune the compile ladder off the hot path.  Opt-in (0 = off):
         # the autonomous loop only makes sense for long-lived servers.
@@ -239,6 +265,7 @@ class ServeApp:
         self._stats_lock = threading.Lock()
         self._n_requests = 0
         self._n_rejected = 0
+        self._n_shed = 0
         self._n_errors = 0
         self._n_expired = 0
         self._n_circuit_open = 0
@@ -299,6 +326,8 @@ class ServeApp:
             trace_sample=self.trace_sample,
             slo=([o.name for o in self.slo.objectives]
                  if self.slo is not None else None),
+            admission_target_ms=(self.admission.target_wait_ms
+                                 if self.admission else None),
             quant_agreement=(round(gate.agreement, 6) if gate else None),
             ladder_tuning=self.tuner is not None,
             sessions_dir=(str(self.sessions_dir)
@@ -343,6 +372,7 @@ class ServeApp:
             n_req, n_rej, n_err = (self._n_requests, self._n_rejected,
                                    self._n_errors)
             n_exp, n_open = self._n_expired, self._n_circuit_open
+            n_shed = self._n_shed
             n_sess, n_win, n_wexp = (self._n_sessions_opened,
                                      self._n_session_windows,
                                      self._n_windows_expired)
@@ -355,6 +385,9 @@ class ServeApp:
         self.sessions.snapshot()
         self.sessions.detach()
         self.journal.event("serve_end", n_requests=n_req, rejected=n_rej,
+                           shed=n_shed,
+                           admission_changes=(self.admission.n_changes
+                                              if self.admission else 0),
                            errors=n_err, expired=n_exp,
                            circuit_open=n_open,
                            breaker_trips=self.breaker.trips,
@@ -391,6 +424,8 @@ class ServeApp:
             self._n_requests += 1
             if status == "rejected":
                 self._n_rejected += 1
+            elif status == "shed":
+                self._n_shed += 1
             elif status == "expired":
                 self._n_expired += 1
             elif status == "circuit_open":
@@ -427,7 +462,10 @@ class ServeApp:
             deadline = (None if session.deadline_ms is None
                         else time.monotonic() + session.deadline_ms / 1000.0)
             try:
-                fut = self.batcher.submit(win[None], deadline=deadline)
+                # Session windows are priority-class: a live BCI stream's
+                # decisions must never be shed before bulk /predict.
+                fut = self.batcher.submit(win[None], deadline=deadline,
+                                          priority=True)
             except Rejected:
                 fut = None
             submitted.append((index, start, t0, deadline, fut))
@@ -533,6 +571,25 @@ class _ServeHandler(JsonRequestHandler):
 
     app: ServeApp = None  # bound by ServeApp.start()
 
+    def _reply_bytes(self, code: int, body: bytes,
+                     content_type: str = "application/json") -> None:
+        """Every reply probes the ``replica.network`` chaos site: a
+        ``truncate`` firing sends a cut-off body over a closed connection
+        (headers claim the full length) — the half-answered-socket shape
+        of a gray network, which a fleet router must fail over."""
+        try:
+            inject.fire("replica.network", status=code, n_bytes=len(body),
+                        tag=self.app.chaos_tag if self.app else None)
+        except inject.ResponseTruncated:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body[: len(body) // 2])
+            self.close_connection = True
+            return
+        super()._reply_bytes(code, body, content_type)
+
     def _parse_trials(self, body: bytes) -> np.ndarray:
         """Trials from a JSON object or raw ``.npz`` bytes (the native
         ``-trials.npz`` layout: ``X`` holds the (n, C, T) array)."""
@@ -611,6 +668,10 @@ class _ServeHandler(JsonRequestHandler):
                 "ladder_retunes": app.ladder_retunes,
                 "queue_depth_trials": app.batcher.queue_depth,
                 "queue_depth_requests": app.batcher.queue_depth_requests,
+                # Adaptive overload control (null when running the legacy
+                # static queue cliff): the live AIMD limit + shed count.
+                "admission": (app.admission.snapshot()
+                              if app.admission is not None else None),
                 "model_swaps": app.registry.swaps})
             return
         if self.path == "/metrics":
@@ -714,8 +775,14 @@ class _ServeHandler(JsonRequestHandler):
                 return
             deadline = (None if deadline_ms is None
                         else time.monotonic() + deadline_ms / 1000.0)
+            # Two-class admission: control/priority traffic (marked by
+            # the caller) bypasses the adaptive limit, so under a
+            # brownout bulk /predict sheds first.
+            priority = (self.headers.get("X-Priority") or "").lower() \
+                in ("high", "control", "session")
             try:
-                fut = app.batcher.submit(x, deadline=deadline)
+                fut = app.batcher.submit(x, deadline=deadline,
+                                         priority=priority)
                 # Once enqueued, probe reconciliation moves to the
                 # future's own resolution (not this handler): if the
                 # request is shed before any forward runs — expired at
@@ -735,6 +802,15 @@ class _ServeHandler(JsonRequestHandler):
                                    "expired")
                 self._reply(504, {"error": str(exc),
                                   "deadline_ms": deadline_ms})
+                return
+            except Shed as exc:
+                # The adaptive limit refused it while the hard queue
+                # still had room: same 429 wire response, its own
+                # telemetry status (a policy decision, not a full queue).
+                app.record_request(len(x),
+                                   (time.perf_counter() - t0) * 1000.0,
+                                   "shed")
+                self._reply(429, {"error": str(exc), "shed": True})
                 return
             except Rejected as exc:
                 app.record_request(len(x),
@@ -1003,6 +1079,23 @@ def main(argv=None) -> int:
                              "(0 = off, 1 = every request).  Errors, "
                              "expired deadlines, and circuit refusals "
                              "always flush their buffered spans.")
+    parser.add_argument("--admissionTargetMs", type=float, default=0.0,
+                        help="Adaptive overload control: AIMD the "
+                             "admitted queue depth so queue-wait p95 "
+                             "tracks this target (0 = legacy static "
+                             "queue cliff).  Bulk /predict sheds first "
+                             "(429); X-Priority/session traffic only "
+                             "hits the hard --maxQueue bound.")
+    parser.add_argument("--chaos", type=str, default=None,
+                        help="Fault-injection plan armed for this "
+                             "serving process (same syntax as train "
+                             "--chaos), e.g. "
+                             "'serve.degrade:slow=0.25:times=0' to make "
+                             "this replica a reproducible gray failure.")
+    parser.add_argument("--chaosTag", type=str, default=None,
+                        help="Tag carried to the serve.degrade/"
+                             "replica.network sites so an if_tag= spec "
+                             "targets exactly this replica.")
     parser.add_argument("--slo", type=str, default=None,
                         help="Declarative SLO spec evaluated over a "
                              "sliding window of live metrics, e.g. "
@@ -1054,6 +1147,15 @@ def main(argv=None) -> int:
         except ValueError as exc:
             parser.error(f"--slo: {exc}")
 
+    chaos_specs = []
+    if args.chaos:
+        try:
+            # Parse-time strictness: a malformed drill plan (bad site,
+            # non-finite slow=/sleep=) fails HERE, not mid-drill.
+            chaos_specs = inject.parse_plan(args.chaos)
+        except (ValueError, OSError) as exc:
+            parser.error(f"--chaos: {exc}")
+
     from eegnetreplication_tpu.config import Paths
 
     metrics_dir = (Path(args.metricsDir) if args.metricsDir
@@ -1061,7 +1163,7 @@ def main(argv=None) -> int:
     sessions_dir = (Path(args.sessionsDir) if args.sessionsDir
                     else Paths.from_here().checkpoints / "serve_sessions")
     with obs_journal.run(metrics_dir, config=vars(args)) as journal, \
-            preempt.guard():
+            preempt.guard(), inject.scoped(*chaos_specs):
         app = ServeApp(args.checkpoint, host=args.host, port=args.port,
                        buckets=buckets, max_wait_ms=args.maxWaitMs,
                        max_queue_trials=args.maxQueue,
@@ -1075,7 +1177,9 @@ def main(argv=None) -> int:
                        tune_every_s=args.tuneEveryS,
                        trace_sample=args.traceSample,
                        slo_spec=args.slo,
-                       slo_window_s=args.sloWindowS)
+                       slo_window_s=args.sloWindowS,
+                       admission_target_ms=args.admissionTargetMs,
+                       chaos_tag=args.chaosTag)
         app.start()
         print(f"serving at {app.url}", flush=True)
         serve_until_preempted(app)

@@ -352,6 +352,72 @@ def leg_session_resume(root: Path) -> None:
             "fault_injected"} <= kinds, kinds
 
 
+def leg_gray(root: Path) -> None:
+    """The gray-failure drill (ISSUE 10): one replica of an in-process
+    fleet is degraded through the tag-gated ``serve.degrade`` site (alive,
+    correct, 20x slow — every liveness signal stays green), the latency-
+    outlier detector ejects it (``replica_ejected`` journaled, membership
+    state ``degraded``), the fault lifts, and half-open probe dispatches
+    re-admit it (``replica_readmitted``) — the observation->mitigation->
+    recovery loop proven end to end from the journal alone."""
+    import time
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    import serve_bench
+
+    from eegnetreplication_tpu.serve.fleet import membership as fleet_ms
+
+    leg_root = root / "gray"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    ckpt = serve_bench.make_synthetic_checkpoint(leg_root, 4, 64)
+    trials = np.random.RandomState(0).randn(16, 4, 64).astype(np.float32)
+    bodies = serve_bench._npz_bodies(trials, 2)
+    with obs.run(root / "obs" / "gray") as jr:
+        apps, replicas, membership, ejector, router = \
+            serve_bench.build_gray_fleet(
+                ckpt, (1, 8), 3, jr,
+                outlier_kw={"min_samples": 6, "cooldown_s": 0.5})
+        victim = replicas[1]
+        try:
+            # Warm the dispatch path + hedge window, then degrade r1.
+            serve_bench.run_gray_load(router, bodies, 120, submitters=6)
+            with inject.scoped(inject.FaultSpec(
+                    site="serve.degrade", times=0, slow=0.2,
+                    if_tag="g1")):
+                deadline = time.monotonic() + 60.0
+                while ejector.n_ejected == 0 \
+                        and time.monotonic() < deadline:
+                    serve_bench.run_gray_load(router, bodies, 60,
+                                              submitters=6)
+                assert ejector.n_ejected >= 1, "slow replica not ejected"
+                assert victim.state == fleet_ms.DEGRADED, victim.state
+                # Ejected != dead: /healthz still answers 200 — exactly
+                # why the liveness poller alone could never catch this.
+                membership.poll_once()
+                assert victim.state == fleet_ms.DEGRADED, \
+                    "health poll re-admitted a gray replica"
+            # Fault lifted: probes must re-admit it.
+            assert serve_bench._wait_replica_state(
+                membership, router, bodies, victim.replica_id, "live",
+                timeout_s=30.0), "ejected replica never readmitted"
+        finally:
+            membership.close()
+            router.close()
+            for app in apps:
+                app.stop()
+    events = _events(jr)
+    kinds = [e["event"] for e in events]
+    assert "replica_ejected" in kinds and "replica_readmitted" in kinds, (
+        set(kinds))
+    assert kinds.index("replica_ejected") \
+        < len(kinds) - 1 - kinds[::-1].index("replica_readmitted")
+    member = [e for e in events if e["event"] == "fleet_member"
+              and e["replica"] == victim.replica_id]
+    states = [e["state"] for e in member]
+    assert "degraded" in states and states[-1] == "live", states
+
+
 def leg_combined(root: Path) -> None:
     """The acceptance drill: checkpoint.write corruption + train.step
     device fault + host.preempt on a 2-subject protocol; preempted mid-run,
@@ -410,6 +476,7 @@ LEGS = {
     "fetch.download": leg_fetch_download,
     "supervisor.hang": leg_supervisor_hang,
     "session.resume": leg_session_resume,
+    "gray": leg_gray,
     "combined": leg_combined,
 }
 

@@ -118,6 +118,11 @@ _COLUMNS = (
     # dead/failing replicas, and the last rolling reload's outcome.
     ("fleet_replicas", "fleet"), ("fleet_failovers", "failovers"),
     ("fleet_reload_status", "fleet_reload"),
+    # Gray-failure defenses (ISSUE 10): latency-outlier ejections,
+    # hedged dispatches fired/won, and requests shed by adaptive
+    # admission — the columns a gray drill run renders under.
+    ("replica_ejections", "ejects"), ("hedges_fired", "hedges"),
+    ("hedges_won", "hedge_wins"), ("shed", "shed"),
     # Tracing + SLOs: how many sampled/anomaly-flushed traces the stream
     # holds (stitch them with scripts/trace_report.py) and the worst SLO
     # breach the run journaled (blank when every objective held).

@@ -52,6 +52,7 @@ class FakeReplica:
         self.queue_depth = 0
         self.degraded: list[str] = []        # non-empty -> healthz 503
         self.predict_status = 200
+        self.predict_delay = 0.0             # gray knob: slow, not dead
         self.predictions = [0, 1, 2]         # served to every /predict
         # reload_fn(checkpoint) -> (status, digest-or-error)
         self.reload_fn = lambda ck: (200, "d-new")
@@ -101,6 +102,8 @@ class FakeReplica:
                 fake.log.append((self.path, body))
                 if self.path == "/predict":
                     fake.headers_log.append(dict(self.headers.items()))
+                    if fake.predict_delay:
+                        time.sleep(fake.predict_delay)
                     if fake.predict_status != 200:
                         self._reply(fake.predict_status,
                                     {"error": "scripted"})

@@ -1,0 +1,564 @@
+"""Gray-failure resilience (ISSUE 10).
+
+Covers the defense layer against replicas that are slow-yet-alive and
+overload that used to be a static cliff: the extended injection registry
+(bounded ``slow=`` degradation, ``every=``/``if_tag=`` predicates,
+response truncation), the latency-outlier ejection policy (median/k
+math, cooldown -> half-open probe -> readmit, max-ejection-fraction
+guard, drain-not-drop), hedged dispatch with its hard budget, and the
+AIMD admission controller with two-class shedding.
+
+Policy/state-machine tests run against scriptable fakes (no JAX, no
+subprocesses); the end-to-end truth — real engines under real degraded
+load — is ``serve_bench.py --gray --selftest`` (the last test here).
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.resil import inject
+from eegnetreplication_tpu.serve.admission import AdmissionController
+from eegnetreplication_tpu.serve.batcher import MicroBatcher, Rejected, Shed
+from eegnetreplication_tpu.serve.fleet import membership as ms
+from eegnetreplication_tpu.serve.fleet.outlier import OutlierEjector
+from eegnetreplication_tpu.serve.fleet.router import FleetRouter, HedgePolicy
+from test_fleet import FakeReplica
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def journal(tmp_path):
+    with obs_journal.run(tmp_path / "obs", config={}) as jr:
+        yield jr
+
+
+def _events(jr, kind):
+    return [e for e in schema.read_events(jr.events_path, complete=False)
+            if e["event"] == kind]
+
+
+# ---------------------------------------------------------------------------
+# Injection-registry extensions (the deterministic gray reproduction).
+
+
+class TestInjectGray:
+    def test_slow_action_is_bounded_latency_not_an_exception(self):
+        with inject.scoped(inject.FaultSpec(site="serve.degrade", times=0,
+                                            slow=0.05)):
+            t0 = time.perf_counter()
+            inject.fire("serve.degrade", tag=None)  # returns normally
+            assert time.perf_counter() - t0 >= 0.045
+
+    def test_if_tag_confines_the_fault_to_one_tagged_caller(self):
+        with inject.scoped(inject.FaultSpec(site="serve.degrade", times=0,
+                                            slow=0.05, if_tag="g1")):
+            t0 = time.perf_counter()
+            inject.fire("serve.degrade", tag="g0")
+            inject.fire("serve.degrade", tag=None)
+            assert time.perf_counter() - t0 < 0.04  # neither fired
+            t0 = time.perf_counter()
+            inject.fire("serve.degrade", tag="g1")
+            assert time.perf_counter() - t0 >= 0.045
+
+    def test_every_n_fires_periodically(self):
+        fired = []
+        with inject.scoped(inject.FaultSpec(site="serve.degrade", times=0,
+                                            every=3, action="raise",
+                                            exc="ValueError")):
+            for i in range(1, 10):
+                try:
+                    inject.fire("serve.degrade", tag=None)
+                except ValueError:
+                    fired.append(i)
+        assert fired == [1, 4, 7]
+
+    def test_truncate_action_raises_the_control_signal(self):
+        with inject.scoped(inject.FaultSpec(site="replica.network",
+                                            times=1)):
+            with pytest.raises(inject.ResponseTruncated):
+                inject.fire("replica.network")
+            inject.fire("replica.network")  # times=1: spent
+
+    @pytest.mark.parametrize("spec", [
+        "serve.degrade:slow=-1", "serve.degrade:slow=inf",
+        "serve.degrade:slow=nan", "serve.degrade:slow=oops",
+        "train.hang:sleep=-0.5", "train.hang:sleep=nan",
+        "serve.degrade:every=0",
+    ])
+    def test_malformed_durations_fail_at_plan_parse_time(self, spec):
+        with pytest.raises(ValueError):
+            inject.parse_plan(spec)
+
+    def test_plan_file_validates_slow_too(self, tmp_path):
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps(
+            [{"site": "serve.degrade", "slow": float("inf")}]))
+        # json.dumps writes Infinity (non-strict); the parse must reject
+        # the value, not smuggle it through to fire time.
+        with pytest.raises(ValueError):
+            inject.parse_plan(f"@{plan}")
+        plan.write_text(json.dumps(
+            [{"site": "serve.degrade", "slow": 0.25, "if_tag": "g1",
+              "times": 0}]))
+        specs = inject.parse_plan(f"@{plan}")
+        assert specs[0].slow == 0.25 and specs[0].if_tag == "g1"
+
+
+# ---------------------------------------------------------------------------
+# Latency-outlier ejection policy (no HTTP: latencies fed directly).
+
+
+def _member_fleet(n, journal, **kw):
+    """Replicas with unused URLs (policy tests never dispatch)."""
+    replicas = [ms.Replica(f"r{i}", f"http://127.0.0.1:{9000 + i}",
+                           journal=journal) for i in range(n)]
+    membership = ms.FleetMembership(replicas, journal=journal)
+    for r in replicas:
+        r.state = ms.LIVE
+    ejector = OutlierEjector(membership, journal=journal, **kw)
+    return replicas, membership, ejector
+
+
+def _feed(ejector, replica, latencies):
+    for lat in latencies:
+        ejector.observe(replica, lat)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestOutlierPolicy:
+    def test_slow_replica_ejected_on_k_times_fleet_median(self, journal):
+        replicas, _, ejector = _member_fleet(
+            3, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            check_interval_s=0.0)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[2], [12.0] * 8)
+        _feed(ejector, replicas[1], [100.0] * 8)  # p95 100 > 3x median 11
+        assert replicas[1].state == ms.DEGRADED
+        assert ejector.n_ejected == 1
+        ev = _events(journal, "replica_ejected")
+        assert len(ev) == 1
+        assert ev[0]["replica"] == "r1"
+        assert ev[0]["p95_ms"] == pytest.approx(100.0)
+        assert ev[0]["fleet_p50_ms"] == pytest.approx(12.0)
+        # Degraded replicas leave dispatch rotation entirely.
+        assert [r.replica_id for r in
+                ejector.membership.dispatchable()] == ["r0", "r2"]
+
+    def test_under_k_stays_live_and_median_resists_the_outlier(self,
+                                                               journal):
+        # The threshold is k x the median of per-replica MEDIANS: the
+        # slow replica's own latencies cannot drag the fleet baseline up.
+        replicas, _, ejector = _member_fleet(
+            3, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            check_interval_s=0.0)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[2], [10.0] * 8)
+        _feed(ejector, replicas[1], [25.0] * 8)  # 2.5x: not an outlier
+        assert all(r.state == ms.LIVE for r in replicas)
+        assert ejector.n_ejected == 0
+
+    def test_floor_ms_suppresses_microsecond_noise(self, journal):
+        # p95 3x the median but under the absolute floor: all-fast fleets
+        # with scheduler jitter must not eject anybody.
+        replicas, _, ejector = _member_fleet(
+            2, journal, k=3.0, min_samples=4, floor_ms=5.0,
+            check_interval_s=0.0)
+        _feed(ejector, replicas[0], [0.5] * 8)
+        _feed(ejector, replicas[1], [4.0] * 8)
+        assert all(r.state == ms.LIVE for r in replicas)
+
+    def test_max_eject_fraction_guard_never_evicts_past_the_cap(self,
+                                                                journal):
+        replicas, _, ejector = _member_fleet(
+            4, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            max_eject_fraction=0.25, check_interval_s=0.0)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[3], [10.0] * 8)
+        _feed(ejector, replicas[1], [200.0] * 8)
+        assert replicas[1].state == ms.DEGRADED  # 1/4 <= 0.25: allowed
+        _feed(ejector, replicas[2], [300.0] * 8)
+        assert replicas[2].state == ms.LIVE      # 2/4 > 0.25: refused
+        assert ejector.n_ejected == 1
+
+    def test_cooldown_probe_readmit_cycle(self, journal):
+        clock = FakeClock()
+        replicas, _, ejector = _member_fleet(
+            3, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            cooldown_s=5.0, check_interval_s=0.0, clock=clock)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[2], [10.0] * 8)
+        _feed(ejector, replicas[1], [100.0] * 8)
+        assert replicas[1].state == ms.DEGRADED
+        # Inside the cooldown: no probe slots.
+        assert ejector.claim_probe(set()) is None
+        clock.t += 5.1
+        probe = ejector.claim_probe(set())
+        assert probe is replicas[1]
+        # Only one probe slot per half-open window.
+        assert ejector.claim_probe(set()) is None
+        # Probe latency back under the ejection threshold: readmitted.
+        ejector.observe(replicas[1], 12.0)
+        assert replicas[1].state == ms.LIVE
+        assert ejector.n_readmitted == 1
+        ev = _events(journal, "replica_readmitted")
+        assert len(ev) == 1 and ev[0]["replica"] == "r1"
+
+    def test_slow_probe_restarts_the_cooldown(self, journal):
+        clock = FakeClock()
+        replicas, _, ejector = _member_fleet(
+            3, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            cooldown_s=5.0, check_interval_s=0.0, clock=clock)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[2], [10.0] * 8)
+        _feed(ejector, replicas[1], [100.0] * 8)
+        clock.t += 5.1
+        assert ejector.claim_probe(set()) is replicas[1]
+        ejector.observe(replicas[1], 90.0)  # still way over threshold
+        assert replicas[1].state == ms.DEGRADED
+        assert ejector.claim_probe(set()) is None  # cooldown restarted
+        clock.t += 5.1
+        assert ejector.claim_probe(set()) is replicas[1]
+        ejector.observe(replicas[1], 11.0)
+        assert replicas[1].state == ms.LIVE
+        assert _events(journal, "replica_readmitted")
+
+    def test_pre_ejection_straggler_cannot_short_circuit_readmission(
+            self, journal):
+        # An in-flight request from before the ejection that completes
+        # FAST must not re-admit the replica without a cooldown+probe —
+        # whether it drains out inside the cooldown or after it elapsed
+        # (only a CLAIMED probe's latency may judge re-admission).
+        clock = FakeClock()
+        replicas, _, ejector = _member_fleet(
+            3, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            cooldown_s=5.0, check_interval_s=0.0, clock=clock)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[2], [10.0] * 8)
+        _feed(ejector, replicas[1], [100.0] * 8)
+        assert replicas[1].state == ms.DEGRADED
+        ejector.observe(replicas[1], 2.0)  # fast straggler drains out
+        assert replicas[1].state == ms.DEGRADED
+        clock.t += 5.1                     # cooldown elapsed, no probe yet
+        ejector.observe(replicas[1], 2.0)  # late fast straggler
+        assert replicas[1].state == ms.DEGRADED
+        ejector.observe(replicas[1], 400.0)  # late SLOW straggler must
+        assert ejector.claim_probe(set()) is replicas[1]  # not re-cooldown
+        ejector.observe(replicas[1], 9.0)  # the claimed probe decides
+        assert replicas[1].state == ms.LIVE
+        assert ejector.n_readmitted == 1
+
+    def test_event_summary_reports_gray_fields(self, journal):
+        replicas, _, ejector = _member_fleet(
+            3, journal, k=3.0, min_samples=4, floor_ms=1.0,
+            cooldown_s=0.0, check_interval_s=0.0)
+        _feed(ejector, replicas[0], [10.0] * 8)
+        _feed(ejector, replicas[2], [10.0] * 8)
+        _feed(ejector, replicas[1], [100.0] * 8)
+        assert ejector.claim_probe(set()) is replicas[1]
+        ejector.observe(replicas[1], 10.0)
+        events = schema.read_events(journal.events_path, complete=False)
+        summary = schema.event_summary(events)
+        assert summary["replica_ejections"] == 1
+        assert summary["replica_readmissions"] == 1
+        assert not any("_schema_error" in e for e in events)
+
+
+# ---------------------------------------------------------------------------
+# Ejection drains; it never drops.
+
+
+class TestEjectionDrain:
+    def test_in_flight_requests_on_an_ejected_replica_complete(self,
+                                                               journal):
+        slow, fast = FakeReplica(), FakeReplica()
+        slow.predict_delay = 0.4
+        try:
+            replicas = [ms.Replica("r0", slow.url, journal=journal),
+                        ms.Replica("r1", fast.url, journal=journal)]
+            membership = ms.FleetMembership(replicas, journal=journal)
+            router = FleetRouter(membership, journal=journal)
+            membership.poll_once()
+            fast.queue_depth = 50  # force the slow one to be chosen
+            membership.poll_once()
+            result = {}
+
+            def dispatch():
+                result["outcome"] = router.dispatch(b"{}")
+
+            th = threading.Thread(target=dispatch, daemon=True)
+            th.start()
+            time.sleep(0.1)  # the request is in flight on r0
+            assert replicas[0].inflight == 1
+            # Eject mid-flight (the exact transition the ejector makes).
+            assert membership.set_state(replicas[0], ms.DEGRADED,
+                                        "latency_outlier",
+                                        only_from=(ms.LIVE,))
+            th.join(timeout=5.0)
+            assert not th.is_alive()
+            status, _, replica_id = result["outcome"]
+            # Drained, not dropped: the in-flight request completed on
+            # the replica it was already running on.
+            assert status == 200 and replica_id == "r0"
+            assert replicas[0].state == ms.DEGRADED
+            assert replicas[0].inflight == 0
+        finally:
+            slow.stop()
+            fast.stop()
+
+
+# ---------------------------------------------------------------------------
+# Hedged dispatch.
+
+
+class TestHedging:
+    def _warm_window(self, router, n=24):
+        for _ in range(n):
+            status, _, _ = router.dispatch(b"{}")
+            assert status == 200
+
+    def _fleet(self, fakes, journal, hedge):
+        replicas = [ms.Replica(f"r{i}", fake.url, journal=journal)
+                    for i, fake in enumerate(fakes)]
+        membership = ms.FleetMembership(replicas, journal=journal)
+        router = FleetRouter(membership, journal=journal, hedge=hedge)
+        membership.poll_once()
+        return replicas, membership, router
+
+    def test_slow_primary_hedges_to_sibling_and_hedge_wins(self, journal):
+        slow, fast = FakeReplica(), FakeReplica()
+        try:
+            _, membership, router = self._fleet(
+                [slow, fast], journal,
+                HedgePolicy(quantile=0.9, budget_fraction=0.5,
+                            min_samples=8, max_delay_ms=50.0))
+            self._warm_window(router, 12)
+            slow.predict_delay = 0.5
+            slow.queue_depth, fast.queue_depth = 0, 10  # prefer slow
+            membership.poll_once()
+            t0 = time.perf_counter()
+            status, _, replica_id = router.dispatch(b"{}")
+            elapsed = time.perf_counter() - t0
+            assert status == 200
+            assert replica_id == "r1"          # the hedge answered
+            assert elapsed < 0.4               # did NOT wait out the 0.5s
+            assert router.n_hedges == 1
+            assert router.n_hedge_wins == 1
+            ev = _events(journal, "hedge")
+            assert len(ev) == 1
+            assert ev[0]["primary"] == "r0" and ev[0]["hedge"] == "r1"
+            assert ev[0]["winner"] == "hedge"
+        finally:
+            slow.stop()
+            fast.stop()
+
+    def test_fast_primary_never_hedges(self, journal):
+        a, b = FakeReplica(), FakeReplica()
+        try:
+            _, _, router = self._fleet(
+                [a, b], journal,
+                HedgePolicy(budget_fraction=0.5, min_samples=8,
+                            min_delay_ms=200.0, max_delay_ms=400.0))
+            self._warm_window(router, 30)
+            assert router.n_hedges == 0
+            assert _events(journal, "hedge") == []
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_hard_budget_caps_extra_dispatches(self, journal):
+        slow, fast = FakeReplica(), FakeReplica()
+        try:
+            _, membership, router = self._fleet(
+                [slow, fast], journal,
+                HedgePolicy(quantile=0.9, budget_fraction=0.05,
+                            min_samples=8, max_delay_ms=30.0))
+            self._warm_window(router, 20)
+            slow.predict_delay = 0.15
+            slow.queue_depth, fast.queue_depth = 0, 10
+            membership.poll_once()
+            for _ in range(10):
+                status, _, _ = router.dispatch(b"{}")
+                assert status == 200
+            # 30 dispatches at 5%: exactly one hedge may ever fire; the
+            # other nine slow requests wait the primary out.
+            assert router.n_hedges == 1
+            assert router.n_hedges <= 0.05 * router.n_dispatched + 1
+            assert len(_events(journal, "hedge")) == 1
+        finally:
+            slow.stop()
+            fast.stop()
+
+    def test_no_hedging_below_min_samples(self, journal):
+        slow, fast = FakeReplica(), FakeReplica()
+        slow.predict_delay = 0.2
+        try:
+            _, _, router = self._fleet(
+                [slow, fast], journal,
+                HedgePolicy(budget_fraction=0.5, min_samples=50,
+                            max_delay_ms=10.0))
+            fast.queue_depth = 10
+            router.membership.poll_once()
+            status, _, _ = router.dispatch(b"{}")
+            assert status == 200
+            assert router.n_hedges == 0  # window too cold to define slow
+        finally:
+            slow.stop()
+            fast.stop()
+
+
+# ---------------------------------------------------------------------------
+# Adaptive AIMD admission + two-class shedding.
+
+
+class TestAdmission:
+    def test_aimd_backoff_and_additive_increase(self, journal):
+        clock = FakeClock()
+        ctl = AdmissionController(target_wait_ms=10.0, min_limit=8,
+                                  max_limit=128, increase=16,
+                                  interval_s=1.0, journal=journal,
+                                  clock=clock)
+        assert ctl.limit == 128  # optimistic start
+        for _ in range(5):
+            ctl.observe_wait(50.0)
+        clock.t += 1.1
+        ctl.observe_wait(50.0)   # interval elapsed: p95 50 > 10 -> halve
+        assert ctl.limit == 64
+        clock.t += 1.1
+        ctl.observe_wait(60.0)
+        assert ctl.limit == 32
+        # Quiet traffic: additive increase, one step per interval.
+        for _ in range(3):
+            clock.t += 1.1
+            ctl.observe_wait(1.0)
+        assert ctl.limit == 32 + 3 * 16
+        moves = _events(journal, "admission_change")
+        assert [m["reason"] for m in moves] == \
+            ["backoff", "backoff", "increase", "increase", "increase"]
+        assert all(m["target_wait_ms"] == 10.0 for m in moves)
+
+    def test_limit_floors_at_min_and_caps_at_max(self, journal):
+        clock = FakeClock()
+        ctl = AdmissionController(target_wait_ms=10.0, min_limit=8,
+                                  max_limit=32, increase=64,
+                                  interval_s=1.0, journal=journal,
+                                  clock=clock)
+        for _ in range(8):
+            clock.t += 1.1
+            ctl.observe_wait(100.0)
+        assert ctl.limit == 8
+        clock.t += 1.1
+        ctl.observe_wait(0.5)
+        assert ctl.limit == 32  # one big step, clamped to max
+
+    def test_shed_journal_is_throttled_but_counts_every_shed(self,
+                                                             journal):
+        clock = FakeClock()
+        ctl = AdmissionController(target_wait_ms=10.0, min_limit=8,
+                                  max_limit=32, journal=journal,
+                                  clock=clock)
+        for _ in range(100):
+            ctl.record_shed()
+        clock.t += 1.0
+        ctl.record_shed()
+        assert ctl.n_shed == 101
+        sheds = _events(journal, "shed")
+        assert len(sheds) == 2  # first + one throttled flush
+        assert sum(e["n_shed"] for e in sheds) == 101
+
+    def test_bulk_sheds_first_priority_only_hits_the_hard_cliff(
+            self, journal):
+        release = threading.Event()
+        started = threading.Event()
+
+        def blocking_infer(x):
+            started.set()
+            release.wait(10.0)
+            return np.zeros(len(x), np.int64)
+
+        ctl = AdmissionController(target_wait_ms=10.0, min_limit=4,
+                                  max_limit=16, journal=journal,
+                                  clock=FakeClock())
+        batcher = MicroBatcher(blocking_infer, max_batch=1,
+                               max_wait_ms=0.0, max_queue_trials=64,
+                               journal=journal, admission=ctl)
+        try:
+            one = np.zeros((1, 2, 4), np.float32)
+            batcher.submit(one)         # dequeued by the blocked worker
+            started.wait(5.0)
+            futs = [batcher.submit(one) for _ in range(16)]  # at limit
+            with pytest.raises(Shed):
+                batcher.submit(one)     # bulk #17: shed by policy
+            assert ctl.n_shed == 1
+            # Priority traffic sails past the adaptive limit...
+            pfuts = [batcher.submit(one, priority=True)
+                     for _ in range(16)]
+            # ...and only the HARD queue bound stops it.
+            extra = [batcher.submit(one, priority=True)
+                     for _ in range(64 - 32)]
+            with pytest.raises(Rejected) as exc_info:
+                batcher.submit(one, priority=True)
+            assert not isinstance(exc_info.value, Shed)
+            release.set()
+            for fut in futs + pfuts + extra:
+                fut.result(timeout=10.0)
+        finally:
+            release.set()
+            batcher.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# The end-to-end acceptance: real engines, real degraded load.
+
+
+class TestGrayBenchSelftest:
+    def test_gray_selftest_passes(self, tmp_path):
+        """ISSUE-10 acceptance: (a) one replica degraded to >= 20x
+        forward latency is ejected while hedging holds open-loop p99
+        within 2x the healthy baseline at zero failures, and is
+        readmitted once the fault lifts (journaled in order); (b) at 2x
+        saturation, AIMD admission keeps on-time goodput >= 70% of peak
+        while the static cliff collapses, shedding bulk before priority
+        traffic every time."""
+        out = tmp_path / "BENCH_GRAY_selftest.json"
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
+             "--gray", "--selftest", "--grayOut", str(out),
+             "--workDir", str(tmp_path / "work")],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu"))
+        assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-2000:]
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads(out.read_text())
+        slow = record["slow_replica_leg"]
+        assert slow["gray"]["failures"] == 0
+        assert slow["degrade_factor"] >= 20.0
+        assert slow["p99_ratio"] <= 2.0
+        assert slow["ejections"] >= 1
+        assert slow["victim_readmitted"] is True
+        assert slow["hedge_fraction"] <= 0.05
+        over = record["overload_leg"]
+        assert over["adaptive_goodput_frac"] >= 0.7
+        assert over["adaptive"]["shed_priority"] == 0
+        assert over["adaptive"]["shed_bulk"] > 0
+        assert record["journal"]["ejected_before_readmitted"] is True
+        assert math.isfinite(over["static_goodput_frac"])
