@@ -66,6 +66,16 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     # and every LadderTuner bucket-ladder/coalescing-window retune.
     "quant_gate": ("precision", "outcome", "agreement", "floor"),
     "ladder_retune": ("old_buckets", "new_buckets", "reason"),
+    # Multi-tenant zoo (serve/registry.ModelZoo + serve/zoo.py): engine
+    # materialization / LRU eviction under the compiled-program budget,
+    # every rebuild+swap of the stacked one-program engine, and the
+    # per-tenant stacked-vs-unstacked argmax equivalence verdict that
+    # gates it (refuse -> per-model fallback).
+    "model_load": ("model", "digest"),
+    "model_evict": ("model", "reason"),
+    "zoo_restack": ("n_tenants", "outcome", "reason"),
+    "stack_gate": ("precision", "outcome", "agreement", "floor",
+                   "n_tenants"),
     # Streaming sessions (serve/sessions/): one stream's lifecycle, every
     # window decision, the durable snapshot/restore pair, and the
     # graceful-degradation record of a window that missed its deadline.
@@ -365,6 +375,32 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
     if gates:
         out["quant_gate"] = gates[-1].get("outcome")
         out["quant_agreement"] = gates[-1].get("agreement")
+    # Multi-tenant zoo: tenant count (from the serve_start advert, else
+    # the distinct models loaded), load/evict churn, restack outcomes,
+    # and the last stacked-gate verdict — only reported for zoo streams
+    # so single-model rows stay compact.
+    loads = [e for e in events if e["event"] == "model_load"]
+    evicts = [e for e in events if e["event"] == "model_evict"]
+    restacks = [e for e in events if e["event"] == "zoo_restack"]
+    serve_tenants = [e.get("tenants") for e in events
+                     if e["event"] == "serve_start"
+                     and isinstance(e.get("tenants"), list)]
+    if loads or evicts or restacks or serve_tenants:
+        if serve_tenants:
+            out["tenants"] = len(serve_tenants[-1])
+        elif restacks and isinstance(restacks[-1].get("n_tenants"), int):
+            out["tenants"] = restacks[-1]["n_tenants"]
+        else:
+            out["tenants"] = len({e["model"] for e in loads})
+        out["model_loads"] = len(loads)
+        out["model_evictions"] = len(evicts)
+        if restacks:
+            out["zoo_restacks"] = len(restacks)
+            out["zoo_restack_outcome"] = restacks[-1].get("outcome")
+    stack_gates = [e for e in events if e["event"] == "stack_gate"]
+    if stack_gates:
+        out["stack_gate"] = stack_gates[-1].get("outcome")
+        out["stack_agreement"] = stack_gates[-1].get("agreement")
     # Streaming sessions: stream counts, per-window tail latency,
     # deadline misses, and snapshot/resume activity — only reported for
     # streams that actually served sessions.

@@ -62,7 +62,8 @@ def is_qleaf(node: Any) -> bool:
             and getattr(node["q"], "dtype", None) == np.int8)
 
 
-def quantize_tensor(w: np.ndarray, axis: int = -1) -> dict[str, np.ndarray]:
+def quantize_tensor(w: np.ndarray, axis: int = -1, *,
+                    tenant_axis: int | None = None) -> dict[str, np.ndarray]:
     """Per-channel symmetric int8 quantization of one weight tensor.
 
     ``axis`` names the output-channel axis (last for every flax conv/dense
@@ -70,10 +71,18 @@ def quantize_tensor(w: np.ndarray, axis: int = -1) -> dict[str, np.ndarray]:
     single large filter cannot crush the resolution of the others.  An
     all-zero channel keeps scale 1.0 (its q is all-zero anyway — avoids a
     0/0 at dequantization).
+
+    ``tenant_axis`` (for trees stacked along a leading tenant axis by
+    ``ops/stacked.py``) keeps that axis un-reduced too, yielding
+    per-tenant-per-channel scales: each tenant's channels calibrate
+    against that tenant's own amax, so stacking nine models quantizes
+    exactly as nine separate quantizations would.
     """
     w = np.asarray(w, np.float32)
-    axis = axis % w.ndim
-    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    keep = {axis % w.ndim}
+    if tenant_axis is not None:
+        keep.add(tenant_axis % w.ndim)
+    reduce_axes = tuple(i for i in range(w.ndim) if i not in keep)
     amax = np.max(np.abs(w), axis=reduce_axes, keepdims=True)
     scale = np.where(amax > 0, amax / QMAX, 1.0).astype(np.float32)
     q = np.clip(np.rint(w / scale), -QMAX, QMAX).astype(np.int8)
@@ -87,12 +96,21 @@ def dequantize_tensor(qleaf: Mapping[str, Any]):
     return jnp.asarray(qleaf["q"], jnp.float32) * jnp.asarray(qleaf["scale"])
 
 
-def quantize_params(params: Any) -> dict:
+def quantize_params(params: Any, *, stacked: bool = False) -> dict:
     """The params tree with every ``kernel`` leaf replaced by a quantized
-    node; all other leaves pass through as fp32 numpy arrays."""
+    node; all other leaves pass through as fp32 numpy arrays.
+
+    ``stacked=True`` treats every kernel's leading axis as the tenant
+    axis of a :func:`~eegnetreplication_tpu.ops.stacked.stack_trees`
+    result and quantizes per-tenant-per-channel (see
+    :func:`quantize_tensor`) — the int8 form of the one-program
+    multi-tenant forward.
+    """
+    tenant_axis = 0 if stacked else None
+
     def walk(node):
         if hasattr(node, "items"):
-            return {k: (quantize_tensor(v)
+            return {k: (quantize_tensor(v, tenant_axis=tenant_axis)
                         if k == QUANTIZED_LEAF and hasattr(v, "shape")
                         else walk(v))
                     for k, v in node.items()}

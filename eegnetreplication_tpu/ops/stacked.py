@@ -1,0 +1,171 @@
+"""Tenant-stacked parameter trees: one compiled forward for N models.
+
+The paper's within-subject protocol trains NINE per-subject EEGNets per
+run — same architecture, different weights.  Serving them as nine engines
+multiplies everything that makes serving expensive by nine: nine compiled
+bucket ladders, nine warmups, and (worst) up to nine device dispatches per
+coalesced batch, because a mixed-tenant batch has to split per model.
+
+Because the models share one architecture, their param trees are
+*congruent*: every leaf has the same shape and dtype, so the N trees stack
+into ONE tree with a leading ``tenant`` axis (:func:`stack_trees` — the
+param-tree stacking shape from the sharding exemplars in SNIPPETS.md
+[1]/[2], pointed at batching-over-models instead of devices).  The
+stacked forward then serves a *mixed-tenant* batch in one program:
+
+1. **gather** — each trial's tenant index selects its row from every
+   stacked leaf (``leaf[tenant_idx]``: a (B, ...) per-trial param tree);
+2. **forward** — ``jax.vmap`` maps the existing single-model eval forward
+   over (per-trial params, per-trial trial) pairs — the same fused jnp
+   program the single-tenant engine runs, traced once;
+3. the caller scatters predictions back per request (the batcher already
+   does this row-wise).
+
+The compiled-program count is therefore constant in the number of
+tenants: one executable per bucket, whether the stack holds one model or
+nine.  EEGNet's parameter tree is tiny (tens of KB), so the per-trial
+gather is noise next to the dispatch overhead it eliminates.
+
+The int8 variant stacks the quantized tree instead:
+``quantize_params(stacked, stacked=True)`` calibrates scales
+per-tenant-per-channel (each tenant's amax, not the stack's), and the
+gather indexes ``{q, scale}`` leaves exactly like fp32 ones — so a
+stacked int8 tenant is numerically the same quantization it would get
+alone, which is what lets the per-tenant equivalence gate
+(``serve/zoo.py``) compare it against the unstacked fp32 reference.
+
+Pallas is deliberately OFF inside the vmapped forward (same rationale as
+the scanned trainers — see ``training/steps.eval_forward``): the jnp twin
+of the fused block is what XLA batches cleanly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+
+def tree_leaves_with_paths(tree: Any, prefix: str = "") -> list[tuple[str, Any]]:
+    """``[(path, leaf)]`` in sorted-key order (mapping nodes only — the
+    checkpoint trees are plain nested dicts by the time serving sees
+    them)."""
+    if hasattr(tree, "items"):
+        out: list[tuple[str, Any]] = []
+        for k in sorted(tree, key=str):
+            out.extend(tree_leaves_with_paths(
+                tree[k], f"{prefix}/{k}" if prefix else str(k)))
+        return out
+    return [(prefix, tree)]
+
+
+def congruent(trees: list[Any]) -> tuple[bool, str]:
+    """Whether every tree has the same structure, leaf shapes, and dtypes
+    (the stackability test); returns ``(ok, reason)``."""
+    if not trees:
+        return False, "no trees"
+    ref = tree_leaves_with_paths(trees[0])
+    ref_sig = [(p, np.asarray(v).shape, np.asarray(v).dtype) for p, v in ref]
+    for i, tree in enumerate(trees[1:], 1):
+        sig = [(p, np.asarray(v).shape, np.asarray(v).dtype)
+               for p, v in tree_leaves_with_paths(tree)]
+        if sig != ref_sig:
+            got = {p for p, _, _ in sig}
+            want = {p for p, _, _ in ref_sig}
+            if got != want:
+                return False, (f"tree {i} structure differs "
+                               f"(missing {sorted(want - got)[:3]}, "
+                               f"extra {sorted(got - want)[:3]})")
+            for (p, s, d), (_, rs, rd) in zip(sig, ref_sig):
+                if (s, d) != (rs, rd):
+                    return False, (f"tree {i} leaf {p}: {s}/{d} vs "
+                                   f"reference {rs}/{rd}")
+            return False, f"tree {i} differs from reference"
+    return True, "ok"
+
+
+def stack_trees(trees: list[Any]) -> dict:
+    """Stack N congruent param/batch-stats trees along a new leading
+    ``tenant`` axis: every leaf becomes ``(N, *leaf.shape)``.
+
+    Raises ``ValueError`` on incongruent trees — a zoo mixing
+    architectures must fall back to per-model engines, never stack
+    silently wrong.
+    """
+    ok, reason = congruent(trees)
+    if not ok:
+        raise ValueError(f"param trees are not stackable: {reason}")
+
+    def walk(nodes):
+        first = nodes[0]
+        if hasattr(first, "items"):
+            return {k: walk([n[k] for n in nodes]) for k in first}
+        return np.stack([np.asarray(n) for n in nodes])
+
+    return walk(list(trees))
+
+
+def tenant_slice(stacked: Any, z: int) -> dict:
+    """Tenant ``z``'s tree back out of a stacked one (a view per leaf) —
+    the inverse :func:`stack_trees` tests pin, and what a restack reuses
+    for tenants whose weights did not change."""
+    def walk(node):
+        if hasattr(node, "items"):
+            return {k: walk(v) for k, v in node.items()}
+        return np.asarray(node)[z]
+
+    return walk(stacked)
+
+
+def gather_tree(stacked: Any, tenant_idx):
+    """Per-trial param tree: every leaf indexed by the ``(B,)`` tenant
+    vector (jnp, jit-safe) — step 1 of the one-program forward."""
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.asarray(leaf)[tenant_idx], stacked)
+
+
+def stacked_eval_forward(model, stacked_params, stacked_batch_stats,
+                         x, tenant_idx):
+    """Eval-mode logits for a mixed-tenant batch, one program.
+
+    ``x`` is ``(B, C, T)``; ``tenant_idx`` is ``(B,)`` int32 rows into
+    the stack.  Gather + vmap over the existing single-model
+    ``eval_forward`` (jnp twin; Pallas off under vmap).  Trials of the
+    same tenant produce exactly the logits the unstacked forward yields —
+    the property the serving gate verifies per tenant before the stacked
+    engine may answer requests.
+    """
+    import jax
+
+    from eegnetreplication_tpu.training.steps import eval_forward
+
+    params_b = gather_tree(stacked_params, tenant_idx)
+    stats_b = gather_tree(stacked_batch_stats, tenant_idx)
+
+    def one(p, bs, xi):
+        return eval_forward(model, p, bs, xi[None], allow_pallas=False)[0]
+
+    return jax.vmap(one)(params_b, stats_b, x)
+
+
+def stacked_quantized_eval_forward(model, stacked_qparams,
+                                   stacked_batch_stats, x, tenant_idx):
+    """The int8 twin of :func:`stacked_eval_forward`: gathers the stacked
+    quantized tree (``{q, scale}`` leaves index per-tenant like any
+    other) and vmaps the existing quantized forward — specialized EEGNet
+    schedule included — so the in-program dequantize sees each trial's
+    own tenant's scales."""
+    import jax
+
+    from eegnetreplication_tpu.ops.quant import quantized_eval_forward
+
+    qparams_b = gather_tree(stacked_qparams, tenant_idx)
+    stats_b = gather_tree(stacked_batch_stats, tenant_idx)
+
+    def one(qp, bs, xi):
+        return quantized_eval_forward(model, qp, bs, xi[None])[0]
+
+    return jax.vmap(one)(qparams_b, stats_b, x)

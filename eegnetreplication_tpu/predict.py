@@ -98,9 +98,19 @@ def main(argv=None) -> int:
     select_platform()
     parser = argparse.ArgumentParser(
         description="Classify EEG trials with a trained checkpoint.")
-    parser.add_argument("--checkpoint", required=True,
+    parser.add_argument("--checkpoint", default=None,
                         help=".npz (native), an Orbax checkpoint directory, "
-                             "or .pth (reference format).")
+                             "or .pth (reference format).  Required unless "
+                             "--zoo is given.")
+    parser.add_argument("--zoo", default=None,
+                        help="Model-zoo spec ('id=path,...' pairs or a "
+                             "checkpoint directory) — the SAME addressing "
+                             "the serve --zoo flag uses, so a CLI --model "
+                             "and a served X-Model resolve identically.")
+    parser.add_argument("--model", default=None,
+                        help="Model id to resolve through --zoo (a tenant "
+                             "id, a variables-digest prefix, or 'default' "
+                             "= the zoo's first entry).")
     src = parser.add_mutually_exclusive_group(required=True)
     src.add_argument("--input", help="A -trials.npz file to classify.")
     src.add_argument("--subject", type=int,
@@ -117,7 +127,52 @@ def main(argv=None) -> int:
                              "server.")
     args = parser.parse_args(argv)
 
-    model, params, batch_stats = load_model_from_checkpoint(args.checkpoint)
+    if bool(args.checkpoint) == bool(args.zoo):
+        parser.error("exactly one of --checkpoint or --zoo is required")
+    if args.model and not args.zoo:
+        parser.error("--model requires --zoo (it names a zoo tenant)")
+
+    checkpoint = args.checkpoint
+    if args.zoo:
+        # The server's exact addressing path (serve/zoo.py): parse the
+        # same spec, resolve the same id/digest rules, THEN load the one
+        # checkpoint this prediction needs.  Digest-prefix addressing
+        # digests each tenant's checkpoint until the prefix resolves.
+        from eegnetreplication_tpu.serve.engine import variables_digest
+        from eegnetreplication_tpu.serve.zoo import (
+            looks_like_digest,
+            parse_zoo_spec,
+            resolve_model_id,
+        )
+
+        try:
+            mapping = parse_zoo_spec(args.zoo)
+        except ValueError as exc:
+            parser.error(f"--zoo: {exc}")
+        digests: dict[str, str] = {}
+        loaded: dict[str, tuple] = {}
+        if args.model and str(args.model) not in mapping \
+                and looks_like_digest(str(args.model)):
+            # Only a genuine digest-prefix spec pays the per-tenant
+            # digest loads; an exact tenant id resolves without them.
+            for mid, path in mapping.items():
+                loaded[mid] = load_model_from_checkpoint(path)
+                digests[mid] = variables_digest(loaded[mid][1],
+                                                loaded[mid][2])
+        try:
+            model_id = resolve_model_id(list(mapping), args.model,
+                                        next(iter(mapping)), digests)
+        except KeyError as exc:
+            parser.error(f"--model: {exc.args[0]}")
+        checkpoint = mapping[model_id]
+        logger.info("Zoo model %s -> %s", model_id, checkpoint)
+        if model_id in loaded:   # digest addressing already parsed it
+            model, params, batch_stats = loaded[model_id]
+        else:
+            model, params, batch_stats = \
+                load_model_from_checkpoint(checkpoint)
+    else:
+        model, params, batch_stats = load_model_from_checkpoint(checkpoint)
     if args.input:
         from eegnetreplication_tpu.data.io import load_trials
 

@@ -30,6 +30,18 @@ The worker runs in the submitting thread's :mod:`contextvars` context
 ``serve.forward`` fault-injection/retry instrumentation wrapped around
 ``infer_fn`` by the service — journal into the serving run exactly as
 they would on the main thread.
+
+Multi-tenant batching (``tenant_aware=True``): every request carries a
+tenant index (its model in the zoo), the queue splits per tenant, and
+coalescing dequeues **weighted-fair** — one request per pending tenant
+per round-robin cycle until the batch fills — so one hot tenant's
+backlog cannot starve a cold tenant's lone request: a just-arrived
+request is dispatched no later than the very next batch, regardless of
+how deep any sibling queue is (the starvation bound the regression test
+pins).  The coalesced batch MIXES tenants; ``infer_fn(trials, tenants)``
+receives the per-trial tenant vector and the stacked zoo engine serves
+it in one program.  With a single tenant the dequeue order degenerates
+to exactly the old FIFO+greedy behavior.
 """
 
 from __future__ import annotations
@@ -83,7 +95,7 @@ class MicroBatcher:
                  max_batch: int = 128, max_wait_ms: float = 5.0,
                  max_queue_trials: int = 512, journal=None,
                  heartbeat: hb.Heartbeat | None = None,
-                 admission=None):
+                 admission=None, tenant_aware: bool = False):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         if max_queue_trials < max_batch:
@@ -91,6 +103,12 @@ class MicroBatcher:
                 f"max_queue_trials ({max_queue_trials}) must be >= "
                 f"max_batch ({max_batch})")
         self._infer_fn = infer_fn
+        # tenant_aware: submit() accepts a per-request tenant index, the
+        # dequeue is weighted-fair across tenants, and infer_fn is called
+        # as infer_fn(trials, tenants) with the per-trial tenant vector
+        # (the model zoo's stacked forward).  Off (default): the legacy
+        # single-model infer_fn(trials) contract.
+        self.tenant_aware = bool(tenant_aware)
         self.max_batch = int(max_batch)
         self.max_wait_s = float(max_wait_ms) / 1000.0
         self.max_queue_trials = int(max_queue_trials)
@@ -107,13 +125,17 @@ class MicroBatcher:
         self.heartbeat = heartbeat if heartbeat is not None else hb.emitter()
         self._cv = threading.Condition()
         # Entries: (trials, future, t_enqueued, deadline-or-None, trace
-        # ctx-or-None) where the deadline is a time.monotonic() instant.
-        # The trace context is captured at submit so the worker can emit
-        # queue-wait/forward/scatter spans under the REQUEST's trace even
-        # though it runs in its own (construction-time) contextvars.
-        self._pending: deque[
+        # ctx-or-None, tenant) where the deadline is a time.monotonic()
+        # instant.  The trace context is captured at submit so the worker
+        # can emit queue-wait/forward/scatter spans under the REQUEST's
+        # trace even though it runs in its own (construction-time)
+        # contextvars.  One FIFO per tenant; ``_rr`` is the persistent
+        # round-robin ring the weighted-fair dequeue walks (single-tenant
+        # traffic degenerates to one FIFO — the legacy order).
+        self._queues: dict[int, deque[
             tuple[np.ndarray, Future, float, float | None,
-                  trace.TraceContext | None]] = deque()
+                  trace.TraceContext | None, int]]] = {}
+        self._rr: deque[int] = deque()
         self._pending_trials = 0
         self._closed = False
         # Run the worker inside a copy of the constructing thread's
@@ -137,7 +159,13 @@ class MicroBatcher:
         """Requests currently enqueued (not yet handed to the worker) —
         the fleet router's least-loaded dispatch signal."""
         with self._cv:
-            return len(self._pending)
+            return self._pending_requests_locked()
+
+    def _pending_requests_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _has_pending_locked(self) -> bool:
+        return any(self._queues.values())
 
     def _gauge_depth_locked(self) -> None:
         """Publish both queue-depth gauges (``self._cv`` held).  Every
@@ -146,11 +174,11 @@ class MicroBatcher:
         per-batch ``bucket_fill`` occupancy."""
         self._journal.metrics.set("queue_depth_trials", self._pending_trials)
         self._journal.metrics.set("queue_depth_requests",
-                                  len(self._pending))
+                                  self._pending_requests_locked())
 
     def submit(self, trials: np.ndarray,
                deadline: float | None = None,
-               priority: bool = False) -> Future:
+               priority: bool = False, tenant: int = 0) -> Future:
         """Enqueue ``(n, C, T)`` trials; the future resolves to their
         ``(n,)`` predictions.  Raises :class:`Rejected` when the queue is
         full or the batcher is shut down, :class:`Shed` when the adaptive
@@ -160,10 +188,19 @@ class MicroBatcher:
         :class:`DeadlineExceeded` instead of wasting a forward.
         ``priority=True`` marks control/session traffic: it bypasses the
         adaptive limit (never shed before bulk) and only the hard
-        ``max_queue_trials`` cliff applies."""
+        ``max_queue_trials`` cliff applies.  ``tenant`` indexes the
+        request's model in a multi-tenant zoo (``tenant_aware``
+        batchers only — the single-model contract pins tenant 0)."""
         x = np.asarray(trials, np.float32)
         if x.ndim == 2:
             x = x[None]
+        tenant = int(tenant)
+        if tenant != 0 and not self.tenant_aware:
+            raise ValueError(
+                f"tenant {tenant} submitted to a single-tenant batcher "
+                "(construct with tenant_aware=True for zoo serving)")
+        if tenant < 0:
+            raise ValueError(f"tenant must be >= 0, got {tenant}")
         n = len(x)
         if n == 0:
             fut: Future = Future()
@@ -187,8 +224,12 @@ class MicroBatcher:
                 # exact moment the service is overloaded.
                 shed_pending = self._pending_trials
             else:
-                self._pending.append((x, fut, time.perf_counter(),
-                                      deadline, trace.current()))
+                q = self._queues.get(tenant)
+                if q is None:
+                    q = self._queues[tenant] = deque()
+                    self._rr.append(tenant)
+                q.append((x, fut, time.perf_counter(), deadline,
+                          trace.current(), tenant))
                 self._pending_trials += n
                 self._gauge_depth_locked()
                 self._cv.notify_all()
@@ -230,9 +271,13 @@ class MicroBatcher:
         with self._cv:
             self._closed = True
             if not drain:
-                while self._pending:
-                    _, fut, _, _, _ = self._pending.popleft()
-                    fut.set_exception(Rejected("serving is shutting down"))
+                for q in self._queues.values():
+                    while q:
+                        _, fut, _, _, _, _ = q.popleft()
+                        fut.set_exception(
+                            Rejected("serving is shutting down"))
+                self._queues.clear()
+                self._rr.clear()
                 self._pending_trials = 0
                 self._gauge_depth_locked()
             self._cv.notify_all()
@@ -245,7 +290,7 @@ class MicroBatcher:
     # -- worker side ------------------------------------------------------
     def _take_batch(self) -> list[
             tuple[np.ndarray, Future, float,
-                  trace.TraceContext | None]] | None:
+                  trace.TraceContext | None, int]] | None:
         """Block for work, honor the coalescing window, pop one batch.
         Returns ``None`` when closed and fully drained.  Requests whose
         deadline already passed are dropped HERE — before the forward —
@@ -254,7 +299,7 @@ class MicroBatcher:
         try:
             while True:
                 with self._cv:
-                    if self._pending:
+                    if self._has_pending_locked():
                         return self._coalesce_locked(expired)
                     if self._closed:
                         return None
@@ -284,28 +329,59 @@ class MicroBatcher:
                         "request deadline expired while queued; dropped "
                         "before inference"))
 
+    def _oldest_enqueue_locked(self) -> float:
+        return min(q[0][2] for q in self._queues.values() if q)
+
+    def _pop_fit_locked(
+            self, q, now: float,
+            expired: list[tuple[Future, float, trace.TraceContext | None]],
+            parked: list, batch_empty: bool, n: int):
+        """Pop the first entry of one tenant's queue that fits the
+        remaining batch budget; expired entries drop, misfits move onto
+        ``parked`` for the REST of this coalesce pass (the budget only
+        shrinks — once skipped, an entry cannot fit later, so re-scanning
+        it every pop would make the pass O(taken x skipped)).  The
+        caller restores parked entries to the queue front in order —
+        greedy across requests, no starvation: a skipped request reaches
+        the head eventually and an empty batch always takes the head,
+        oversize or not.  Returns the entry or ``None`` when nothing in
+        this queue fits."""
+        while q:
+            entry = q.popleft()
+            x, fut, t_enq, deadline, ctx, tenant = entry
+            if deadline is not None and now >= deadline:
+                # Expired while queued: drop before the forward.
+                self._pending_trials -= len(x)
+                expired.append((fut, t_enq, ctx))
+                self._journal.metrics.inc("requests_expired")
+                continue
+            if not batch_empty and n + len(x) > self.max_batch:
+                parked.append(entry)
+                continue  # greedy: a later request of this tenant may fit
+            return entry
+        return None
+
     def _coalesce_locked(
             self,
             expired: list[tuple[Future, float, trace.TraceContext | None]]
     ) -> list[tuple[np.ndarray, Future, float,
-                    trace.TraceContext | None]]:
+                    trace.TraceContext | None, int]]:
         """Honor the coalescing window and pop one batch (``self._cv``
         held).  Requests whose deadline passed while queued go onto
         ``expired`` instead of into the batch.
 
-        Dequeue is GREEDY across requests: a request too large to join
-        the current batch is skipped (kept at the queue front, in order)
-        and the scan continues, so a full bucket's worth of later small
-        requests coalesces NOW instead of trickling out one underfilled
-        forward per misfit — the regression shape is a full top bucket
-        queued behind a smaller head request.  No starvation: a skipped
-        request reaches the head eventually and the head is always taken,
-        oversize or not.
+        The fill walks the tenant ring WEIGHTED-FAIR: one request per
+        pending tenant per cycle (the ring's rotation persists across
+        batches), cycling until the batch fills or nothing more fits —
+        so a cold tenant's lone request rides the very next dispatch no
+        matter how deep a hot sibling's backlog is, and a single tenant
+        degenerates to the legacy FIFO+greedy scan (same membership,
+        same order).
         """
         # Coalesce: wait until max_batch trials are queued or max_wait
-        # has elapsed since the FIRST pending request — bounded added
+        # has elapsed since the OLDEST pending request — bounded added
         # latency, never an idle park.
-        wait_until = self._pending[0][2] + self.max_wait_s
+        wait_until = self._oldest_enqueue_locked() + self.max_wait_s
         while (self._pending_trials < self.max_batch
                and not self._closed):
             remaining = wait_until - time.perf_counter()
@@ -315,28 +391,49 @@ class MicroBatcher:
         batch = []
         n = 0
         now = time.monotonic()
-        skipped: list[tuple[np.ndarray, Future, float, float | None,
-                            trace.TraceContext | None]] = []
-        while self._pending and n < self.max_batch:
-            x, fut, t_enq, deadline, ctx = self._pending.popleft()
-            req_n = len(x)
-            if deadline is not None and now >= deadline:
-                # Expired while queued: drop before the forward.
-                self._pending_trials -= req_n
-                expired.append((fut, t_enq, ctx))
-                self._journal.metrics.inc("requests_expired")
-                continue
-            if batch and n + req_n > self.max_batch:
-                skipped.append((x, fut, t_enq, deadline, ctx))
-                continue  # greedy: later requests may still fit
-            batch.append((x, fut, t_enq, ctx))
-            n += req_n
-        # Skipped requests return to the FRONT in their arrival order —
-        # they are older than everything behind them.
-        self._pending.extendleft(reversed(skipped))
+        parked: dict[int, list] = {}
+        while n < self.max_batch:
+            progressed = False
+            for _ in range(len(self._rr)):
+                tenant = self._rr[0]
+                self._rr.rotate(-1)
+                q = self._queues.get(tenant)
+                if not q:
+                    continue
+                entry = self._pop_fit_locked(
+                    q, now, expired, parked.setdefault(tenant, []),
+                    not batch, n)
+                if entry is None:
+                    continue
+                batch.append((entry[0], entry[1], entry[2], entry[4],
+                              entry[5]))
+                n += len(entry[0])
+                progressed = True
+                if n >= self.max_batch:
+                    break
+            if not progressed:
+                break
+        # Parked (too-big-for-this-batch) entries return to the FRONT in
+        # their original order — they are older than everything behind
+        # them and lead the next coalesce pass.
+        for tenant, entries in parked.items():
+            if entries:
+                self._queues[tenant].extendleft(reversed(entries))
+        # Tenants whose queue drained leave the ring (re-appended on the
+        # next submit); the ring's rotation carries the fairness state.
+        for tenant in [t for t, q in self._queues.items() if not q]:
+            del self._queues[tenant]
+            self._rr.remove(tenant)
         self._pending_trials -= n
         self._gauge_depth_locked()
         return batch
+
+    def _dispatch(self, x: np.ndarray, tenants: np.ndarray | None):
+        """One inference call: the tenant-aware contract passes the
+        per-trial tenant vector alongside the trials."""
+        if tenants is not None:
+            return self._infer_fn(x, tenants)
+        return self._infer_fn(x)
 
     def _run(self) -> None:
         # First beat at thread start: the worker announces itself before
@@ -349,12 +446,18 @@ class MicroBatcher:
                 return
             if not batch:  # every queued request expired: nothing to run
                 continue
-            xs = [x for x, _, _, _ in batch]
+            xs = [x for x, _, _, _, _ in batch]
             x = np.concatenate(xs) if len(xs) > 1 else xs[0]
+            # The per-trial tenant vector, aligned with the concatenated
+            # batch rows — what a zoo's stacked forward gathers by.
+            tenants = (np.concatenate(
+                [np.full(len(bx), tenant, np.int32)
+                 for bx, _, _, _, tenant in batch])
+                if self.tenant_aware else None)
             now = time.perf_counter()
             # Queue-wait spans land at dequeue (enqueue -> here), one per
             # traced request, under each REQUEST's own context.
-            for bx, _, t_enq, ctx in batch:
+            for bx, _, t_enq, ctx, _ in batch:
                 trace.emit_span(ctx, "queue.wait",
                                 dur_s=now - t_enq, journal=self._journal,
                                 n_trials=len(bx))
@@ -362,7 +465,7 @@ class MicroBatcher:
             # lives in the first sampled request's trace (else the first
             # traced one) and names every other coalesced trace in
             # link_traces, so the stitcher can attach it to their trees.
-            ctxs = [ctx for _, _, _, ctx in batch if ctx is not None]
+            ctxs = [ctx for _, _, _, ctx, _ in batch if ctx is not None]
             primary = next((c for c in ctxs if c.sampled),
                            ctxs[0] if ctxs else None)
             link_traces = sorted({c.trace_id for c in ctxs
@@ -383,21 +486,24 @@ class MicroBatcher:
                                        journal=self._journal,
                                        n_trials=len(x),
                                        n_requests=len(batch),
+                                       n_tenants=(
+                                           int(len(np.unique(tenants)))
+                                           if tenants is not None else 1),
                                        link_traces=link_traces) as sp:
-                        preds = np.asarray(self._infer_fn(x))
+                        preds = np.asarray(self._dispatch(x, tenants))
                         forward_span = sp.span_id if sp else None
                 else:
-                    preds = np.asarray(self._infer_fn(x))
+                    preds = np.asarray(self._dispatch(x, tenants))
             except BaseException as exc:  # noqa: BLE001 — routed to futures
-                for _, fut, _, _ in batch:
+                for _, fut, _, _, _ in batch:
                     if not fut.cancelled():
                         fut.set_exception(exc)
                 continue
-            # Scatter rows back in arrival order: request i owns
+            # Scatter rows back in dequeue order: request i owns
             # preds[off : off + len(request i)].
             t_scatter = time.perf_counter()
             off = 0
-            for bx, fut, t_enq, ctx in batch:
+            for bx, fut, t_enq, ctx, _ in batch:
                 k = len(bx)
                 if not fut.cancelled():
                     fut.set_result(preds[off:off + k])

@@ -138,6 +138,15 @@ class InferenceEngine:
     and returns int64 class predictions for the real rows only.
     """
 
+    # Compile-event naming prefix and the dummy inputs one bucket's warmup
+    # compiles with — the two points where the tenant-stacked engine
+    # (serve/zoo.py) differs, so warmup() is shared via these hooks.
+    WHAT_PREFIX = "serve_forward"
+
+    def _warm_args(self, b: int) -> tuple:
+        c, t = self.geometry
+        return (self._jnp.zeros((b, c, t), self._jnp.float32),)
+
     def __init__(self, model, params, batch_stats,
                  buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
                  precision: str = "fp32", digest: str | None = None,
@@ -248,7 +257,6 @@ class InferenceEngine:
             enable_compilation_cache,
         )
 
-        c, t = self.geometry
         walls: dict[int, float] = {}
         with self._lock:
             if self._warmed:
@@ -259,12 +267,11 @@ class InferenceEngine:
             cache_dir = enable_compilation_cache(explicit_only=True)
             tag = "" if self.precision == "fp32" else f"_{self.precision}"
             for b in self.buckets:
-                what = f"serve_forward{tag}_b{b}"
+                what = f"{self.WHAT_PREFIX}{tag}_b{b}"
                 self._journal.event("compile_begin", what=what)
                 probe = compile_cache_probe(cache_dir)
                 t0 = time.perf_counter()
-                jax.block_until_ready(
-                    self._fwd(self._jnp.zeros((b, c, t), self._jnp.float32)))
+                jax.block_until_ready(self._fwd(*self._warm_args(b)))
                 wall = time.perf_counter() - t0
                 walls[b] = wall
                 cache_hit = compile_cache_hit(cache_dir, probe)

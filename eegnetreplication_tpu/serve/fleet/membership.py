@@ -143,6 +143,8 @@ class Replica:
         self.digest: str | None = None
         self.precision: str | None = None   # from the last health poll
         self.buckets: tuple[int, ...] | None = None  # active ladder
+        self.n_tenants: int | None = None   # zoo tenant count (None =
+        self.stacked: bool | None = None    # single-model replica)
         self.slo_breached: list[str] = []   # breached SLO objectives
         self.queue_depth = 0          # requests, from the last health poll
         self.health_failures = 0      # consecutive unreachable polls
@@ -176,6 +178,7 @@ class Replica:
                 "state": self.state, "digest": self.digest,
                 "precision": self.precision,
                 "buckets": list(self.buckets) if self.buckets else None,
+                "n_tenants": self.n_tenants, "stacked": self.stacked,
                 "slo_breached": list(self.slo_breached),
                 "queue_depth": self.queue_depth, "inflight": self.inflight,
                 "circuit": self.breaker.state}
@@ -304,6 +307,20 @@ class FleetMembership:
                 replica.buckets = tuple(int(b) for b in buckets)
             except (TypeError, ValueError):
                 pass  # malformed advert must not poison the whole poll
+        # A multi-tenant replica adverts its zoo on /healthz; the tenant
+        # count and stacked-engine state mirror into the snapshot the
+        # fleet /healthz aggregates (single-model replicas stay None).
+        zoo = payload.get("zoo")
+        if isinstance(zoo, dict):
+            n = zoo.get("n_tenants")
+            replica.n_tenants = n if isinstance(n, int) else None
+            replica.stacked = zoo.get("stacked") is not None
+        else:
+            # The advert stopped carrying a zoo (replica restarted as a
+            # single-model server): stale tenant state must not linger
+            # in the fleet snapshot.
+            replica.n_tenants = None
+            replica.stacked = None
         depth = payload.get("queue_depth_requests")
         if isinstance(depth, int):
             replica.queue_depth = depth

@@ -335,6 +335,11 @@ class _FleetHandler(JsonRequestHandler):
             # a control-class client behind the router would be shed as
             # bulk by the replica's adaptive limit.
             passthrough["X-Priority"] = self.headers["X-Priority"]
+        if self.headers.get("X-Model"):
+            # Zoo model addressing rides through too: a stripped X-Model
+            # would make the replica serve its DEFAULT tenant with a 200
+            # — the wrong model's answers, silently.
+            passthrough["X-Model"] = self.headers["X-Model"]
         try:
             status, data, replica_id = app.router.dispatch(
                 body, content_type, headers=passthrough)

@@ -1,4 +1,4 @@
-"""Model registry: integrity-verified hot-reload with atomic engine swap.
+"""Model registry & zoo: integrity-verified hot-reload, multi-tenant serving.
 
 A long-lived serving process outlives any single checkpoint: training
 produces a better model, the server must pick it up WITHOUT dropping the
@@ -15,6 +15,22 @@ A reload of a corrupt/missing checkpoint raises and leaves the current
 engine serving — a bad push must degrade to "nothing changed", never to
 an outage.  Every successful swap is journaled as a ``model_swap`` event
 with the old and new content digests.
+
+:class:`ModelZoo` is the registry's multi-tenant evolution: the paper's
+within-subject protocol yields NINE per-subject models per run, and the
+zoo serves all of them from one process.  Requests address a model id
+(a zoo key — typically the subject —, an explicit variables-digest
+prefix, or the default); engines materialize on demand and evict LRU
+under a compiled-program budget (``model_load``/``model_evict``
+journaled).  When every tenant shares one architecture the zoo collapses
+its hot path into ONE program: a
+:class:`~eegnetreplication_tpu.serve.zoo.StackedEngine` over the tenants'
+stacked param trees serves a mixed-tenant coalesced batch in a single
+gather+forward — the compiled-program count is constant in the number of
+tenants — gated per tenant against the unstacked fp32 references
+(refuse → per-model fallback).  A hot reload of one tenant restacks off
+the hot path and swaps atomically (``zoo_restack``), the PR-3 shape: a
+restack under load drops zero requests.
 """
 
 from __future__ import annotations
@@ -33,6 +49,7 @@ from eegnetreplication_tpu.serve.engine import (
     QuantGateResult,
     build_gated_engine,
     load_model_from_checkpoint,
+    variables_digest,
 )
 from eegnetreplication_tpu.utils.logging import logger
 
@@ -93,6 +110,12 @@ class ModelRegistry:
         """The precision actually answering requests (fp32 when the quant
         gate refused an int8 request)."""
         return self.engine.precision
+
+    @property
+    def active_buckets(self) -> tuple[int, ...]:
+        """The live ladder — same cheap-accessor surface the zoo offers,
+        so ladder readers (the tuner) need not touch ``engine``."""
+        return self.engine.buckets
 
     def _build(self, checkpoint: str | Path, buckets: tuple[int, ...],
                warm: bool) -> InferenceEngine:
@@ -194,3 +217,565 @@ class ModelRegistry:
         the old (still-alive) engine and routes the next one to the new.
         """
         return self.engine.infer(trials)
+
+
+# ---------------------------------------------------------------------------
+# The multi-tenant zoo.
+# ---------------------------------------------------------------------------
+
+class _ZooEntry:
+    """One tenant: checkpoint identity, loaded variables, resident engine."""
+
+    __slots__ = ("model_id", "checkpoint", "model", "params", "batch_stats",
+                 "digest", "engine", "serving_precision", "gate",
+                 "last_used", "loads", "evictions")
+
+    def __init__(self, model_id: str, checkpoint: Path):
+        self.model_id = model_id
+        self.checkpoint = Path(checkpoint)
+        self.model = None            # set on first variables load
+        self.params = None
+        self.batch_stats = None
+        self.digest: str | None = None
+        self.engine: InferenceEngine | None = None   # resident when set
+        self.serving_precision: str | None = None
+        self.gate: QuantGateResult | None = None
+        self.last_used = 0.0         # monotonic; LRU eviction key
+        self.loads = 0               # engine materializations
+        self.evictions = 0
+
+
+class ModelZoo:
+    """N addressable tenants, one hot path.
+
+    The zoo keeps every tenant's *variables* resident (an EEGNet tree is
+    tens of KB — nine of them are noise) but treats *compiled programs*
+    as the scarce resource: per-model engines materialize on demand
+    (``model_load``) and evict least-recently-used once their program
+    count exceeds ``max_programs`` (``model_evict``; each resident
+    engine holds ``len(buckets)`` warm executables).
+
+    With ``stack=True`` (default) and congruent tenants, construction
+    builds ONE :class:`~eegnetreplication_tpu.serve.zoo.StackedEngine`
+    over the stacked trees, gated per tenant against the unstacked fp32
+    references; ``infer(x, tenant_idx)`` then serves any mixed-tenant
+    batch in a single dispatch and per-model engines exist only as a
+    gate-refusal fallback.  ``reload`` swaps one tenant's weights and
+    restacks off the hot path (``zoo_restack``) with zero dropped
+    requests; ``retune`` mirrors ``ModelRegistry.retune`` for the
+    LadderTuner (same duck-typed surface: ``engine``, ``retune``,
+    ``swaps``, ``retunes``, ``serving_precision``).
+    """
+
+    def __init__(self, checkpoints, *, default: str | None = None,
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS,
+                 precision: str = "fp32",
+                 quant_floor: float = QUANT_AGREEMENT_FLOOR,
+                 gate_set=None, max_programs: int = 0, stack: bool = True,
+                 warm: bool = True, journal=None):
+        from eegnetreplication_tpu.serve.zoo import parse_zoo_spec
+
+        mapping = parse_zoo_spec(checkpoints)
+        self.tenant_ids: list[str] = list(mapping)
+        self.default_id = str(default) if default is not None \
+            else self.tenant_ids[0]
+        if self.default_id not in mapping:
+            raise ValueError(f"default model {self.default_id!r} is not a "
+                             f"zoo tenant (have {self.tenant_ids})")
+        self.buckets = tuple(buckets)
+        self.precision = precision          # requested
+        self.quant_floor = float(quant_floor)
+        self._gate_set = gate_set           # None = default_gate_set
+        self.max_programs = int(max_programs)   # 0 = unbounded
+        self.stack_requested = bool(stack)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._entries = {mid: _ZooEntry(mid, path)
+                         for mid, path in mapping.items()}
+        self._lock = threading.Lock()       # entry/LRU bookkeeping
+        self._build_lock = threading.Lock()  # serializes engine builds
+        self._reload_lock = threading.Lock()  # serializes reload/restack
+        self._stacked = None                # the one-program hot path
+        self.last_stack_gate = None
+        self.last_gate: QuantGateResult | None = None  # registry compat
+        self._swaps = 0
+        self._retunes = 0
+        self._restacks = 0
+        if self.stack_requested:
+            self._restack(reason="initial", warm=warm)
+        if self._stacked is None:
+            # Per-model serving (stacking off or refused): the default
+            # tenant materializes eagerly so the service never answers
+            # its first request cold.
+            self.materialize(self.default_id, warm=warm)
+
+    # -- addressing --------------------------------------------------------
+    @property
+    def n_tenants(self) -> int:
+        return len(self.tenant_ids)
+
+    def tenant_index(self, model_id: str) -> int:
+        try:
+            return self.tenant_ids.index(model_id)
+        except ValueError:
+            raise KeyError(f"unknown model {model_id!r}; zoo tenants: "
+                           f"{self.tenant_ids}") from None
+
+    def checkpoint_for(self, model_id: str) -> Path:
+        return self._entries[model_id].checkpoint
+
+    def digest_for(self, model_id: str) -> str | None:
+        """The digest of the weights ACTUALLY answering this tenant's
+        requests.  While the stacked engine serves, that is the digest
+        baked into the stack — during the seconds a post-reload restack
+        spends rebuilding, the old stack still answers, and reporting
+        the entry's already-swapped digest would misattribute those
+        predictions.  The moment the new stack swaps in, its
+        tenant_digests carry the reloaded digest."""
+        stacked = self._stacked
+        if stacked is not None and model_id in stacked.tenant_digests:
+            return stacked.tenant_digests[model_id]
+        return self._entries[model_id].digest
+
+    def resolve(self, spec: str | None) -> str:
+        """A request's model spec -> tenant id via the SHARED resolver
+        (:func:`~eegnetreplication_tpu.serve.zoo.resolve_model_id` — the
+        predict CLI routes through the same one): ``None``/``"default"``
+        is the default tenant, an exact zoo key wins next, then an
+        unambiguous variables-digest prefix among tenants whose digest
+        is known (all of them once the stack built; lazily-loaded ones
+        otherwise)."""
+        from eegnetreplication_tpu.serve.zoo import resolve_model_id
+
+        return resolve_model_id(
+            self.tenant_ids, spec, self.default_id,
+            {mid: self._entries[mid].digest for mid in self.tenant_ids})
+
+    # -- program-budget accounting ----------------------------------------
+    def _resident_programs_locked(self) -> int:
+        return sum(len(e.engine.buckets) for e in self._entries.values()
+                   if e.engine is not None)
+
+    def _evict_over_budget_locked(self) -> None:
+        """Drop LRU resident engines until within ``max_programs``.  The
+        most-recently-used engine always survives (the zoo must be able
+        to serve even when one ladder alone exceeds the budget)."""
+        if self.max_programs <= 0:
+            return
+        while self._resident_programs_locked() > self.max_programs:
+            resident = sorted(
+                (e for e in self._entries.values() if e.engine is not None),
+                key=lambda e: e.last_used)
+            if len(resident) <= 1:
+                return
+            victim = resident[0]
+            freed = len(victim.engine.buckets)
+            victim.engine = None
+            victim.evictions += 1
+            self._journal.event("model_evict", model=victim.model_id,
+                                reason="program_budget",
+                                freed_programs=freed,
+                                resident_programs=
+                                self._resident_programs_locked())
+            self._journal.metrics.inc("zoo_evictions")
+            logger.info("Zoo evicted %s (LRU, freed %d programs)",
+                        victim.model_id, freed)
+
+    # -- loading -----------------------------------------------------------
+    def _load_variables(self, entry: _ZooEntry) -> None:
+        """Load (model, params, batch_stats) once per tenant; idempotent.
+        Caller holds ``_build_lock``.
+
+        Geometry is enforced homogeneous across the zoo: every request
+        is shape-validated against ONE (C, T), so a mixed-geometry
+        tenant could never be addressed anyway — fail its first load
+        with a clear contract instead of 400-ing its traffic forever.
+        (Same-geometry architecture differences still stack-or-fallback
+        through the congruence check.)"""
+        if entry.params is not None:
+            return
+        model, params, batch_stats = \
+            load_model_from_checkpoint(entry.checkpoint)
+        for other in self._entries.values():
+            if other.model is not None and \
+                    (other.model.n_channels, other.model.n_times) != \
+                    (model.n_channels, model.n_times):
+                raise ValueError(
+                    f"zoo tenants must share one geometry: "
+                    f"{entry.model_id} is "
+                    f"({model.n_channels}, {model.n_times}) but "
+                    f"{other.model_id} is "
+                    f"({other.model.n_channels}, {other.model.n_times}); "
+                    "serve mixed geometries from separate processes")
+        entry.model, entry.params, entry.batch_stats = \
+            model, params, batch_stats
+        entry.digest = variables_digest(params, batch_stats)
+
+    def materialize(self, model_id: str,
+                    warm: bool = False) -> InferenceEngine:
+        """The tenant's per-model engine, building it on demand (gated at
+        the requested precision) and evicting LRU siblings past the
+        program budget.  The fast path (already resident) is one lock."""
+        entry = self._entries[model_id]
+        with self._lock:
+            entry.last_used = time.monotonic()
+            engine = entry.engine
+        if engine is not None:
+            if warm:
+                engine.warmup()   # idempotent: no-op when already warm
+            return engine
+        with self._build_lock:
+            with self._lock:
+                if entry.engine is not None:
+                    engine = entry.engine
+            if engine is not None:
+                if warm:
+                    engine.warmup()
+                return engine
+            t0 = time.perf_counter()
+            self._load_variables(entry)
+            engine, gate = build_gated_engine(
+                entry.model, entry.params, entry.batch_stats, self.buckets,
+                precision=self.precision, floor=self.quant_floor,
+                gate_set=self._gate_set, source=str(entry.checkpoint),
+                warm=warm, journal=self._journal)
+            entry.gate = gate
+            self.last_gate = gate
+            entry.serving_precision = engine.precision
+            with self._lock:
+                entry.engine = engine
+                entry.last_used = time.monotonic()
+                entry.loads += 1
+                self._evict_over_budget_locked()
+                resident = self._resident_programs_locked()
+            self._journal.event(
+                "model_load", model=model_id, digest=engine.digest,
+                precision=engine.precision,
+                checkpoint=str(entry.checkpoint),
+                resident_programs=resident,
+                elapsed_s=round(time.perf_counter() - t0, 3))
+            self._journal.metrics.inc("zoo_loads")
+            return engine
+
+    # -- stacking ----------------------------------------------------------
+    def _restack(self, reason: str, warm: bool = True) -> None:
+        """(Re)build the one-program stacked engine off the hot path and
+        swap it atomically; a gate refusal (or incongruent tenants)
+        leaves per-model serving in place.  Caller must NOT hold the
+        locks the hot path takes — in-flight batches keep running on the
+        old stacked engine object until the swap."""
+        from eegnetreplication_tpu.serve.zoo import build_stacked_engine
+
+        t0 = time.perf_counter()
+        with self._build_lock:
+            for entry in self._entries.values():
+                self._load_variables(entry)
+        members = [(mid, self._entries[mid].model, self._entries[mid].params,
+                    self._entries[mid].batch_stats)
+                   for mid in self.tenant_ids]
+        try:
+            stacked, gate = build_stacked_engine(
+                members, self.buckets, precision=self.precision,
+                gate_set=self._gate_set,
+                floor=(self.quant_floor if self.precision == "int8"
+                       else None),
+                warm=warm, journal=self._journal)
+        except Exception as exc:  # noqa: BLE001 — restack must not stale
+            # ValueError = incongruent tenants (mixed architectures):
+            # per-model serving is the contract, not a failed zoo.  ANY
+            # other failure (compile OOM, gate inference error) gets the
+            # same treatment — the one thing a failed restack must never
+            # do is leave a PRE-change stack serving old weights under
+            # the new digests, so the stale stack demotes either way.
+            outcome = ("unstackable" if isinstance(exc, ValueError)
+                       else "error")
+            logger.warning("Zoo cannot stack (%s: %s); serving per-model "
+                           "engines", type(exc).__name__, exc)
+            self._journal.event("zoo_restack", n_tenants=self.n_tenants,
+                                outcome=outcome, reason=reason,
+                                error=f"{type(exc).__name__}: "
+                                      f"{exc}"[:200],
+                                demoted_stale_stack=self._demote_stale(),
+                                elapsed_s=round(time.perf_counter() - t0,
+                                                3))
+            return
+        self.last_stack_gate = gate
+        outcome = "pass" if stacked is not None else "refused"
+        demoted = False
+        if stacked is not None:
+            old = self._stacked
+            self._stacked = stacked   # atomic reference swap
+            self._restacks += 1
+            del old
+        else:
+            demoted = self._demote_stale()
+        self._journal.event(
+            "zoo_restack", n_tenants=self.n_tenants, outcome=outcome,
+            reason=reason, precision=self.precision,
+            agreement=round(gate.agreement, 6),
+            digest=(stacked.digest if stacked is not None else None),
+            demoted_stale_stack=demoted,
+            elapsed_s=round(time.perf_counter() - t0, 3))
+        self._journal.metrics.inc("zoo_restacks", outcome=outcome)
+
+    def _demote_stale(self) -> bool:
+        """A restack that FAILED after tenant state changed (a reload)
+        must not leave the pre-change stack serving: its weights no
+        longer match the digests the zoo reports — silent corruption.
+        Demote to per-model serving (fresh weights, materialized on
+        demand) — refuse-and-keep-serving, never stale-and-keep-serving.
+        Returns whether a live stack was demoted."""
+        if self._stacked is None:
+            return False
+        self._stacked = None
+        logger.warning("Zoo demoted the stale stacked engine; serving "
+                       "per-model until a restack passes")
+        return True
+
+    @property
+    def stacked(self):
+        """The live one-program engine, or ``None`` when serving
+        per-model (stacking off, refused, or unstackable)."""
+        return self._stacked
+
+    # -- registry-compatible surface --------------------------------------
+    @property
+    def engine(self) -> InferenceEngine:
+        """The LIVE engine (the stacked one, else the default tenant's,
+        materialized on demand).  Callers that only need identity —
+        health probes, request validation — must use the cheap
+        :attr:`geometry`/:attr:`digest`/:attr:`active_buckets`/
+        :attr:`serving_precision` accessors instead: this property can
+        trigger a synchronous engine build when the default tenant was
+        LRU-evicted, which must never ride a /healthz poll."""
+        stacked = self._stacked
+        if stacked is not None:
+            return stacked
+        return self.materialize(self.default_id)
+
+    @property
+    def geometry(self) -> tuple[int, int]:
+        """(n_channels, n_times) without materializing anything."""
+        stacked = self._stacked
+        if stacked is not None:
+            return stacked.geometry
+        for mid in self.tenant_ids:
+            model = self._entries[mid].model
+            if model is not None:
+                return model.n_channels, model.n_times
+        with self._build_lock:   # first touch: load the default's tree
+            self._load_variables(self._entries[self.default_id])
+        model = self._entries[self.default_id].model
+        return model.n_channels, model.n_times
+
+    @property
+    def digest(self) -> str | None:
+        """The identity /healthz advertises: the stack's digest when the
+        one-program engine serves, else the default tenant's."""
+        stacked = self._stacked
+        if stacked is not None:
+            return stacked.digest
+        return self._entries[self.default_id].digest
+
+    @property
+    def active_buckets(self) -> tuple[int, ...]:
+        stacked = self._stacked
+        if stacked is not None:
+            return stacked.buckets
+        return self.buckets
+
+    @property
+    def serving_precision(self) -> str:
+        stacked = self._stacked
+        if stacked is not None:
+            return stacked.precision
+        entry = self._entries[self.default_id]
+        return entry.serving_precision or self.precision
+
+    @property
+    def swaps(self) -> int:
+        with self._lock:
+            return self._swaps
+
+    @property
+    def retunes(self) -> int:
+        with self._lock:
+            return self._retunes
+
+    @property
+    def restacks(self) -> int:
+        with self._lock:
+            return self._restacks
+
+    # -- the hot path ------------------------------------------------------
+    def infer(self, trials: np.ndarray,
+              tenant_idx: np.ndarray | int = 0) -> np.ndarray:
+        """Mixed-tenant batch -> predictions.
+
+        One dispatch through the stacked engine when it is live;
+        otherwise the batch splits per tenant and each slice runs its
+        own (materialized-on-demand) engine — up to N dispatches, the
+        cost the stack exists to collapse.
+        """
+        x = np.asarray(trials, np.float32)
+        if x.ndim == 2:
+            x = x[None]
+        tid = np.broadcast_to(np.asarray(tenant_idx, np.int32),
+                              (len(x),)).astype(np.int32, copy=False)
+        stacked = self._stacked
+        if stacked is not None:
+            if len(x):
+                now = time.monotonic()
+                with self._lock:
+                    for z in np.unique(tid):
+                        self._entries[self.tenant_ids[int(z)]].last_used \
+                            = now
+            return stacked.infer(x, tid)
+        out = np.empty(len(x), np.int64)
+        for z in np.unique(tid):
+            mid = self.tenant_ids[int(z)]
+            engine = self.materialize(mid)
+            mask = tid == z
+            out[mask] = engine.infer(x[mask])
+        return out
+
+    # -- mutation ----------------------------------------------------------
+    def reload(self, model_id: str, checkpoint: str | Path, *,
+               warm: bool = True) -> str:
+        """Swap ONE tenant's weights (integrity-verified, geometry-gated)
+        and restack off the hot path.  Raises without touching the
+        serving state on any failure; returns the tenant's new digest.
+        """
+        with self._reload_lock:
+            entry = self._entries[self.resolve(model_id)]
+            t0 = time.perf_counter()
+            model, params, batch_stats = load_model_from_checkpoint(
+                checkpoint)
+            if (model.n_channels, model.n_times) != self.geometry:
+                raise ValueError(
+                    f"hot-reload geometry mismatch: serving "
+                    f"{self.geometry}, checkpoint {checkpoint} is "
+                    f"{(model.n_channels, model.n_times)}; restart the "
+                    "service to change model geometry")
+            new_digest = variables_digest(params, batch_stats)
+            # The build AND the entry mutation serialize with
+            # materialize() (same _build_lock): a concurrent on-demand
+            # build that read the pre-reload weights must land BEFORE
+            # the swap below, never overwrite it afterwards.
+            with self._build_lock:
+                engine = None
+                if self._stacked is None:
+                    # Per-model serving: the tenant's engine itself must
+                    # be rebuilt (gated) off to the side before the swap.
+                    engine, gate = build_gated_engine(
+                        model, params, batch_stats, self.buckets,
+                        precision=self.precision, floor=self.quant_floor,
+                        gate_set=self._gate_set, source=str(checkpoint),
+                        warm=warm, journal=self._journal)
+                    entry.gate = gate
+                    self.last_gate = gate
+                old_digest = entry.digest
+                with self._lock:
+                    entry.model, entry.params, entry.batch_stats = \
+                        model, params, batch_stats
+                    entry.digest = new_digest
+                    entry.checkpoint = Path(checkpoint)
+                    if engine is not None:
+                        entry.engine = engine
+                        entry.serving_precision = engine.precision
+                        # The rebuilt engine is the freshest resident:
+                        # stamp recency (so it is not the next LRU
+                        # victim) and enforce the program budget it may
+                        # have just exceeded.
+                        entry.last_used = time.monotonic()
+                        self._evict_over_budget_locked()
+                    else:
+                        entry.engine = None  # stale weights must not serve
+                    self._swaps += 1
+            self._journal.event(
+                "model_swap", checkpoint=str(checkpoint),
+                model=entry.model_id, digest=new_digest,
+                previous_digest=old_digest,
+                precision=self.precision,
+                elapsed_s=round(time.perf_counter() - t0, 3))
+            self._journal.metrics.inc("model_swaps")
+            if self.stack_requested:
+                self._restack(reason=f"reload:{entry.model_id}", warm=warm)
+            return new_digest
+
+    def retune(self, buckets: tuple[int, ...], *, warm: bool = True):
+        """Adopt a new bucket ladder (the LadderTuner's primitive): the
+        stacked engine rebuilds on the new ladder off the hot path (same
+        weights — no re-gate, mirroring ``ModelRegistry.retune``) and
+        swaps atomically; resident per-model engines drop and rebuild
+        lazily on the new ladder."""
+        with self._reload_lock:
+            # _build_lock: an in-flight materialize() captured the OLD
+            # self.buckets — it must finish (and land) before the ladder
+            # moves and the old-ladder engines retire below.
+            with self._build_lock:
+                self.buckets = tuple(int(b) for b in buckets)
+                stacked = self._stacked
+                if stacked is not None:
+                    from eegnetreplication_tpu.serve.zoo import (
+                        StackedEngine,
+                    )
+
+                    engine = StackedEngine(
+                        stacked.model, stacked.tenant_ids, stacked.params,
+                        stacked.batch_stats, self.buckets,
+                        precision=stacked.precision,
+                        tenant_digests=stacked.tenant_digests,
+                        journal=self._journal)
+                    if warm:
+                        engine.warmup()
+                    self._stacked = engine
+                with self._lock:
+                    for entry in self._entries.values():
+                        entry.engine = None  # old-ladder engines retire
+                    self._retunes += 1
+            if self._stacked is None:
+                # Per-model mode: rebuild the default engine on the new
+                # ladder so the tuner's swap is observable immediately.
+                self.materialize(self.default_id, warm=warm)
+            return self.engine
+
+    # -- observability -----------------------------------------------------
+    def snapshot(self) -> dict:
+        """The /healthz ``tenants`` payload: per-tenant identity,
+        precision, residency, and recency, plus the stacked-engine
+        state."""
+        now = time.monotonic()
+        stacked = self._stacked
+        with self._lock:
+            tenants = []
+            for mid in self.tenant_ids:
+                e = self._entries[mid]
+                tenants.append({
+                    "model": mid,
+                    # The digest actually serving (the stack's slice
+                    # while it answers; the entry's once per-model).
+                    "digest": (stacked.tenant_digests.get(mid, e.digest)
+                               if stacked is not None else e.digest),
+                    "precision": (stacked.precision if stacked is not None
+                                  else e.serving_precision),
+                    "resident": (stacked is not None
+                                 or e.engine is not None),
+                    "engine_resident": e.engine is not None,
+                    "last_used_age_s": (round(now - e.last_used, 3)
+                                        if e.last_used else None),
+                    "loads": e.loads,
+                    "evictions": e.evictions,
+                    "default": mid == self.default_id})
+            return {
+                "n_tenants": self.n_tenants,
+                "default": self.default_id,
+                "stacked": (None if stacked is None else {
+                    "precision": stacked.precision,
+                    "digest": stacked.digest,
+                    "buckets": list(stacked.buckets),
+                    "n_tenants": stacked.n_tenants}),
+                "resident_programs": self._resident_programs_locked(),
+                "max_programs": self.max_programs,
+                "restacks": self._restacks,
+                "tenants": tenants}

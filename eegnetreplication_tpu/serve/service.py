@@ -11,8 +11,14 @@ run is observable (obs) and survivable (resil):
   A per-request deadline (``X-Deadline-Ms`` header or ``deadline_ms``
   JSON field) is enforced at dequeue (an expired request is dropped
   before wasting a forward) and at response time — both answer 504.
+  Under ``--zoo`` the request addresses a model (``X-Model`` header or
+  ``"model"`` JSON field: tenant id, digest prefix, or default); an
+  unknown id answers 404.  Mixed-tenant traffic coalesces into ONE
+  batch and (same-architecture tenants) ONE stacked forward.
 - ``POST /reload`` — ``{"checkpoint": path}``: integrity-verified hot
-  swap with zero dropped in-flight requests.
+  swap with zero dropped in-flight requests.  Under ``--zoo``,
+  ``{"model": id, "checkpoint": path}`` swaps ONE tenant's weights and
+  restacks the one-program engine off the hot path (``zoo_restack``).
 - ``GET /healthz`` — liveness + the serving digest and queue depth;
   degrades to 503 when the circuit breaker is open or the batcher
   worker's heartbeat is stale, so external orchestrators can act.
@@ -95,7 +101,7 @@ from eegnetreplication_tpu.serve.engine import (
     DEFAULT_BUCKETS,
     QUANT_AGREEMENT_FLOOR,
 )
-from eegnetreplication_tpu.serve.registry import ModelRegistry
+from eegnetreplication_tpu.serve.registry import ModelRegistry, ModelZoo
 from eegnetreplication_tpu.serve.sessions import SessionStore, WindowDecision
 from eegnetreplication_tpu.serve.sessions.session import (
     STATUS_ERROR,
@@ -132,15 +138,23 @@ def make_infer_fn(registry: ModelRegistry, breaker: CircuitBreaker | None
     bounded, non-raising delay): the gray-replica reproduction.  It
     carries ``chaos_tag`` so an ``if_tag=`` spec degrades exactly one
     tagged replica of an in-process fleet drill.
+
+    A tenant-aware batcher (zoo serving) calls the result with the
+    per-trial tenant vector as a second argument, which routes to the
+    zoo's mixed-tenant ``infer(x, tenant_idx)``; without it the legacy
+    single-model path is byte-identical to before.
     """
-    def dispatch(x: np.ndarray) -> np.ndarray:
+    def dispatch(x: np.ndarray, tenants=None) -> np.ndarray:
         inject.fire("serve.forward", n_trials=len(x))
         inject.fire("serve.degrade", n_trials=len(x), tag=chaos_tag)
-        return registry.infer(x)
+        if tenants is None:
+            return registry.infer(x)
+        return registry.infer(x, tenants)
 
-    def infer_fn(x: np.ndarray) -> np.ndarray:
+    def infer_fn(x: np.ndarray, tenants=None) -> np.ndarray:
         try:
-            out = resil_retry.call(lambda: dispatch(x), policy=SERVE_RETRY,
+            out = resil_retry.call(lambda: dispatch(x, tenants),
+                                   policy=SERVE_RETRY,
                                    site="serve.forward")
         except Exception:
             if breaker is not None:
@@ -162,7 +176,8 @@ class ServeApp:
     ``serve_end``.
     """
 
-    def __init__(self, checkpoint: str | Path, *, host: str = "127.0.0.1",
+    def __init__(self, checkpoint: str | Path | None = None, *,
+                 host: str = "127.0.0.1",
                  port: int = 0, buckets: tuple[int, ...] = DEFAULT_BUCKETS,
                  max_batch: int | None = None, max_wait_ms: float = 5.0,
                  max_queue_trials: int = 512,
@@ -182,18 +197,41 @@ class ServeApp:
                  slo_window_s: float = obs_slo.DEFAULT_WINDOW_S,
                  slo_interval_s: float = 1.0,
                  admission_target_ms: float = 0.0,
-                 chaos_tag: str | None = None):
+                 chaos_tag: str | None = None,
+                 zoo=None, default_model: str | None = None,
+                 max_programs: int = 0, stack: bool = True):
         self.journal = journal if journal is not None \
             else obs_journal.current()
-        self.checkpoint = str(checkpoint)
         # precision="int8" requests the quantized engine; the registry
         # runs the mandatory fp32-argmax equivalence gate and falls back
         # to fp32 on refusal (serving_precision reports the truth).
-        self.registry = ModelRegistry(tuple(buckets), precision=precision,
-                                      quant_floor=quant_floor,
-                                      gate_set=gate_set,
-                                      journal=self.journal)
-        self.registry.load(checkpoint)
+        #
+        # ``zoo`` (an id=path mapping/spec — see serve/zoo.parse_zoo_spec)
+        # switches the process to multi-tenant serving: requests address
+        # a model id (X-Model header / "model" JSON field), the batcher
+        # coalesces ACROSS tenants weighted-fair, and same-architecture
+        # tenants serve through ONE stacked compiled program per bucket
+        # (gated per tenant, refuse -> per-model fallback).
+        if zoo is not None:
+            self.registry = ModelZoo(
+                zoo, default=default_model, buckets=tuple(buckets),
+                precision=precision, quant_floor=quant_floor,
+                gate_set=gate_set, max_programs=max_programs,
+                stack=stack, journal=self.journal)
+            self.zoo: ModelZoo | None = self.registry
+            self.checkpoint = str(
+                self.registry.checkpoint_for(self.registry.default_id))
+        else:
+            if checkpoint is None:
+                raise ValueError("ServeApp needs a checkpoint or a zoo")
+            self.zoo = None
+            self.checkpoint = str(checkpoint)
+            self.registry = ModelRegistry(tuple(buckets),
+                                          precision=precision,
+                                          quant_floor=quant_floor,
+                                          gate_set=gate_set,
+                                          journal=self.journal)
+            self.registry.load(checkpoint)
         # Streaming sessions: durable when sessions_dir is given (the CLI
         # always passes one), in-memory otherwise.  --resume restores the
         # newest valid snapshot generation BEFORE the listener binds, so a
@@ -239,7 +277,7 @@ class ServeApp:
             max_batch=resolved_max_batch,
             max_wait_ms=max_wait_ms, max_queue_trials=max_queue_trials,
             journal=self.journal, heartbeat=self.heartbeat,
-            admission=self.admission)
+            admission=self.admission, tenant_aware=self.zoo is not None)
         # Ladder self-tuning: observe bucket occupancy + arrival rate,
         # retune the compile ladder off the hot path.  Opt-in (0 = off):
         # the autonomous loop only makes sense for long-lived servers.
@@ -333,6 +371,10 @@ class ServeApp:
             sessions_dir=(str(self.sessions_dir)
                           if self.sessions_dir else None),
             sessions_restored=len(self.sessions.restored),
+            tenants=(list(self.zoo.tenant_ids)
+                     if self.zoo is not None else None),
+            stacked=(self.zoo.stacked is not None
+                     if self.zoo is not None else None),
             host=self.address[0], port=self.address[1])
         logger.info("Serving %s at %s (buckets %s, %s)", self.checkpoint,
                     self.url, self.registry.engine.buckets,
@@ -400,12 +442,30 @@ class ServeApp:
                            ladder_retunes=self.ladder_retunes,
                            slo_breaches=(self.slo.breach_events
                                          if self.slo is not None else 0),
+                           n_tenants=(self.zoo.n_tenants
+                                      if self.zoo is not None else None),
+                           zoo_restacks=(self.zoo.restacks
+                                         if self.zoo is not None else None),
                            precision=self.registry.serving_precision)
         logger.info("Serve drained and stopped: %d requests "
                     "(%d rejected, %d errors, %d expired, %d refused by "
                     "the open circuit), %d model swap(s), %d breaker "
                     "trip(s)", n_req, n_rej, n_err, n_exp, n_open,
                     self.registry.swaps, self.breaker.trips)
+
+    # -- identity (cheap; never builds an engine) --------------------------
+    def model_geometry(self) -> tuple[int, int]:
+        """(n_channels, n_times) the service accepts — the zoo's cached
+        geometry in multi-tenant mode (the registry engine is always
+        resident in single-model mode)."""
+        if self.zoo is not None:
+            return self.zoo.geometry
+        return self.registry.engine.geometry
+
+    def serving_digest(self) -> str | None:
+        if self.zoo is not None:
+            return self.zoo.digest
+        return self.registry.engine.digest
 
     # -- request accounting (called from handler threads) -----------------
     def begin_request(self) -> None:
@@ -456,6 +516,11 @@ class ServeApp:
         the stream continues — one late decision must not kill a live
         session.  Caller holds ``session.lock``.
         """
+        # Session windows classify under the zoo's DEFAULT tenant (the
+        # same model an unaddressed /predict uses); single-model serving
+        # keeps tenant 0.
+        tenant = (self.zoo.tenant_index(self.zoo.default_id)
+                  if self.zoo is not None else 0)
         submitted = []
         for index, start, win in ready:
             t0 = time.perf_counter()
@@ -465,7 +530,7 @@ class ServeApp:
                 # Session windows are priority-class: a live BCI stream's
                 # decisions must never be shed before bulk /predict.
                 fut = self.batcher.submit(win[None], deadline=deadline,
-                                          priority=True)
+                                          priority=True, tenant=tenant)
             except Rejected:
                 fut = None
             submitted.append((index, start, t0, deadline, fut))
@@ -590,26 +655,42 @@ class _ServeHandler(JsonRequestHandler):
             return
         super()._reply_bytes(code, body, content_type)
 
-    def _parse_trials(self, body: bytes) -> np.ndarray:
-        """Trials from a JSON object or raw ``.npz`` bytes (the native
-        ``-trials.npz`` layout: ``X`` holds the (n, C, T) array)."""
+    def _parse_predict_body(self, body: bytes
+                            ) -> tuple[np.ndarray, object, object]:
+        """One decode of a /predict body -> (trials, deadline_ms-or-None,
+        model-spec-or-None).  A multi-MB JSON body is parsed ONCE here —
+        reading deadline and model through separate helpers would
+        json.loads it three times on the hot path.  npz bodies carry
+        deadline/model in headers only."""
         ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
         if ctype == "application/json":
             payload = json.loads(body.decode())
             if not isinstance(payload, dict) or "trials" not in payload:
                 raise ValueError('JSON body must be {"trials": [...]}')
-            return np.asarray(payload["trials"], np.float32)
+            return (np.asarray(payload["trials"], np.float32),
+                    payload.get("deadline_ms"), payload.get("model"))
         with np.load(io.BytesIO(body)) as data:
             if "X" in getattr(data, "files", ()):
-                return np.asarray(data["X"], np.float32)
+                return np.asarray(data["X"], np.float32), None, None
             raise ValueError("npz body carries no 'X' trials array")
 
     # -- routes -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — stdlib naming
         app = self.app
         if self.path == "/healthz":
-            engine = app.registry.engine
-            c, t = engine.geometry
+            # Identity reads are CHEAP by contract: in zoo mode they come
+            # from the zoo's cached accessors, never from the engine
+            # property — a health probe must not trigger a synchronous
+            # engine build for an LRU-evicted default tenant.
+            c, t = app.model_geometry()
+            digest = app.serving_digest()
+            if app.zoo is not None:
+                buckets = list(app.zoo.active_buckets)
+                precision = app.zoo.serving_precision
+            else:
+                engine = app.registry.engine
+                buckets = list(engine.buckets)
+                precision = engine.precision
             # Liveness, not just reachability: an open breaker or a stale
             # worker heartbeat degrades healthz to 503 so an external
             # orchestrator (LB health checks, the supervisor) can pull
@@ -633,6 +714,7 @@ class _ServeHandler(JsonRequestHandler):
                 slo_state = app.slo.state()
                 degraded.extend(f"slo:{name}" for name in app.slo.breached)
             q = app.journal.metrics.quantile
+            zoo_snap = app.zoo.snapshot() if app.zoo is not None else None
             self._reply(503 if degraded else 200, {
                 "status": "degraded" if degraded else "ok",
                 "degraded": degraded,
@@ -650,20 +732,20 @@ class _ServeHandler(JsonRequestHandler):
                     "threshold_s": verdict.threshold_s,
                     "stale": verdict.stale},
                 "checkpoint": app.checkpoint,
-                "model_digest": engine.digest,
+                "model_digest": digest,
                 # The fleet router's membership poll reads these two:
                 # variables_digest verifies canary identity (which weights
                 # this replica actually serves), the queue depths feed
                 # least-loaded dispatch — no separate endpoint needed.
-                "variables_digest": engine.digest,
+                "variables_digest": digest,
                 "geometry": {"n_channels": c, "n_times": t},
                 # The ACTIVE ladder (a retune moves it) + the precision
                 # actually serving — the fleet membership poll mirrors
                 # both into each replica's snapshot.
-                "buckets": list(engine.buckets),
+                "buckets": buckets,
                 "max_batch": app.batcher.max_batch,
                 "max_wait_ms": round(app.batcher.max_wait_s * 1000.0, 3),
-                "precision": engine.precision,
+                "precision": precision,
                 "requested_precision": app.registry.precision,
                 "ladder_retunes": app.ladder_retunes,
                 "queue_depth_trials": app.batcher.queue_depth,
@@ -672,6 +754,13 @@ class _ServeHandler(JsonRequestHandler):
                 # static queue cliff): the live AIMD limit + shed count.
                 "admission": (app.admission.snapshot()
                               if app.admission is not None else None),
+                # Multi-tenant zoo state (null for single-model serving):
+                # per-tenant id/digest/precision/residency/recency plus
+                # the stacked one-program engine's identity.  The fleet
+                # membership poll mirrors the tenant count into each
+                # replica's snapshot.
+                "zoo": zoo_snap,
+                "tenants": zoo_snap["tenants"] if zoo_snap else None,
                 "model_swaps": app.registry.swaps})
             return
         if self.path == "/metrics":
@@ -759,11 +848,12 @@ class _ServeHandler(JsonRequestHandler):
             try:
                 with trace.span("http.parse", journal=app.journal):
                     body = self._read_body()
-                    x = self._parse_trials(body)
-                deadline_ms = self._deadline_ms(self._payload_deadline(body))
+                    x, payload_deadline, payload_model = \
+                        self._parse_predict_body(body)
+                deadline_ms = self._deadline_ms(payload_deadline)
                 if x.ndim == 2:
                     x = x[None]
-                c, t = app.registry.engine.geometry
+                c, t = app.model_geometry()
                 if x.ndim != 3 or x.shape[1:] != (c, t):
                     raise ValueError(
                         f"expected trials shaped (n, {c}, {t}), got "
@@ -772,6 +862,34 @@ class _ServeHandler(JsonRequestHandler):
                 app.record_request(0, (time.perf_counter() - t0) * 1000.0,
                                    "bad_request")
                 self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+                return
+            # Model addressing: the X-Model header wins, else the JSON
+            # body's "model" field; absent means the default tenant.  An
+            # unknown id is 404 — the request is well-formed, the name
+            # just doesn't resolve in this zoo.
+            model_spec = self.headers.get("X-Model")
+            if model_spec is None:
+                model_spec = payload_model
+            model_id, tenant = None, 0
+            if app.zoo is not None:
+                try:
+                    model_id = app.zoo.resolve(model_spec)
+                    tenant = app.zoo.tenant_index(model_id)
+                except KeyError as exc:
+                    app.record_request(
+                        len(x), (time.perf_counter() - t0) * 1000.0,
+                        "bad_model")
+                    self._reply(404, {"error": str(exc.args[0]),
+                                      "tenants": app.zoo.tenant_ids})
+                    return
+            elif model_spec not in (None, "", "default"):
+                app.record_request(
+                    len(x), (time.perf_counter() - t0) * 1000.0,
+                    "bad_model")
+                self._reply(404, {
+                    "error": f"model {model_spec!r} requested but no "
+                             "model zoo is configured (single-model "
+                             "server; start with --zoo)"})
                 return
             deadline = (None if deadline_ms is None
                         else time.monotonic() + deadline_ms / 1000.0)
@@ -782,7 +900,7 @@ class _ServeHandler(JsonRequestHandler):
                 in ("high", "control", "session")
             try:
                 fut = app.batcher.submit(x, deadline=deadline,
-                                         priority=priority)
+                                         priority=priority, tenant=tenant)
                 # Once enqueued, probe reconciliation moves to the
                 # future's own resolution (not this handler): if the
                 # request is shed before any forward runs — expired at
@@ -839,11 +957,16 @@ class _ServeHandler(JsonRequestHandler):
                               "latency_ms": round(latency_ms, 3)})
             return
         app.record_request(len(x), latency_ms, "ok")
-        self._reply(200, {
+        reply = {
             "predictions": [int(p) for p in preds],
             "class_names": list(CLASS_NAMES), "n": len(x),
             "latency_ms": round(latency_ms, 3),
-            "model_digest": app.registry.engine.digest})
+            "model_digest": (app.zoo.digest_for(model_id)
+                             if app.zoo is not None
+                             else app.registry.engine.digest)}
+        if model_id is not None:
+            reply["model"] = model_id
+        self._reply(200, reply)
 
     def _reconcile_probe(self, fut) -> None:
         """Done-callback for submitted predict futures: release the
@@ -857,22 +980,31 @@ class _ServeHandler(JsonRequestHandler):
         if isinstance(exc, (DeadlineExceeded, Rejected)):
             self.app.breaker.cancel_probe()
 
-    def _payload_deadline(self, body: bytes):
-        """``deadline_ms`` from a JSON body (None for npz bodies — raw
-        trial uploads carry the deadline in the header)."""
-        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
-        if ctype != "application/json":
-            return None
-        try:
-            payload = json.loads(body.decode())
-        except (ValueError, UnicodeDecodeError):
-            return None  # _parse_trials already rejected it with a 400
-        return payload.get("deadline_ms") if isinstance(payload, dict) \
-            else None
-
     def _reload(self, app: ServeApp) -> None:
         try:
             payload = json.loads(self._read_body().decode() or "{}")
+            if app.zoo is not None:
+                # Zoo reload swaps ONE tenant's weights and restacks off
+                # the hot path (zero drops — the PR-3 swap shape, one
+                # level up).  "model" defaults to the default tenant; an
+                # omitted checkpoint re-pushes THAT tenant's own file
+                # (never another tenant's weights under its name).
+                model_id = app.zoo.resolve(payload.get("model"))
+                checkpoint = (payload.get("checkpoint")
+                              or app.zoo.checkpoint_for(model_id))
+                digest = app.zoo.reload(model_id, checkpoint)
+                if model_id == app.zoo.default_id:
+                    # /healthz advertises the default tenant's file; a
+                    # default-tenant reload must move it too.
+                    app.checkpoint = str(checkpoint)
+                self._reply(200, {
+                    "status": "ok", "model": model_id,
+                    "checkpoint": str(checkpoint),
+                    "model_digest": digest,
+                    "stacked": app.zoo.stacked is not None,
+                    "zoo_restacks": app.zoo.restacks,
+                    "model_swaps": app.registry.swaps})
+                return
             checkpoint = payload.get("checkpoint") or app.checkpoint
             engine = app.registry.reload(checkpoint)
         except Exception as exc:  # noqa: BLE001 — reload must not kill serving
@@ -898,7 +1030,7 @@ class _ServeHandler(JsonRequestHandler):
             if not isinstance(payload, dict):
                 raise ValueError("body must be a JSON object")
             sid = payload.get("session") or os.urandom(6).hex()
-            c, t = app.registry.engine.geometry
+            c, t = app.model_geometry()
             window = int(payload.get("window", t))
             if window != t:
                 raise ValueError(
@@ -991,7 +1123,7 @@ class _ServeHandler(JsonRequestHandler):
                 tail = [d.as_json() for d in session.decisions[-16:]]
                 self._reply(200, self._session_json(
                     session, decisions_tail=tail,
-                    model_digest=app.registry.engine.digest))
+                    model_digest=app.serving_digest()))
         finally:
             app.end_request()
 
@@ -1042,9 +1174,31 @@ def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Online EEG inference service (warm-compiled engine, "
                     "dynamic micro-batching, model hot-reload).")
-    parser.add_argument("--checkpoint", required=True,
+    parser.add_argument("--checkpoint", default=None,
                         help=".npz (native), an Orbax checkpoint directory, "
-                             "or .pth (reference format).")
+                             "or .pth (reference format).  Required unless "
+                             "--zoo is given.")
+    parser.add_argument("--zoo", default=None,
+                        help="Multi-tenant model zoo: 'id=path,id=path' "
+                             "pairs or a directory of checkpoints (each "
+                             "*.npz/*.pth becomes a tenant keyed by file "
+                             "stem).  Requests then address a model via "
+                             "the X-Model header / 'model' JSON field; "
+                             "same-architecture tenants serve through ONE "
+                             "stacked compiled program per bucket.")
+    parser.add_argument("--defaultModel", default=None,
+                        help="The tenant answering requests that name no "
+                             "model (default: the zoo's first entry).")
+    parser.add_argument("--maxPrograms", type=int, default=0,
+                        help="Compiled-program budget for resident "
+                             "per-model engines (each costs one program "
+                             "per bucket); LRU tenants evict past it.  "
+                             "0 = unbounded.  The stacked engine is "
+                             "exempt — it is the budget's point.")
+    parser.add_argument("--noStack", action="store_true",
+                        help="Serve the zoo through per-model engines "
+                             "only (skip the stacked one-program "
+                             "forward).")
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8790,
                         help="Listen port (0 = ephemeral).")
@@ -1133,6 +1287,27 @@ def main(argv=None) -> int:
                              "nothing restored.")
     args = parser.parse_args(argv)
 
+    if bool(args.checkpoint) == bool(args.zoo):
+        # Same rule as the predict CLI: both given would silently ignore
+        # --checkpoint (the zoo serves its own tenants), neither serves
+        # nothing.
+        parser.error("exactly one of --checkpoint or --zoo is required")
+
+    zoo_spec = None
+    if args.zoo:
+        from eegnetreplication_tpu.serve.zoo import parse_zoo_spec
+
+        try:
+            # Parse-time strictness: a malformed zoo spec fails HERE,
+            # not after the journal opened and engines started building.
+            zoo_spec = parse_zoo_spec(args.zoo)
+            if args.defaultModel and args.defaultModel not in zoo_spec:
+                raise ValueError(
+                    f"--defaultModel {args.defaultModel!r} is not a zoo "
+                    f"tenant (have {list(zoo_spec)})")
+        except ValueError as exc:
+            parser.error(f"--zoo: {exc}")
+
     try:
         buckets = (tuple(sorted({int(b) for b in args.buckets.split(",")}))
                    if args.buckets else DEFAULT_BUCKETS)
@@ -1179,7 +1354,10 @@ def main(argv=None) -> int:
                        slo_spec=args.slo,
                        slo_window_s=args.sloWindowS,
                        admission_target_ms=args.admissionTargetMs,
-                       chaos_tag=args.chaosTag)
+                       chaos_tag=args.chaosTag,
+                       zoo=zoo_spec, default_model=args.defaultModel,
+                       max_programs=args.maxPrograms,
+                       stack=not args.noStack)
         app.start()
         print(f"serving at {app.url}", flush=True)
         serve_until_preempted(app)

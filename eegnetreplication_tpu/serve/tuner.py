@@ -152,6 +152,13 @@ class LadderTuner:
     ``start()`` runs it on a background thread every ``interval_s``.
     ``apply()`` is public so benches/tests can drive a forced retune
     through the exact swap machinery the autonomous path uses.
+
+    ``registry`` is anything with the swap surface the tuner drives —
+    the single-model :class:`~eegnetreplication_tpu.serve.registry.ModelRegistry`
+    or the multi-tenant :class:`~eegnetreplication_tpu.serve.registry.ModelZoo`
+    (whose ``retune`` rebuilds the stacked one-program engine on the new
+    ladder off the hot path; occupancy is ladder-wide either way, since
+    every tenant shares the one bucket ladder).
     """
 
     def __init__(self, registry, batcher, *, journal=None,
@@ -231,8 +238,8 @@ class LadderTuner:
         would burn seconds of device time for nothing; the batcher adopts
         the new window live.
         """
-        old_engine = self.registry.engine
-        old_buckets = old_engine.buckets
+        old_buckets = self.registry.active_buckets
+        old_precision = self.registry.serving_precision
         old_wait_ms = self.batcher.max_wait_s * 1000.0
         t0 = time.perf_counter()
         ladder_changed = tuple(proposal.buckets) != tuple(old_buckets)
@@ -251,7 +258,7 @@ class LadderTuner:
             new_buckets=list(proposal.buckets), reason=proposal.reason,
             old_max_wait_ms=round(old_wait_ms, 3),
             new_max_wait_ms=round(proposal.max_wait_ms, 3),
-            precision=old_engine.precision,
+            precision=old_precision,
             dispatches=(stats.dispatches if stats else None),
             arrival_trials_per_s=(round(stats.arrival_trials_per_s, 2)
                                   if stats else None),
@@ -268,7 +275,10 @@ class LadderTuner:
         — a tuner bug must not take serving down."""
         try:
             stats = self.collect()
-            current = self.registry.engine.buckets
+            # active_buckets, not engine.buckets: the zoo's engine
+            # property may synchronously BUILD an evicted default-tenant
+            # engine, and a ladder read on the tune tick must stay cheap.
+            current = self.registry.active_buckets
             proposal = propose(stats, current,
                                self.batcher.max_wait_s * 1000.0,
                                min_dispatches=self.min_dispatches,

@@ -1,0 +1,398 @@
+"""Tenant-stacked serving engine + the stacked equivalence gate.
+
+The :class:`~eegnetreplication_tpu.serve.registry.ModelZoo` holds N
+same-architecture models (the paper's nine per-subject EEGNets); this
+module provides the piece that collapses their hot path into ONE
+program: a :class:`StackedEngine` whose jitted forward takes
+``(trials, tenant_idx)`` and serves a *mixed-tenant* coalesced batch in
+a single gather+forward (``ops/stacked.py``), so the compiled-program
+count stays constant in the number of tenants — one executable per
+bucket whether the stack holds one model or nine.
+
+A stacked variant may only serve after :func:`run_stack_gate` confirmed,
+**per tenant**, that its argmax matches that tenant's unstacked fp32
+reference on the gate set — the same refuse-and-keep-serving shape as
+the int8 quant gate (``serve/engine.py``): a refusal journals the
+verdict and the zoo falls back to per-model engines, never to an
+outage.  fp32 stacks are held to exact agreement (the vmapped forward
+is the same computation; a disagreement means something is genuinely
+wrong), int8 stacks to the configured quant floor.
+
+``parse_zoo_spec`` is the one model-addressing parser shared by the
+server CLI (``--zoo``) and the predict CLI (``--zoo --model``), so the
+two surfaces cannot resolve the same id to different checkpoints.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import trace
+from eegnetreplication_tpu.ops import stacked as ops_stacked
+from eegnetreplication_tpu.serve.engine import (
+    DEFAULT_BUCKETS,
+    QUANT_AGREEMENT_FLOOR,
+    InferenceEngine,
+    default_gate_set,
+    variables_digest,
+)
+from eegnetreplication_tpu.utils.logging import logger
+
+# Per-tenant argmax-agreement floors for the stacked gate: fp32 stacking
+# is the same math (vmap of the same forward), so anything short of
+# exact agreement is a real defect; int8 stacking inherits the quant
+# gate's floor (per-tenant-per-channel scales make a stacked tenant's
+# quantization identical to its standalone one).
+STACK_FLOOR_FP32 = 1.0
+STACK_FLOOR_INT8 = QUANT_AGREEMENT_FLOOR
+
+
+def parse_zoo_spec(spec) -> dict[str, Path]:
+    """``{model_id: checkpoint_path}`` from the shared addressing spec.
+
+    Accepts a mapping (passed through), a comma-separated
+    ``id=path,id=path`` string, or a directory whose ``*.npz`` /
+    ``*.pth`` checkpoints become tenants keyed by file stem (subject
+    checkpoints like ``subject_01_best_model.npz`` keep their stem as
+    the id).  Order is preserved (insertion / name-sorted for a
+    directory): it defines each tenant's index in the stack.
+    """
+    if hasattr(spec, "items"):
+        out = {str(k): Path(v) for k, v in spec.items()}
+    else:
+        text = str(spec)
+        p = Path(text)
+        if "=" not in text and p.is_dir():
+            out = {f.stem: f for f in sorted(
+                list(p.glob("*.npz")) + list(p.glob("*.pth")))}
+            if not out:
+                raise ValueError(f"zoo directory {p} holds no .npz/.pth "
+                                 "checkpoints")
+        else:
+            out = {}
+            for part in text.split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                if "=" not in part:
+                    raise ValueError(
+                        f"zoo spec entry {part!r} is not id=path "
+                        "(or pass a checkpoint directory)")
+                mid, _, path = part.partition("=")
+                mid = mid.strip()
+                if not mid or not path.strip():
+                    raise ValueError(f"zoo spec entry {part!r} has an "
+                                     "empty id or path")
+                if mid in out:
+                    raise ValueError(f"duplicate zoo model id {mid!r}")
+                out[mid] = Path(path.strip())
+    if not out:
+        raise ValueError("zoo spec names no models")
+    return out
+
+
+def looks_like_digest(spec: str) -> bool:
+    """Whether a model spec is plausibly a variables-digest prefix
+    (>= 8 hex chars) rather than a tenant id."""
+    return (len(spec) >= 8
+            and all(ch in "0123456789abcdef" for ch in spec.lower()))
+
+
+def resolve_model_id(tenant_ids: list[str], spec: str | None,
+                     default_id: str,
+                     digests: dict[str, str | None]) -> str:
+    """The one model-addressing resolution (ModelZoo.resolve and the
+    predict CLI both route through here, so server and CLI cannot
+    resolve the same spec differently): ``None``/``""``/``"default"`` is
+    the default tenant, an exact zoo key wins next, then an unambiguous
+    variables-digest prefix among tenants whose digest is known."""
+    if spec is None or spec == "" or spec == "default":
+        return default_id
+    spec = str(spec)
+    if spec in tenant_ids:
+        return spec
+    if looks_like_digest(spec):
+        matches = [mid for mid in tenant_ids
+                   if digests.get(mid) is not None
+                   and digests[mid].startswith(spec.lower())]
+        if len(matches) == 1:
+            return matches[0]
+        if len(matches) > 1:
+            raise KeyError(f"digest prefix {spec!r} is ambiguous: "
+                           f"{matches}")
+    raise KeyError(f"unknown model {spec!r}; zoo tenants: {tenant_ids}")
+
+
+class StackedEngine(InferenceEngine):
+    """N congruent models pre-compiled as ONE bucketed tenant-gathered
+    forward: ``infer(trials, tenant_idx)``.
+
+    Construction stacks nothing itself — it receives the stacked trees
+    (``ops/stacked.py``) plus the tenant order, builds the fp32 or int8
+    jitted forward, and reuses the base engine's bucket warmup (compile
+    events journal as ``zoo_forward[_int8]_b<bucket>``; their count is
+    the constant-in-tenants proof the bench records).
+    """
+
+    WHAT_PREFIX = "zoo_forward"
+
+    def __init__(self, model, tenant_ids, stacked_params,
+                 stacked_batch_stats,
+                 buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                 precision: str = "fp32",
+                 tenant_digests: dict[str, str] | None = None,
+                 journal=None):
+        import jax
+        import jax.numpy as jnp
+
+        if not tenant_ids:
+            raise ValueError("a stacked engine needs at least one tenant")
+        if not buckets or list(buckets) != sorted(set(buckets)) \
+                or buckets[0] < 1:
+            raise ValueError(
+                f"buckets must be strictly increasing positive ints, got "
+                f"{buckets!r}")
+        if precision not in ("fp32", "int8"):
+            raise ValueError(f"precision must be fp32 or int8, got "
+                             f"{precision!r}")
+        self.model = model
+        self.tenant_ids = list(tenant_ids)
+        self.params = stacked_params          # the STACKED tree (Z, ...)
+        self.batch_stats = stacked_batch_stats
+        self.buckets = tuple(int(b) for b in buckets)
+        self.precision = precision
+        self.source = None
+        # The engine digest identifies the whole stack (what a /healthz
+        # reader compares); per-tenant fp32 digests stay addressable via
+        # tenant_digests so digest-addressed requests resolve.
+        self.digest = variables_digest(stacked_params, stacked_batch_stats)
+        self.tenant_digests = dict(tenant_digests or {})
+        self.quantized_digest = None
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._lock = threading.Lock()
+        self._jnp = jnp
+        if precision == "int8":
+            from eegnetreplication_tpu.ops import quant
+
+            self.qparams = quant.quantize_params(stacked_params,
+                                                 stacked=True)
+            self.quantized_digest = quant.qparams_digest(self.qparams)
+            qp, bs = self.qparams, stacked_batch_stats
+            self._fwd = jax.jit(lambda xx, tt: jnp.argmax(
+                ops_stacked.stacked_quantized_eval_forward(
+                    model, qp, bs, xx, tt), axis=-1))
+        else:
+            sp, bs = stacked_params, stacked_batch_stats
+            self._fwd = jax.jit(lambda xx, tt: jnp.argmax(
+                ops_stacked.stacked_eval_forward(model, sp, bs, xx, tt),
+                axis=-1))
+        self._warmed = False
+
+    @classmethod
+    def from_members(cls, members: list[tuple[str, object, dict, dict]],
+                     buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                     precision: str = "fp32",
+                     journal=None) -> "StackedEngine":
+        """Stack ``[(model_id, model, params, batch_stats), ...]`` —
+        raises ``ValueError`` when the trees are not congruent (mixed
+        architectures cannot stack; the zoo then serves per-model)."""
+        model = members[0][1]
+        for mid, m, _, _ in members[1:]:
+            if (m.n_channels, m.n_times) != (model.n_channels,
+                                             model.n_times):
+                raise ValueError(
+                    f"tenant {mid!r} geometry "
+                    f"({m.n_channels}, {m.n_times}) != stack geometry "
+                    f"({model.n_channels}, {model.n_times})")
+        stacked_params = ops_stacked.stack_trees([p for _, _, p, _ in
+                                                  members])
+        stacked_stats = ops_stacked.stack_trees([b for _, _, _, b in
+                                                 members])
+        digests = {mid: variables_digest(p, b)
+                   for mid, _, p, b in members}
+        return cls(model, [mid for mid, _, _, _ in members],
+                   stacked_params, stacked_stats, buckets,
+                   precision=precision, tenant_digests=digests,
+                   journal=journal)
+
+    @property
+    def n_tenants(self) -> int:
+        return len(self.tenant_ids)
+
+    def _warm_args(self, b: int) -> tuple:
+        c, t = self.geometry
+        return (self._jnp.zeros((b, c, t), self._jnp.float32),
+                self._jnp.zeros((b,), self._jnp.int32))
+
+    def infer(self, trials: np.ndarray,
+              tenant_idx: np.ndarray | int = 0) -> np.ndarray:
+        """Class predictions for ``(n, C, T)`` trials whose i-th row
+        belongs to tenant ``tenant_idx[i]`` (a scalar broadcasts).
+        Thread-safe; padding replicates the last real row AND its tenant
+        index, so padded rows run a real tenant's program slice and are
+        dropped after argmax exactly like the single-model engine."""
+        x = np.asarray(trials, np.float32)
+        if x.ndim == 2:
+            x = x[None]
+        c, t = self.geometry
+        if x.ndim != 3 or x.shape[1:] != (c, t):
+            raise ValueError(
+                f"expected trials shaped (n, {c}, {t}), got {x.shape}")
+        n = len(x)
+        tid = np.broadcast_to(np.asarray(tenant_idx, np.int32), (n,)) \
+            .astype(np.int32, copy=True)
+        if n and (tid.min() < 0 or tid.max() >= self.n_tenants):
+            raise ValueError(
+                f"tenant index out of range [0, {self.n_tenants}): "
+                f"{sorted(set(tid.tolist()))[:8]}")
+        if n == 0:
+            return np.zeros(0, np.int64)
+        out = np.empty(n, np.int64)
+        top = self.buckets[-1]
+        with self._lock:
+            for start in range(0, n, top):
+                chunk, tchunk = x[start:start + top], tid[start:start + top]
+                k = len(chunk)
+                b = self.bucket_for(k)
+                with trace.span("engine.forward", journal=self._journal,
+                                bucket=b, n_real=k, padded=b - k,
+                                precision=self.precision,
+                                tenants=int(len(np.unique(tchunk)))):
+                    if k < b:
+                        chunk = np.concatenate(
+                            [chunk, np.repeat(chunk[-1:], b - k, axis=0)])
+                        tchunk = np.concatenate(
+                            [tchunk, np.repeat(tchunk[-1:], b - k)])
+                    preds = np.asarray(self._fwd(
+                        self._jnp.asarray(chunk),
+                        self._jnp.asarray(tchunk)))
+                out[start:start + k] = preds[:k]
+                self._journal.metrics.observe("bucket_fill", k / b,
+                                              bucket=str(b))
+        return out
+
+
+@dataclass(frozen=True)
+class StackGateResult:
+    """Outcome of one stacked-vs-unstacked per-tenant equivalence check."""
+
+    outcome: str                      # "pass" | "refused"
+    agreement: float                  # overall fraction of agreeing trials
+    per_tenant: dict[str, float] = field(default_factory=dict)
+    floor: float = STACK_FLOOR_FP32
+    n_trials: int = 0
+    precision: str = "fp32"
+    gate_source: str = "synthetic"
+
+    @property
+    def passed(self) -> bool:
+        return self.outcome == "pass"
+
+
+def run_stack_gate(references: dict[str, InferenceEngine],
+                   candidate: StackedEngine,
+                   gate_set: list[tuple[str, np.ndarray]] | None = None, *,
+                   floor: float | None = None,
+                   journal=None) -> StackGateResult:
+    """Mandatory per-tenant equivalence check before a stacked engine may
+    serve.
+
+    ``references`` maps every tenant id to its UNSTACKED fp32 engine.
+    Each tenant's gate trials run through the stacked forward (with that
+    tenant's index on every row) and through its reference; ANY tenant
+    below the floor refuses the whole stack — one misassembled tenant
+    must not serve just because eight siblings stacked cleanly.  The
+    verdict is journaled as a ``stack_gate`` event either way.
+    """
+    journal = journal if journal is not None else obs_journal.current()
+    if floor is None:
+        floor = (STACK_FLOOR_INT8 if candidate.precision == "int8"
+                 else STACK_FLOOR_FP32)
+    c, t = candidate.geometry
+    source = "caller"
+    if gate_set is None:
+        source, gate_set = default_gate_set(c, t)
+    per_tenant: dict[str, float] = {}
+    agree_total = 0
+    n_total = 0
+    for z, mid in enumerate(candidate.tenant_ids):
+        ref_engine = references[mid]
+        agree = n = 0
+        for _, x in gate_set:
+            ref = ref_engine.infer(x)
+            got = candidate.infer(x, np.full(len(x), z, np.int32))
+            agree += int(np.sum(ref == got))
+            n += len(x)
+        per_tenant[mid] = agree / max(n, 1)
+        agree_total += agree
+        n_total += n
+    agreement = agree_total / max(n_total, 1)
+    outcome = "pass" if (n_total and
+                         min(per_tenant.values()) >= floor) else "refused"
+    result = StackGateResult(outcome=outcome, agreement=agreement,
+                             per_tenant=per_tenant, floor=floor,
+                             n_trials=n_total,
+                             precision=candidate.precision,
+                             gate_source=source)
+    journal.event("stack_gate", precision=candidate.precision,
+                  outcome=outcome, agreement=round(agreement, 6),
+                  per_tenant={k: round(v, 6) for k, v in
+                              per_tenant.items()},
+                  floor=floor, n_trials=n_total, gate_source=source,
+                  n_tenants=candidate.n_tenants,
+                  digest=candidate.digest,
+                  quantized_digest=candidate.quantized_digest)
+    journal.metrics.set("stack_gate_agreement", agreement)
+    (logger.info if outcome == "pass" else logger.warning)(
+        "Stack gate %s: %s stacked vs unstacked fp32 argmax agreement "
+        "%.4f over %d trials x %d tenants (%s, floor %.3f)",
+        outcome.upper(), candidate.precision, agreement, n_total,
+        candidate.n_tenants, source, floor)
+    return result
+
+
+def build_stacked_engine(members: list[tuple[str, object, dict, dict]],
+                         buckets: tuple[int, ...] = DEFAULT_BUCKETS, *,
+                         precision: str = "fp32",
+                         gate_set: list[tuple[str, np.ndarray]] | None
+                         = None,
+                         floor: float | None = None, warm: bool = True,
+                         journal=None
+                         ) -> tuple[StackedEngine | None, StackGateResult]:
+    """Stack ``members``, gate the result per tenant, warm it on pass.
+
+    Returns ``(engine, gate)`` — ``engine`` is ``None`` on a refusal
+    (the zoo then serves per-model engines: refuse-and-keep-serving).
+    The fp32 reference engines used by the gate are throwaways (unwarmed;
+    they compile only the buckets the gate trials need) and are dropped
+    on return — the stacked engine is the only thing held warm.
+    """
+    t0 = time.perf_counter()
+    candidate = StackedEngine.from_members(members, buckets,
+                                           precision=precision,
+                                           journal=journal)
+    references = {mid: InferenceEngine(model, params, bstats, buckets,
+                                       precision="fp32", journal=journal)
+                  for mid, model, params, bstats in members}
+    gate = run_stack_gate(references, candidate, gate_set, floor=floor,
+                          journal=journal)
+    if not gate.passed:
+        logger.warning(
+            "Stacked %s engine refused by the stack gate (min per-tenant "
+            "agreement %.4f < floor %.3f); serving per-model engines",
+            precision, min(gate.per_tenant.values(), default=0.0),
+            gate.floor)
+        return None, gate
+    if warm:
+        candidate.warmup()
+    logger.info("Stacked %s engine over %d tenants ready in %.2fs "
+                "(buckets %s)", precision, candidate.n_tenants,
+                time.perf_counter() - t0, candidate.buckets)
+    return candidate, gate

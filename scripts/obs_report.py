@@ -105,6 +105,9 @@ _COLUMNS = (
     # times the LadderTuner swapped the compile ladder under load.
     ("precision", "prec"), ("quant_agreement", "quant_agree"),
     ("ladder_retunes", "retunes"),
+    # Multi-tenant zoo: how many models this serving run addressed
+    # (single-model rows show "-") and its restack count under reloads.
+    ("tenants", "tenants"), ("zoo_restacks", "restacks"),
     # Supervision & liveness: supervisor restarts/hang detections (from
     # supervisor_* events), expired-deadline drops and circuit-breaker
     # trips (from request/circuit_state events).

@@ -21,6 +21,14 @@ gated engine builder, so whatever the equivalence gate decides — serve
 int8 or fall back to fp32 — they must decide identically.
 ``--skip-int8`` restricts the run to the fp32 legs.
 
+``--zoo-checkpoint`` adds the MULTI-TENANT legs: a two-tenant zoo server
+(``a`` = --checkpoint, ``b`` = --zoo-checkpoint, one stacked program)
+answers the same trials addressed per tenant via ``X-Model``, and each
+tenant's served predictions must byte-match ``predict_trials`` on that
+tenant's checkpoint AND the ``predict --zoo ... --model <id>`` CLI line
+— server and CLI resolve model ids through the same
+``serve/zoo.parse_zoo_spec``/``resolve_model_id`` by construction.
+
 Exit 0 on PASS.  Wired as the ``serve-smoke`` leg of
 ``scripts/rehearsal_product_path.py`` and exercised CI-sized by
 ``tests/test_serve.py``.
@@ -58,12 +66,41 @@ def served_predictions(checkpoint: str, trials_path: Path,
         app.stop()
 
 
+def zoo_served_predictions(zoo_spec: dict, trials_path: Path
+                           ) -> dict[str, list[int]]:
+    """Round-trip the trials through ONE zoo server, once per tenant
+    (X-Model addressing over the stacked one-program hot path)."""
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    app = ServeApp(zoo=zoo_spec).start()
+    out: dict[str, list[int]] = {}
+    try:
+        for model_id in zoo_spec:
+            req = urllib.request.Request(
+                app.url + "/predict", data=trials_path.read_bytes(),
+                headers={"Content-Type": "application/octet-stream",
+                         "X-Model": model_id})
+            resp = json.loads(urllib.request.urlopen(req,
+                                                     timeout=120).read())
+            if resp.get("model") != model_id:
+                raise RuntimeError(f"served model {resp.get('model')!r} "
+                                   f"!= requested {model_id!r}")
+            out[model_id] = resp["predictions"]
+    finally:
+        app.stop()
+    return out
+
+
 def cli_stdout_line(checkpoint: str, trials_path: Path,
-                    precision: str = "fp32") -> str:
+                    precision: str = "fp32",
+                    zoo: str | None = None, model: str | None = None
+                    ) -> str:
     """Last stdout line of the real predict CLI subprocess."""
+    source = (["--zoo", zoo, "--model", model] if zoo
+              else ["--checkpoint", checkpoint])
     proc = subprocess.run(
         [sys.executable, "-m", "eegnetreplication_tpu.predict",
-         "--checkpoint", checkpoint, "--input", str(trials_path),
+         *source, "--input", str(trials_path),
          "--precision", precision],
         cwd=REPO, capture_output=True, text=True, timeout=600,
         env={**os.environ,
@@ -98,6 +135,11 @@ def main(argv=None) -> int:
                         help="Skip the subprocess leg (CI-sized runs).")
     parser.add_argument("--skip-int8", action="store_true",
                         help="Skip the quantized-path byte-match legs.")
+    parser.add_argument("--zoo-checkpoint", default=None,
+                        help="A second (same-geometry) checkpoint: adds "
+                             "the two-tenant zoo byte-match legs "
+                             "(stacked server X-Model vs per-tenant "
+                             "predict_trials vs predict --zoo --model).")
     args = parser.parse_args(argv)
 
     from eegnetreplication_tpu.utils.platform import select_platform
@@ -156,6 +198,31 @@ def main(argv=None) -> int:
                       f"{want!r}")
                 return 1
             print(f"int8 CLI line byte-match: {got!r}")
+
+    if args.zoo_checkpoint:
+        zoo_spec = {"a": args.checkpoint, "b": args.zoo_checkpoint}
+        served_zoo = zoo_served_predictions(zoo_spec, trials_path)
+        zoo_arg = ",".join(f"{k}={v}" for k, v in zoo_spec.items())
+        for model_id, ckpt in zoo_spec.items():
+            got = np.asarray(served_zoo[model_id], np.int64)
+            m, p, b = load_model_from_checkpoint(ckpt)
+            want = predict_trials(m, p, b, x)
+            if not np.array_equal(got, want):
+                diff = int(np.sum(got != want))
+                print(f"FAIL: zoo tenant {model_id!r} served predictions "
+                      f"differ from predict_trials on {diff}/{len(x)} "
+                      "trials")
+                return 1
+            if not args.skip_cli:
+                line = cli_stdout_line(ckpt, trials_path,
+                                       zoo=zoo_arg, model=model_id)
+                want_line = expected_line(got, y)
+                if line != want_line:
+                    print(f"FAIL: zoo CLI stdout {line!r} != "
+                          f"served-derived {want_line!r}")
+                    return 1
+        print(f"zoo byte-match: {len(zoo_spec)} tenants x {len(x)} "
+              "predictions (stacked server == per-tenant CLI)")
 
     print("SERVE SMOKE PASS")
     return 0

@@ -57,6 +57,7 @@ class FakeReplica:
         # reload_fn(checkpoint) -> (status, digest-or-error)
         self.reload_fn = lambda ck: (200, "d-new")
         self.slo_breached: list[str] = []     # advertised on /healthz
+        self.zoo = None                       # zoo advert (dict) or None
         self.log: list[tuple[str, bytes]] = []
         self.headers_log: list[dict] = []     # per-/predict request headers
         fake = self
@@ -91,6 +92,7 @@ class FakeReplica:
                         "precision": fake.precision,
                         "buckets": list(fake.buckets),
                         "slo": {"breached": list(fake.slo_breached)},
+                        "zoo": fake.zoo,
                         "queue_depth_requests": fake.queue_depth,
                         "queue_depth_trials": fake.queue_depth})
                     return

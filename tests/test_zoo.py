@@ -1,0 +1,710 @@
+"""Multi-tenant zoo (ISSUE 11): one-program vmap-stacked serving.
+
+Covers the acceptance surface: stacked-vs-unstacked parity (fp32 exact,
+int8 at the gate floor), gather-index permutation invariance, the
+single-tenant degenerate case, per-tenant-per-channel stacked int8
+quantization, the stack gate's refuse->per-model fallback, zoo
+addressing (id / digest prefix / default), LRU evict + reload roundtrip
+with ``model_load``/``model_evict``/``zoo_restack`` journaling, the
+weighted-fair tenant dequeue's starvation bound, the zoo HTTP surface
+(X-Model routing, /healthz tenants, per-tenant /reload), the fleet
+membership tenant mirror, and the ``serve_bench.py --zoo`` selftest
+floors plus the committed BENCH_ZOO.json acceptance record.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.obs import journal as obs_journal  # noqa: E402
+from eegnetreplication_tpu.obs import schema  # noqa: E402
+from eegnetreplication_tpu.ops import quant  # noqa: E402
+from eegnetreplication_tpu.ops import stacked as ops_stacked  # noqa: E402
+from eegnetreplication_tpu.serve.batcher import MicroBatcher  # noqa: E402
+from eegnetreplication_tpu.serve.engine import (  # noqa: E402
+    InferenceEngine,
+)
+from eegnetreplication_tpu.serve.registry import ModelZoo  # noqa: E402
+from eegnetreplication_tpu.serve.zoo import (  # noqa: E402
+    StackedEngine,
+    build_stacked_engine,
+    parse_zoo_spec,
+    resolve_model_id,
+    run_stack_gate,
+)
+from eegnetreplication_tpu.training.checkpoint import (  # noqa: E402
+    save_checkpoint,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+C, T = 4, 64
+
+
+def _variables(seed: int = 0):
+    model = EEGNet(n_channels=C, n_times=T)
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, C, T)),
+                           train=False)
+    return model, variables["params"], variables["batch_stats"]
+
+
+def _members(n: int = 3):
+    return [(f"s{i + 1}", *_variables(i)) for i in range(n)]
+
+
+def _checkpoint(tmp_path: Path, seed: int, name: str) -> Path:
+    model, params, bs = _variables(seed)
+    return save_checkpoint(
+        tmp_path / name, params, bs,
+        metadata={"model": "eegnet", "n_channels": C, "n_times": T,
+                  "F1": model.F1, "D": model.D})
+
+
+def _zoo_spec(tmp_path: Path, n: int = 3) -> dict:
+    return {f"s{i + 1}": _checkpoint(tmp_path, i, f"s{i + 1}.npz")
+            for i in range(n)}
+
+
+@pytest.fixture(scope="module")
+def trials():
+    return np.random.RandomState(0).randn(40, C, T).astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def members():
+    return _members(3)
+
+
+@pytest.fixture(scope="module")
+def stacked_fp32(members):
+    return StackedEngine.from_members(members, buckets=(1, 8, 16))
+
+
+class TestStackedOps:
+    def test_stack_trees_roundtrip_via_tenant_slice(self, members):
+        sp = ops_stacked.stack_trees([p for _, _, p, _ in members])
+        for z, (_, _, p, _) in enumerate(members):
+            got = ops_stacked.tenant_slice(sp, z)
+            for (path, a), (_, b) in zip(
+                    ops_stacked.tree_leaves_with_paths(got),
+                    ops_stacked.tree_leaves_with_paths(p)):
+                assert np.array_equal(a, np.asarray(b)), path
+
+    def test_incongruent_trees_refuse_to_stack(self, members):
+        other = EEGNet(n_channels=C + 1, n_times=T)
+        v = other.init(jax.random.PRNGKey(9),
+                       jnp.zeros((1, C + 1, T)), train=False)
+        with pytest.raises(ValueError, match="not stackable"):
+            ops_stacked.stack_trees([members[0][2], v["params"]])
+
+    def test_stacked_quantization_is_per_tenant_per_channel(self, members):
+        """The stacked int8 tree must carry each tenant's OWN scales:
+        slicing tenant z out of the stacked quantization equals
+        quantizing tenant z alone (up to the broadcast keepdims shape)."""
+        sp = ops_stacked.stack_trees([p for _, _, p, _ in members])
+        sq = quant.quantize_params(sp, stacked=True)
+        for z, (_, _, p, _) in enumerate(members):
+            alone = quant.quantize_params(p)
+            sliced = ops_stacked.tenant_slice(sq, z)
+
+            def walk(a, b, path=""):
+                if quant.is_qleaf(a):
+                    assert np.array_equal(a["q"], b["q"]), path
+                    assert np.array_equal(
+                        a["scale"],
+                        np.asarray(b["scale"]).reshape(a["scale"].shape)
+                    ), path
+                    return
+                if hasattr(a, "items"):
+                    for k in a:
+                        walk(a[k], b[k], f"{path}/{k}")
+                    return
+                # fp32 passthrough leaves (BN/bias) stack untouched.
+                assert np.array_equal(np.asarray(a), np.asarray(b)), path
+
+            walk(alone, sliced)
+
+
+class TestStackedParity:
+    def test_fp32_per_tenant_argmax_exact(self, members, stacked_fp32,
+                                          trials):
+        for z, (mid, model, p, b) in enumerate(members):
+            ref = InferenceEngine(model, p, b, (16,)).infer(trials)
+            got = stacked_fp32.infer(trials, np.full(len(trials), z,
+                                                     np.int32))
+            assert np.array_equal(got, ref), mid
+
+    def test_int8_per_tenant_at_gate_floor(self, members, trials):
+        int8 = StackedEngine.from_members(members, buckets=(16,),
+                                          precision="int8")
+        for z, (mid, model, p, b) in enumerate(members):
+            tid = np.full(len(trials), z, np.int32)
+            got = int8.infer(trials, tid)
+            # Exact vs the standalone int8 engine (same quantization by
+            # construction) ...
+            alone = InferenceEngine(model, p, b, (16,), precision="int8")
+            assert np.array_equal(got, alone.infer(trials)), mid
+            # ... and within the quant-gate floor vs the fp32 reference.
+            fp32 = InferenceEngine(model, p, b, (16,)).infer(trials)
+            assert np.mean(got == fp32) >= 0.99, mid
+
+    def test_gather_index_permutation_invariance(self, stacked_fp32,
+                                                 trials):
+        rng = np.random.RandomState(3)
+        tid = rng.randint(0, 3, len(trials)).astype(np.int32)
+        base = stacked_fp32.infer(trials, tid)
+        perm = rng.permutation(len(trials))
+        got = stacked_fp32.infer(trials[perm], tid[perm])
+        assert np.array_equal(got, base[perm])
+
+    def test_single_tenant_degenerate_case(self, members, trials):
+        mid, model, p, b = members[0]
+        one = StackedEngine.from_members([members[0]], buckets=(1, 16))
+        ref = InferenceEngine(model, p, b, (1, 16)).infer(trials)
+        assert np.array_equal(one.infer(trials, 0), ref)
+        assert one.n_tenants == 1
+
+    def test_tenant_index_out_of_range_raises(self, stacked_fp32, trials):
+        with pytest.raises(ValueError, match="tenant index out of range"):
+            stacked_fp32.infer(trials[:2], np.array([0, 3], np.int32))
+
+    def test_scalar_tenant_broadcasts(self, stacked_fp32, members, trials):
+        _, model, p, b = members[1]
+        ref = InferenceEngine(model, p, b, (16,)).infer(trials[:5])
+        assert np.array_equal(stacked_fp32.infer(trials[:5], 1), ref)
+
+
+class TestStackGate:
+    def test_pass_journals_stack_gate(self, members, stacked_fp32,
+                                      trials, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            refs = {mid: InferenceEngine(m, p, b, (16,))
+                    for mid, m, p, b in members}
+            gate = run_stack_gate(refs, stacked_fp32,
+                                  [("t", trials[:16])], journal=jr)
+            events = [e for e in schema.read_events(jr.events_path,
+                                                    complete=False)
+                      if e["event"] == "stack_gate"]
+        assert gate.passed and gate.floor == 1.0
+        assert set(gate.per_tenant) == {"s1", "s2", "s3"}
+        assert all(v == 1.0 for v in gate.per_tenant.values())
+        assert events and events[-1]["outcome"] == "pass"
+        assert events[-1]["n_tenants"] == 3
+
+    def test_mismatched_reference_refuses(self, members, stacked_fp32,
+                                          trials):
+        """A stack that disagrees with a tenant's reference must refuse —
+        here simulated by handing tenant s1 ANOTHER model's reference."""
+        _, m2, p2, b2 = members[1]
+        refs = {mid: InferenceEngine(m, p, b, (16,))
+                for mid, m, p, b in members}
+        refs["s1"] = InferenceEngine(m2, p2, b2, (16,))
+        gate = run_stack_gate(refs, stacked_fp32, [("t", trials[:16])])
+        assert not gate.passed
+        assert gate.per_tenant["s1"] < 1.0
+
+    def test_build_refusal_returns_none(self, members, trials,
+                                        monkeypatch):
+        """A refused gate yields (None, gate) — the zoo then serves
+        per-model (refuse-and-keep-serving)."""
+        from eegnetreplication_tpu.serve import zoo as zoo_mod
+
+        real = zoo_mod.run_stack_gate
+
+        def refusing(refs, cand, gate_set=None, **kw):
+            g = real(refs, cand, gate_set, **kw)
+            return type(g)(outcome="refused", agreement=0.0,
+                           per_tenant=g.per_tenant, floor=g.floor,
+                           n_trials=g.n_trials, precision=g.precision)
+
+        monkeypatch.setattr(zoo_mod, "run_stack_gate", refusing)
+        engine, gate = build_stacked_engine(
+            members, (16,), gate_set=[("t", trials[:8])])
+        assert engine is None and not gate.passed
+
+
+class TestZooAddressing:
+    def test_parse_spec_pairs_and_errors(self, tmp_path):
+        spec = parse_zoo_spec("a=/x/a.npz, b=/x/b.npz")
+        assert list(spec) == ["a", "b"]
+        with pytest.raises(ValueError, match="duplicate"):
+            parse_zoo_spec("a=/x,a=/y")
+        with pytest.raises(ValueError, match="id=path"):
+            parse_zoo_spec("nonsense-without-equals")
+        with pytest.raises(ValueError, match="no models"):
+            parse_zoo_spec({})
+
+    def test_parse_spec_directory(self, tmp_path):
+        _zoo_spec(tmp_path, 2)
+        spec = parse_zoo_spec(str(tmp_path))
+        assert list(spec) == ["s1", "s2"]
+
+    def test_resolve_rules(self):
+        ids = ["s1", "s2"]
+        digests = {"s1": "ab" * 32, "s2": "cd" * 32}
+        assert resolve_model_id(ids, None, "s2", digests) == "s2"
+        assert resolve_model_id(ids, "default", "s1", digests) == "s1"
+        assert resolve_model_id(ids, "s2", "s1", digests) == "s2"
+        assert resolve_model_id(ids, "abababab", "s1", digests) == "s1"
+        with pytest.raises(KeyError, match="unknown model"):
+            resolve_model_id(ids, "nope", "s1", digests)
+        with pytest.raises(KeyError, match="ambiguous"):
+            resolve_model_id(["a", "b"], "ee" * 8, "a",
+                             {"a": "ee" * 32, "b": "ee" * 32})
+
+
+class TestModelZoo:
+    def test_stacked_matches_per_model_mixed_batch(self, tmp_path, trials):
+        spec = _zoo_spec(tmp_path, 3)
+        gate = [("g", trials[:16])]
+        zs = ModelZoo(spec, buckets=(1, 8, 16), gate_set=gate, warm=False)
+        zp = ModelZoo(spec, buckets=(1, 8, 16), gate_set=gate,
+                      stack=False, warm=False)
+        assert zs.stacked is not None and zp.stacked is None
+        tid = np.random.RandomState(1).randint(0, 3, len(trials)) \
+            .astype(np.int32)
+        assert np.array_equal(zs.infer(trials, tid), zp.infer(trials, tid))
+
+    def test_lru_evict_and_reload_roundtrip(self, tmp_path, trials):
+        spec = _zoo_spec(tmp_path, 3)
+        with obs_journal.run(tmp_path / "obs_lru", config={}) as jr:
+            # Budget = one resident ladder: every materialization past
+            # the first evicts the LRU sibling.
+            zoo = ModelZoo(spec, buckets=(1, 16), stack=False,
+                           max_programs=2, warm=False, journal=jr)
+            before = {mid: zoo.infer(trials[:4], zoo.tenant_index(mid))
+                      for mid in zoo.tenant_ids}
+            snap = zoo.snapshot()
+            assert snap["resident_programs"] <= 2
+            resident = [t["engine_resident"] for t in snap["tenants"]]
+            assert resident == [False, False, True]
+            # An evicted tenant re-materializes on demand and serves the
+            # SAME predictions (identity survives the evict/reload trip).
+            again = zoo.infer(trials[:4], 0)
+            assert np.array_equal(again, before["s1"])
+            assert zoo.snapshot()["tenants"][0]["loads"] == 2
+            events = schema.read_events(jr.events_path, complete=False)
+        loads = [e for e in events if e["event"] == "model_load"]
+        evicts = [e for e in events if e["event"] == "model_evict"]
+        assert len(loads) == 4 and len(evicts) >= 2
+        assert all(e["reason"] == "program_budget" for e in evicts)
+        assert {e["model"] for e in loads} == {"s1", "s2", "s3"}
+
+    def test_reload_restacks_and_journals(self, tmp_path, trials):
+        spec = _zoo_spec(tmp_path, 2)
+        new_ckpt = _checkpoint(tmp_path, 42, "s2_new.npz")
+        gate = [("g", trials[:16])]
+        with obs_journal.run(tmp_path / "obs_re", config={}) as jr:
+            zoo = ModelZoo(spec, buckets=(1, 16), gate_set=gate,
+                           warm=False, journal=jr)
+            before = zoo.infer(trials, np.ones(len(trials), np.int32))
+            old_digest = zoo.digest_for("s2")
+            zoo.reload("s2", new_ckpt)
+            after = zoo.infer(trials, np.ones(len(trials), np.int32))
+            events = schema.read_events(jr.events_path, complete=False)
+        assert zoo.digest_for("s2") != old_digest
+        assert zoo.restacks == 2   # initial + reload
+        assert not np.array_equal(before, after)  # new weights serve
+        swaps = [e for e in events if e["event"] == "model_swap"]
+        restacks = [e for e in events if e["event"] == "zoo_restack"]
+        assert swaps and swaps[-1]["model"] == "s2"
+        assert len(restacks) == 2
+        assert restacks[-1]["outcome"] == "pass"
+        assert restacks[-1]["reason"] == "reload:s2"
+
+    def test_mixed_geometry_zoo_rejected(self, tmp_path, trials):
+        """Every request shape-validates against ONE (C, T), so a
+        mixed-geometry tenant could never be addressed — the zoo must
+        fail fast with the separate-processes contract, not 400 that
+        tenant's traffic forever."""
+        spec = _zoo_spec(tmp_path, 1)
+        other = EEGNet(n_channels=C + 3, n_times=T)
+        v = other.init(jax.random.PRNGKey(8),
+                       jnp.zeros((1, C + 3, T)), train=False)
+        spec["wide"] = save_checkpoint(
+            tmp_path / "wide.npz", v["params"], v["batch_stats"],
+            metadata={"model": "eegnet", "n_channels": C + 3,
+                      "n_times": T, "F1": other.F1, "D": other.D})
+        with pytest.raises(ValueError, match="share one geometry"):
+            ModelZoo(spec, buckets=(1, 16), warm=False,
+                     gate_set=[("g", trials[:8])])
+
+    def test_reload_rejects_geometry_change(self, tmp_path, trials):
+        spec = _zoo_spec(tmp_path, 2)
+        other = EEGNet(n_channels=C + 2, n_times=T)
+        v = other.init(jax.random.PRNGKey(5),
+                       jnp.zeros((1, C + 2, T)), train=False)
+        bad = save_checkpoint(
+            tmp_path / "bad_geo.npz", v["params"], v["batch_stats"],
+            metadata={"model": "eegnet", "n_channels": C + 2,
+                      "n_times": T, "F1": other.F1, "D": other.D})
+        zoo = ModelZoo(spec, buckets=(1, 16),
+                       gate_set=[("g", trials[:8])], warm=False)
+        old = zoo.digest_for("s1")
+        with pytest.raises(ValueError, match="geometry mismatch"):
+            zoo.reload("s1", bad)
+        assert zoo.digest_for("s1") == old  # serving state untouched
+
+    def test_refused_restack_demotes_stale_stack(self, tmp_path, trials,
+                                                 monkeypatch):
+        """A reload whose follow-up restack is REFUSED must not leave the
+        pre-reload stack serving under the new digest: the zoo demotes to
+        per-model serving, and the reloaded tenant answers with its NEW
+        weights."""
+        from eegnetreplication_tpu.serve import zoo as zoo_mod
+
+        spec = _zoo_spec(tmp_path, 2)
+        gate = [("g", trials[:16])]
+        with obs_journal.run(tmp_path / "obs_dem", config={}) as jr:
+            zoo = ModelZoo(spec, buckets=(1, 16), gate_set=gate,
+                           warm=False, journal=jr)
+            assert zoo.stacked is not None
+            fake_gate = zoo_mod.StackGateResult(
+                outcome="refused", agreement=0.0, per_tenant={},
+                floor=1.0, n_trials=0)
+            monkeypatch.setattr(zoo_mod, "build_stacked_engine",
+                                lambda *a, **k: (None, fake_gate))
+            new_ckpt = _checkpoint(tmp_path, 55, "s2_demote.npz")
+            zoo.reload("s2", new_ckpt)
+            assert zoo.stacked is None   # demoted, not stale
+            # The reloaded tenant serves its NEW weights via per-model
+            # fallback (equal to a fresh engine over the new checkpoint).
+            from eegnetreplication_tpu.serve.engine import (
+                load_model_from_checkpoint,
+            )
+
+            m, p, b = load_model_from_checkpoint(new_ckpt)
+            want = InferenceEngine(m, p, b, (1, 16)).infer(trials)
+            got = zoo.infer(trials, np.ones(len(trials), np.int32))
+            assert np.array_equal(got, want)
+            events = schema.read_events(jr.events_path, complete=False)
+        restacks = [e for e in events if e["event"] == "zoo_restack"]
+        assert restacks[-1]["outcome"] == "refused"
+        assert restacks[-1]["demoted_stale_stack"] is True
+
+    def test_retune_rebuilds_stack_on_new_ladder(self, tmp_path, trials):
+        zoo = ModelZoo(_zoo_spec(tmp_path, 2), buckets=(1, 16),
+                       gate_set=[("g", trials[:8])], warm=False)
+        before = zoo.infer(trials[:6], np.array([0, 1] * 3, np.int32))
+        zoo.retune((1, 4, 8), warm=False)
+        assert zoo.engine.buckets == (1, 4, 8)
+        assert zoo.retunes == 1
+        after = zoo.infer(trials[:6], np.array([0, 1] * 3, np.int32))
+        assert np.array_equal(before, after)  # same weights, new ladder
+
+
+class TestWeightedFairDequeue:
+    def _batcher(self, infer, **kw):
+        kw.setdefault("max_batch", 8)
+        kw.setdefault("max_wait_ms", 1.0)
+        kw.setdefault("max_queue_trials", 512)
+        return MicroBatcher(infer, tenant_aware=True, **kw)
+
+    def test_hot_tenant_cannot_starve_cold_one(self):
+        """The starvation bound, asserted from dispatch order: a cold
+        tenant's request submitted BEHIND a 50-request hot backlog must
+        ride the very next dispatched batch."""
+        dispatches = []
+        gate = threading.Event()
+
+        def infer(x, tenants):
+            gate.wait(10)
+            dispatches.append(sorted(set(tenants.tolist())))
+            return np.asarray(tenants, np.int64)
+
+        b = self._batcher(infer)
+        x1 = np.zeros((1, C, T), np.float32)
+        hot = [b.submit(x1, tenant=0) for _ in range(50)]
+        cold = b.submit(x1, tenant=1)
+        gate.set()
+        assert cold.result(timeout=30)[0] == 1
+        for f in hot:
+            assert f.result(timeout=30)[0] == 0
+        b.close()
+        assert 1 in dispatches[0], dispatches[:3]
+        # Bound restated: the cold request waited zero full dispatches.
+        first_cold = next(i for i, d in enumerate(dispatches) if 1 in d)
+        assert first_cold == 0
+
+    def test_mixed_batch_scatter_per_tenant(self):
+        """Each future must get ITS OWN rows back out of a mixed-tenant
+        coalesced batch (the gather+forward+scatter contract)."""
+        gate = threading.Event()
+
+        def infer(x, tenants):
+            gate.wait(10)
+            return np.asarray(tenants, np.int64) * 100 + \
+                np.asarray(x[:, 0, 0], np.int64)
+
+        b = self._batcher(infer, max_batch=64)
+        futs = []
+        for i in range(12):
+            tenant = i % 3
+            x = np.full((1, C, T), float(i), np.float32)
+            futs.append((tenant, i, b.submit(x, tenant=tenant)))
+        gate.set()
+        for tenant, i, fut in futs:
+            assert fut.result(timeout=30)[0] == tenant * 100 + i
+        b.close()
+
+    def test_tenant_on_single_tenant_batcher_raises(self):
+        b = MicroBatcher(lambda x: np.zeros(len(x), np.int64))
+        with pytest.raises(ValueError, match="single-tenant"):
+            b.submit(np.zeros((1, C, T), np.float32), tenant=2)
+        b.close()
+
+    def test_single_tenant_keeps_legacy_greedy_order(self):
+        """tenant_aware with ONE tenant must coalesce exactly like the
+        legacy FIFO+greedy scan (the [4,30,28] -> [32,30] regression).
+        A blocker request parks the worker while the three queue up, so
+        the coalesce sees them all regardless of scheduler timing."""
+        first_started = threading.Event()
+        release = threading.Event()
+        sizes = []
+
+        def infer(x, tenants):
+            sizes.append(len(x))
+            if len(sizes) == 1:  # only the blocker batch parks
+                first_started.set()
+                release.wait(10)
+            return np.zeros(len(x), np.int64)
+
+        b = self._batcher(infer, max_batch=32, max_wait_ms=0.0)
+        try:
+            b.submit(np.zeros((1, C, T), np.float32), tenant=0)
+            assert first_started.wait(5)
+            for n in (4, 30, 28):
+                b.submit(np.zeros((n, C, T), np.float32), tenant=0)
+            release.set()
+            b.close(drain=True)
+            assert sizes == [1, 32, 30]
+        finally:
+            release.set()
+            b.close()
+
+
+class TestZooHTTP:
+    @pytest.fixture()
+    def zoo_app(self, tmp_path, trials):
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        with obs_journal.run(tmp_path / "obs_http", config={}) as jr:
+            app = ServeApp(zoo=_zoo_spec(tmp_path, 2), buckets=(1, 8),
+                           max_wait_ms=1.0,
+                           gate_set=[("g", trials[:8])], journal=jr)
+            app.start()
+            try:
+                yield app, jr
+            finally:
+                app.stop()
+
+    def _post(self, url, payload, headers=None, timeout=30):
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json", **(headers or {})})
+        try:
+            resp = urllib.request.urlopen(req, timeout=timeout)
+            return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            return exc.code, json.loads(exc.read())
+
+    def test_model_addressing_and_healthz_tenants(self, zoo_app, trials):
+        import urllib.request
+
+        app, jr = zoo_app
+        x = trials[:3].tolist()
+        st, by_field = self._post(app.url + "/predict",
+                                  {"trials": x, "model": "s2"})
+        assert st == 200 and by_field["model"] == "s2"
+        st, by_header = self._post(app.url + "/predict", {"trials": x},
+                                   headers={"X-Model": "s2"})
+        assert st == 200
+        assert by_header["predictions"] == by_field["predictions"]
+        st, default = self._post(app.url + "/predict", {"trials": x})
+        assert st == 200 and default["model"] == "s1"
+        assert by_field["model_digest"] == app.zoo.digest_for("s2")
+        st, missing = self._post(app.url + "/predict",
+                                 {"trials": x, "model": "zz"})
+        assert st == 404 and "unknown model" in missing["error"]
+        health = json.loads(urllib.request.urlopen(
+            app.url + "/healthz", timeout=10).read())
+        assert [t["model"] for t in health["tenants"]] == ["s1", "s2"]
+        for t in health["tenants"]:
+            assert t["resident"] is True      # stacked serves everyone
+            assert t["digest"]
+        assert health["zoo"]["stacked"]["n_tenants"] == 2
+        assert health["zoo"]["default"] == "s1"
+
+    def test_reload_one_tenant_restacks(self, zoo_app, tmp_path, trials):
+        app, jr = zoo_app
+        new_ckpt = _checkpoint(tmp_path, 77, "reload_target.npz")
+        st, resp = self._post(app.url + "/reload",
+                              {"model": "s2", "checkpoint": str(new_ckpt)})
+        assert st == 200 and resp["model"] == "s2"
+        assert resp["stacked"] is True and resp["zoo_restacks"] == 2
+        st, after = self._post(app.url + "/predict",
+                               {"trials": trials[:3].tolist(),
+                                "model": "s2"})
+        assert st == 200 and after["model_digest"] == resp["model_digest"]
+
+    def test_session_windows_classify_under_default_tenant(self,
+                                                           tmp_path):
+        """A zoo server's streaming sessions must decide windows with
+        the DEFAULT tenant's model (here s2 — NOT tenant 0), matching a
+        single-model server over that same checkpoint byte for byte."""
+        from eegnetreplication_tpu.serve.service import ServeApp
+
+        spec = _zoo_spec(tmp_path, 2)
+        rng = np.random.RandomState(9)
+        chunk = rng.randn(C, T).astype(np.float32)
+
+        def stream_decisions(app):
+            app.start()
+            try:
+                st, opened = self._post(app.url + "/session/open",
+                                        {"session": "sx", "hop": T,
+                                         "ems_init_block_size": 16})
+                assert st == 200, opened
+                st, resp = self._post(
+                    app.url + f"/session/{opened['session']}/samples",
+                    {"samples": chunk.tolist()})
+                assert st == 200, resp
+                return [d["pred"] for d in resp["decisions"]]
+            finally:
+                app.stop()
+
+        got = stream_decisions(ServeApp(
+            zoo=spec, default_model="s2", buckets=(1, 8),
+            max_wait_ms=1.0, gate_set=[("g", chunk[None])]))
+        want = stream_decisions(ServeApp(
+            spec["s2"], buckets=(1, 8), max_wait_ms=1.0))
+        assert got and got == want
+
+    def test_reload_without_checkpoint_repushes_own_file(self, zoo_app,
+                                                         trials):
+        """An omitted checkpoint re-pushes the NAMED tenant's own file —
+        never another tenant's weights under its name."""
+        app, jr = zoo_app
+        before = app.zoo.digest_for("s2")
+        st, resp = self._post(app.url + "/reload", {"model": "s2"})
+        assert st == 200 and resp["model"] == "s2"
+        assert resp["model_digest"] == before   # same weights, same id
+        assert str(app.zoo.checkpoint_for("s2")) == resp["checkpoint"]
+
+
+class TestZooTelemetry:
+    def test_event_summary_zoo_fields(self):
+        base = {"t": 1.0, "run_id": "r"}
+        events = [
+            dict(base, event="run_start", schema_version=1, git_sha="x",
+                 platform="cpu", device_kind="cpu", n_devices=1,
+                 config={}),
+            dict(base, event="serve_start", checkpoint="c",
+                 buckets=[1], max_batch=1, max_wait_ms=1.0,
+                 tenants=["a", "b", "c"]),
+            dict(base, event="model_load", model="a", digest="d1"),
+            dict(base, event="model_evict", model="a",
+                 reason="program_budget"),
+            dict(base, event="zoo_restack", n_tenants=3, outcome="pass",
+                 reason="initial"),
+            dict(base, event="stack_gate", precision="fp32",
+                 outcome="pass", agreement=1.0, floor=1.0, n_tenants=3),
+            dict(base, event="run_end", status="ok", wall_s=1.0),
+        ]
+        schema.validate_events(events)
+        out = schema.event_summary(events)
+        assert out["tenants"] == 3
+        assert out["model_loads"] == 1
+        assert out["model_evictions"] == 1
+        assert out["zoo_restacks"] == 1
+        assert out["zoo_restack_outcome"] == "pass"
+        assert out["stack_gate"] == "pass"
+        assert out["stack_agreement"] == 1.0
+
+    def test_fleet_membership_mirrors_tenant_count(self):
+        from test_fleet import FakeReplica
+
+        from eegnetreplication_tpu.serve.fleet import membership as ms
+
+        fake = FakeReplica()
+        try:
+            fake.zoo = {"n_tenants": 9, "stacked": {"precision": "fp32"}}
+            replica = ms.Replica("r1", fake.url,
+                                 journal=obs_journal.NullJournal())
+            m = ms.FleetMembership([replica],
+                                   journal=obs_journal.NullJournal())
+            m.poll_once()
+            snap = replica.snapshot()
+            assert snap["n_tenants"] == 9
+            assert snap["stacked"] is True
+            # A restart as a single-model server must RESET the mirror —
+            # stale tenant state cannot linger in the fleet snapshot.
+            fake.zoo = None
+            m.poll_once()
+            snap = replica.snapshot()
+            assert snap["n_tenants"] is None
+            assert snap["stacked"] is None
+        finally:
+            fake.stop()
+
+
+@pytest.mark.filterwarnings("ignore")
+class TestZooBenchSelftest:
+    def test_selftest_passes(self, tmp_path):
+        """The tier-1 --zoo selftest: stacked speedup floor over the
+        per-model zoo, compiled-program count constant in tenants, gate
+        verdicts consistent, zero drops through the restack-under-load
+        leg."""
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
+             "--zoo", "--selftest",
+             "--zooRequests", "400",
+             "--zooOut", str(tmp_path / "BENCH_ZOO.json"),
+             "--workDir", str(tmp_path / "work")],
+            capture_output=True, text=True, timeout=840,
+            env={**dict(__import__("os").environ),
+                 "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": str(REPO)})
+        assert proc.returncode == 0, \
+            f"stdout:\n{proc.stdout[-3000:]}\nstderr:\n{proc.stderr[-2000:]}"
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads((tmp_path / "BENCH_ZOO.json").read_text())
+        assert record["compiled_programs_constant_in_tenants"] is True
+        assert record["restack_under_load"]["failures"] == 0
+
+
+class TestCommittedZooArtifact:
+    def test_committed_record_meets_acceptance(self):
+        """The COMMITTED BENCH_ZOO.json must carry the ISSUE-11
+        acceptance: 9 mixed tenants, stacked >= 3x the per-model zoo
+        rps at unchanged per-tenant gate agreement, compiled-program
+        count constant in tenants, zero drops through the restack leg."""
+        record = json.loads((REPO / "BENCH_ZOO.json").read_text())
+        assert record["n_tenants"] == 9
+        assert record["stacked_speedup"] >= 3.0
+        assert record["gate"]["outcome"] == "pass"
+        assert all(v >= 1.0 for v in record["gate"]["per_tenant"].values())
+        assert record["compiled_programs_constant_in_tenants"] is True
+        assert record["stacked"]["compiled_programs"] == \
+            len(record["buckets"])
+        assert record["per_model"]["compiled_programs"] == \
+            record["n_tenants"] * len(record["buckets"])
+        for leg in ("per_model", "stacked", "restack_under_load"):
+            assert record[leg]["failures"] == 0, leg
+            assert record[leg]["completed"] == record[leg]["n_requests"]
+        assert record["restack_under_load"]["restacks"] >= 2
+        assert record["journal"]["zoo_restack_events"] >= 2
+        assert record["journal"]["model_swap_events"] >= 1
