@@ -108,6 +108,16 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "fleet_shadow": ("replica", "reference", "n_trials", "agree"),
     "fleet_reload": ("status", "checkpoint"),
     "fleet_end": ("n_requests", "wall_s"),
+    # Multi-cell serving (serve/cells/): the front tier's lifecycle, every
+    # cell membership transition (the cells analog of fleet_member — a
+    # cell marked "failed" here is pinned BEFORE its sessions' failover
+    # events), every planned session migration (drain), and every
+    # unplanned cross-cell session failover.
+    "cell_front_start": ("cells",),
+    "cell_member": ("cell", "state", "previous", "reason"),
+    "session_migrate": ("session", "from_cell", "to_cell"),
+    "session_failover": ("session", "from_cell", "to_cell"),
+    "cell_front_end": ("n_requests", "wall_s"),
     # Gray-failure defenses (ISSUE 10): latency-outlier ejection /
     # half-open re-admission of a degraded replica, every hedged
     # dispatch, and adaptive-admission decisions (AIMD limit moves +
@@ -504,6 +514,22 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
             if agree:
                 out["fleet_shadow_agree"] = round(
                     sum(agree) / len(agree), 4)
+    # Multi-cell serving: cell count, membership churn, and session
+    # portability activity (planned migrations vs unplanned failovers) —
+    # only reported for cell-front streams so other rows stay compact.
+    front_starts = [e for e in events if e["event"] == "cell_front_start"]
+    cell_members = [e for e in events if e["event"] == "cell_member"]
+    migrations = [e for e in events if e["event"] == "session_migrate"]
+    cell_failovers = [e for e in events
+                      if e["event"] == "session_failover"]
+    if front_starts or cell_members or migrations or cell_failovers:
+        if front_starts:
+            out["cells"] = len(front_starts[-1].get("cells", []))
+        out["cell_member_transitions"] = len(cell_members)
+        out["cells_failed"] = sum(1 for e in cell_members
+                                  if e.get("state") == "failed")
+        out["session_migrations"] = len(migrations)
+        out["session_failovers"] = len(cell_failovers)
     # Gray-failure defenses: outlier ejections/readmissions, hedged
     # dispatches (and how many the hedge won), and AIMD admission moves —
     # only reported when the machinery actually acted, so other rows stay

@@ -65,6 +65,15 @@ site                  action     effect
                                  network produces; the fleet router must
                                  treat it as a transport failure and
                                  fail over
+``cell.partition``    refuse     ``ConnectionRefusedError`` at the cell
+                                 front's client seam — the whole cell
+                                 looks dead (every request AND health
+                                 poll refused), which is what a cell
+                                 crash or network partition looks like
+                                 from the front tier; ``if_tag=``
+                                 confines it to one cell id so a
+                                 multi-cell process drill kills exactly
+                                 one member
 ====================  =========  ==========================================
 
 Unlike ``sleep=`` (an unbounded silent stall — the watchdog/supervisor
@@ -99,9 +108,10 @@ from eegnetreplication_tpu.utils.logging import logger
 SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
          "host.preempt", "train.chunk", "serve.forward", "train.hang",
          "serve.hang", "session.snapshot", "session.restore",
-         "serve.degrade", "replica.network")
+         "serve.degrade", "replica.network", "cell.partition")
 
-ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate")
+ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate",
+           "refuse")
 
 # Default hang duration for action="sleep" when the spec sets none: long
 # enough that any sane watchdog budget expires first, short enough that a
@@ -157,6 +167,8 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                       "injected degradation: serve.degrade (hit {hit})"),
     "replica.network": ("truncate", None,
                         "injected truncation: replica.network (hit {hit})"),
+    "cell.partition": ("refuse", None,
+                       "injected partition: cell.partition (hit {hit})"),
 }
 
 
@@ -178,6 +190,7 @@ class FaultSpec:
     if_folds_over: int | None = None  # train.step: only programs > N folds
     sleep: float | None = None  # action="sleep": hang duration in seconds
     slow: float | None = None   # action="slow": added latency in seconds
+    refuse: int | None = None   # refuse=1 selects action="refuse"
     every: int | None = None    # fire only on every Nth due hit
     if_tag: str | None = None   # only hits whose ctx tag= matches
 
@@ -220,6 +233,19 @@ class FaultSpec:
                     f"{field_name} must be a non-negative finite number "
                     f"of seconds, got {value}")
             setattr(self, field_name, value)
+        # refuse= gets the same parse-time strictness: it is a selector,
+        # not a count — anything but 1 is a plan typo (refuse=0 would be
+        # "arm a fault that does nothing", which misreports the plan).
+        if self.refuse is not None:
+            if self.refuse != 1:
+                raise ValueError(
+                    f"refuse must be 1 (it selects the connection-refused "
+                    f"action; omit it otherwise), got {self.refuse!r}")
+            if self.action is None:
+                self.action = "refuse"
+            elif self.action != "refuse":
+                raise ValueError(
+                    f"refuse=1 conflicts with action={self.action!r}")
 
 
 class ArmedFault:
@@ -394,6 +420,12 @@ def fire(site: str, **ctx) -> None:
         return
     if action == "truncate":
         raise ResponseTruncated(message)
+    if action == "refuse":
+        # The connection-refused shape a dead/partitioned process shows a
+        # client: an OSError subtype, so the fleet/cell dispatch path
+        # classifies it as a dead connection (immediate pull + failover)
+        # rather than an application error.
+        raise ConnectionRefusedError(message)
     exc_cls = _EXC_TYPES[spec.exc or d_exc or "RuntimeError"]
     raise exc_cls(message)
 

@@ -1,0 +1,20 @@
+"""Multi-cell serving: blast-radius isolation above the fleet tier.
+
+``cells/`` runs N independent cells — each a full serving deployment
+(fleet router + supervised replicas, or a single serve process) — behind
+a thin :class:`~eegnetreplication_tpu.serve.cells.front.CellFront` that
+routes bulk traffic least-loaded and sessions by sticky affinity, with
+planned session migration (``/cell/<id>/drain``) and unplanned
+cross-cell session failover from each cell's snapshot spool.
+"""
+
+from eegnetreplication_tpu.serve.cells.front import CellFront, MigrationError
+from eegnetreplication_tpu.serve.cells.membership import (
+    CellMember,
+    CellMembership,
+    DISPATCHABLE,
+    FAILED,
+)
+
+__all__ = ["CellFront", "CellMember", "CellMembership", "DISPATCHABLE",
+           "FAILED", "MigrationError"]

@@ -1,0 +1,4 @@
+from eegnetreplication_tpu.serve.cells.service import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,196 @@
+"""Cells service wiring: front process + N supervised serving cells.
+
+``python -m eegnetreplication_tpu.serve.cells --checkpoint m.npz
+--cells 2`` spawns N cells under one
+:class:`~eegnetreplication_tpu.resil.supervise.MultiSupervisor` and binds
+the :class:`~eegnetreplication_tpu.serve.cells.front.CellFront` over
+them.  Each cell is:
+
+- ``--replicasPerCell 1`` (default): one ``python -m
+  eegnetreplication_tpu.serve`` process — the smallest full cell (model,
+  batcher, sessions, snapshots);
+- ``--replicasPerCell R > 1``: one ``python -m
+  eegnetreplication_tpu.serve.fleet`` process whose FleetApp supervises
+  R replicas of its own — a full fleet as one cell.
+
+Every cell's session snapshots land under ``--cellsDir`` (shared
+storage): ``<cellsDir>/<cell>/sessions/``.  That directory IS each
+cell's spool — what the front restores sessions from when the cell dies.
+
+Note the supervisor relaunches a crashed CELL (with ``--resume``, so a
+bounce of the whole cell restores its own sessions); cross-cell failover
+covers the window while it is down and any session the front already
+moved stays moved (a resurrected copy is shadowed by affinity and
+discarded on its next drain).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+from pathlib import Path
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import trace
+from eegnetreplication_tpu.resil import preempt, supervise
+from eegnetreplication_tpu.serve.cells.front import CellFront
+from eegnetreplication_tpu.serve.cells.membership import CellMember
+from eegnetreplication_tpu.serve.fleet.service import free_port
+from eegnetreplication_tpu.utils.logging import logger
+
+
+def spawn_cells(checkpoint: str, n: int, *, run_dir: Path, cells_dir: Path,
+                host: str = "127.0.0.1", replicas_per_cell: int = 1,
+                serve_args: list[str] | None = None,
+                session_snapshot_every: int = 16,
+                policy: supervise.SupervisorPolicy | None = None,
+                journal=None) -> tuple[supervise.MultiSupervisor,
+                                       list[CellMember]]:
+    """Child specs + supervisor + CellMember handles for ``n`` cells.
+
+    Ports are pre-assigned so a supervisor relaunch rebinds the same
+    address and the front's membership rejoins the cell automatically.
+    """
+    run_dir = Path(run_dir)
+    run_dir.mkdir(parents=True, exist_ok=True)
+    cells_dir = Path(cells_dir)
+    specs, members = [], []
+    for i in range(n):
+        cell_id = f"c{i}"
+        port = free_port(host)
+        spool = cells_dir / cell_id / "sessions"
+        hb_file = run_dir / f"{cell_id}.heartbeat.json"
+        if replicas_per_cell > 1:
+            cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve.fleet",
+                   "--checkpoint", str(checkpoint), "--host", host,
+                   "--port", str(port),
+                   "--replicas", str(replicas_per_cell),
+                   "--sessionsDir", str(spool),
+                   "--sessionSnapshotEvery", str(session_snapshot_every),
+                   "--metricsDir", str(run_dir / f"{cell_id}_obs")]
+        else:
+            cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
+                   "--checkpoint", str(checkpoint), "--host", host,
+                   "--port", str(port),
+                   "--sessionsDir", str(spool / "r0"),
+                   "--sessionSnapshotEvery", str(session_snapshot_every),
+                   "--metricsDir", str(run_dir / f"{cell_id}_obs")]
+        cmd += list(serve_args or [])
+        specs.append(supervise.ChildSpec(name=cell_id, cmd=cmd,
+                                         heartbeat_file=hb_file))
+        members.append(CellMember(cell_id, f"http://{host}:{port}",
+                                  spool=spool, journal=journal))
+    policy = policy or supervise.SupervisorPolicy(
+        grace_s=15.0, poll_s=0.25,
+        # A bounced cell restores its OWN sessions on relaunch; the
+        # front's failover covers the down window.
+        resume_arg="--resume",
+        thresholds={"startup": 300.0})
+    sup = supervise.MultiSupervisor(specs, policy=policy, journal=journal)
+    return sup, members
+
+
+def main(argv=None) -> int:
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    select_platform()
+    parser = argparse.ArgumentParser(
+        prog="eegtpu-cells",
+        description="Multi-cell EEG serving: N independent cells behind a "
+                    "front tier with session affinity, planned session "
+                    "migration (drain), and cell-level failover.")
+    parser.add_argument("--checkpoint", required=True)
+    parser.add_argument("--cells", type=int, default=2,
+                        help="Number of cells to spawn.")
+    parser.add_argument("--replicasPerCell", type=int, default=1,
+                        help="1 = each cell is one serve process; >1 = "
+                             "each cell is a FleetApp supervising this "
+                             "many replicas.")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8792,
+                        help="Front listen port (0 = ephemeral).")
+    parser.add_argument("--cellsDir", type=str, default=None,
+                        help="SHARED storage root for per-cell session "
+                             "spools (default checkpoints/serve_cells).  "
+                             "Cross-cell failover restores from here, so "
+                             "it must be reachable by the front.")
+    parser.add_argument("--sessionSnapshotEvery", type=int, default=16,
+                        help="Per-cell session snapshot cadence in decided "
+                             "windows — the failover staleness bound.")
+    parser.add_argument("--pollS", type=float, default=0.25,
+                        help="Cell health-poll cadence.")
+    parser.add_argument("--outlierK", type=float, default=0.0,
+                        help="Cell-level latency-outlier ejection factor "
+                             "(0 = off): a live cell whose rolling p95 "
+                             "exceeds K x the cell median is ejected to "
+                             "degraded and probe-readmitted.")
+    parser.add_argument("--traceSample", type=float,
+                        default=trace.DEFAULT_SAMPLE_RATE)
+    parser.add_argument("--slo", type=str, default=None,
+                        help="Forwarded to every cell (replica-level SLO "
+                             "monitoring; breaches mirror up into the "
+                             "cell's aggregate health).")
+    parser.add_argument("--metricsDir", type=str, default=None)
+    parser.add_argument("--startupTimeoutS", type=float, default=300.0)
+    args = parser.parse_args(argv)
+    if args.cells < 1:
+        parser.error("--cells must be >= 1")
+    if args.replicasPerCell < 1:
+        parser.error("--replicasPerCell must be >= 1")
+    if args.slo:
+        from eegnetreplication_tpu.obs import slo as obs_slo
+
+        try:
+            obs_slo.parse_slo_spec(args.slo)
+        except ValueError as exc:
+            parser.error(f"--slo: {exc}")
+
+    from eegnetreplication_tpu.config import Paths
+
+    metrics_dir = (Path(args.metricsDir) if args.metricsDir
+                   else Paths.from_here().reports / "obs")
+    cells_dir = (Path(args.cellsDir) if args.cellsDir
+                 else Paths.from_here().checkpoints / "serve_cells")
+    serve_args = ["--traceSample", str(args.traceSample)]
+    if args.slo:
+        serve_args += ["--slo", args.slo]
+    with obs_journal.run(metrics_dir, config=vars(args),
+                         role="cells") as journal, preempt.guard():
+        sup, members = spawn_cells(
+            args.checkpoint, args.cells, run_dir=journal.dir,
+            cells_dir=cells_dir, host=args.host,
+            replicas_per_cell=args.replicasPerCell,
+            serve_args=serve_args,
+            session_snapshot_every=args.sessionSnapshotEvery,
+            journal=journal)
+        sup_thread = threading.Thread(target=sup.run,
+                                      name="cells-supervisor", daemon=True)
+        sup_thread.start()
+        front = CellFront(members, host=args.host, port=args.port,
+                          poll_s=args.pollS, outlier_k=args.outlierK,
+                          trace_sample=args.traceSample, journal=journal)
+        front.membership.start()
+        if not front.membership.wait_live(args.cells,
+                                          timeout_s=args.startupTimeoutS):
+            live = len(front.membership.dispatchable())
+            logger.warning("Only %d/%d cells live after %.0fs — serving "
+                           "with what we have", live, args.cells,
+                           args.startupTimeoutS)
+        front.start()
+        print(f"cells serving at {front.url} "
+              f"({len(front.membership.dispatchable())} live)", flush=True)
+        try:
+            while not preempt.requested():
+                time.sleep(0.2)
+        finally:
+            logger.info("Cells stop requested — draining")
+            front.stop()
+            sup.stop()
+            sup_thread.join(timeout=60.0)
+    return preempt.EX_PREEMPTED if preempt.requested() else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -185,7 +185,18 @@ class Replica:
 
 
 class FleetMembership:
-    """Polls every replica's health; owns the membership state machine."""
+    """Polls every replica's health; owns the membership state machine.
+
+    The state machine is deliberately member-kind-agnostic: the cells
+    tier (:mod:`~eegnetreplication_tpu.serve.cells.membership`) subclasses
+    it to run whole CELLS as members, overriding the three class attrs so
+    its transitions journal as ``cell_member`` events keyed by ``cell``
+    instead of ``fleet_member``/``replica``.
+    """
+
+    MEMBER_EVENT = "fleet_member"      # journal event per transition
+    MEMBER_KEY = "replica"             # the event's identity key
+    TRANSITION_METRIC = "fleet_member_transitions"
 
     def __init__(self, replicas: list[Replica], *, poll_s: float = 0.25,
                  fail_threshold: int = 2, health_timeout_s: float = 2.0,
@@ -203,6 +214,12 @@ class FleetMembership:
         self._journal = journal if journal is not None \
             else obs_journal.current()
         self._state_lock = threading.Lock()
+        # Optional transition hook ``(member, previous, state, reason)``,
+        # called AFTER the transition is journaled (so anything the hook
+        # journals — e.g. the cell front's session failovers — is pinned
+        # to land after its membership event).  Exceptions are contained:
+        # a hook failure must not wedge the poller or a dispatch path.
+        self.on_transition = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # One slot per replica so poll_once's wall is bounded by the
@@ -253,12 +270,20 @@ class FleetMembership:
             # to the DEAD process must not greet the NEW one with a
             # spurious reset-failover right after it rejoins.
             replica.client.close()
-        self._journal.event("fleet_member", replica=replica.replica_id,
+        self._journal.event(self.MEMBER_EVENT,
+                            **{self.MEMBER_KEY: replica.replica_id},
                             state=state, previous=previous, reason=reason)
-        self._journal.metrics.inc("fleet_member_transitions", state=state)
+        self._journal.metrics.inc(self.TRANSITION_METRIC, state=state)
         log = logger.warning if state in (DRAINING, OUT) else logger.info
-        log("Fleet member %s: %s -> %s (%s)", replica.replica_id, previous,
-            state, reason)
+        log("%s %s: %s -> %s (%s)", self.MEMBER_EVENT, replica.replica_id,
+            previous, state, reason)
+        if self.on_transition is not None:
+            try:
+                self.on_transition(replica, previous, state, reason)
+            except Exception as exc:  # noqa: BLE001 — hook must not wedge
+                logger.warning("Membership transition hook failed for %s "
+                               "(%s -> %s): %s", replica.replica_id,
+                               previous, state, exc)
         return True
 
     def mark_unreachable(self, replica: Replica, reason: str) -> None:

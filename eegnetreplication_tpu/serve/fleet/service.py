@@ -28,6 +28,7 @@ single-process serving journal.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import socket
 import sys
@@ -39,10 +40,14 @@ from pathlib import Path
 from eegnetreplication_tpu.obs import journal as obs_journal
 from eegnetreplication_tpu.obs import trace
 from eegnetreplication_tpu.resil import preempt, supervise
-from eegnetreplication_tpu.serve.service import JsonRequestHandler
+from eegnetreplication_tpu.serve.service import (
+    PASSTHROUGH_HEADERS,
+    JsonRequestHandler,
+)
 from eegnetreplication_tpu.serve.fleet import membership as ms
 from eegnetreplication_tpu.serve.fleet.canary import RollingReload
 from eegnetreplication_tpu.serve.fleet.outlier import OutlierEjector
+from eegnetreplication_tpu.serve.sessions import store as session_store
 from eegnetreplication_tpu.serve.fleet.router import (
     AllReplicasBusy,
     FleetRouter,
@@ -112,6 +117,21 @@ class FleetApp:
         self._httpd: ThreadingHTTPServer | None = None
         self._listener: threading.Thread | None = None
         self._stopped = False
+        # Session stickiness: a streaming session's state lives in ONE
+        # replica's store, so every /session/* request for an id must
+        # land on the replica that opened it.  A sticky replica that is
+        # down answers 503 until the supervisor relaunches it on the
+        # same port (with --resume when the fleet serves sessions) and
+        # membership rejoins it — the client's replay-from-acked
+        # handshake covers the gap, exactly like a single-process
+        # restart.
+        self._session_lock = threading.Lock()
+        self._session_affinity: dict[str, str] = {}
+        # One lock per session id, held across an open's pick+forward+
+        # assign: two concurrent opens of the same id must not land on
+        # two replicas (last-writer-wins affinity would orphan a live
+        # session on the loser).
+        self._session_open_locks: dict[str, threading.Lock] = {}
         self._reload_lock = threading.Lock()
         self._stats_lock = threading.Lock()
         self._counts = {"ok": 0, "rejected": 0, "no_replicas": 0,
@@ -215,6 +235,47 @@ class FleetApp:
         else:
             trace.flush_if_anomalous(status, journal=self.journal)
 
+    # -- session stickiness ------------------------------------------------
+    def session_replica(self, sid: str) -> ms.Replica | None:
+        with self._session_lock:
+            replica_id = self._session_affinity.get(sid)
+        if replica_id is None:
+            return None
+        try:
+            return self.membership.by_id(replica_id)
+        except KeyError:
+            return None
+
+    def assign_session(self, sid: str, replica_id: str) -> None:
+        with self._session_lock:
+            self._session_affinity[sid] = replica_id
+
+    def session_open_lock(self, sid: str) -> threading.Lock:
+        with self._session_lock:
+            lock = self._session_open_locks.get(sid)
+            if lock is None:
+                lock = self._session_open_locks[sid] = threading.Lock()
+            return lock
+
+    def drop_session(self, sid: str) -> None:
+        with self._session_lock:
+            self._session_affinity.pop(sid, None)
+            self._session_open_locks.pop(sid, None)
+
+    def pick_session_replica(self) -> ms.Replica | None:
+        """Least-loaded live replica for a new session (fewest sticky
+        sessions first, then the dispatch load key)."""
+        candidates = self.membership.dispatchable()
+        if not candidates:
+            return None
+        with self._session_lock:
+            counts = {r.replica_id: 0 for r in candidates}
+            for rid in self._session_affinity.values():
+                if rid in counts:
+                    counts[rid] += 1
+        return min(candidates,
+                   key=lambda r: (counts[r.replica_id], r.load))
+
     # -- rolling reload ----------------------------------------------------
     def rolling_reload(self, checkpoint: str, *,
                        shadow_n: int | None = None,
@@ -270,9 +331,12 @@ class _FleetHandler(JsonRequestHandler):
             # aggregate also explains WHY a member left rotation.
             slo_breached = {r["replica"]: r["slo_breached"]
                             for r in snapshot if r.get("slo_breached")}
+            with app._session_lock:
+                n_sessions = len(app._session_affinity)
             self._reply(200 if n_live else 503, {
                 "status": "ok" if n_live else "no_live_replicas",
                 "n_replicas": len(snapshot), "n_live": n_live,
+                "sessions": n_sessions,
                 "checkpoint": app.checkpoint,
                 "serving_digests": digests,
                 "slo": {"replicas_breached": slo_breached,
@@ -288,6 +352,18 @@ class _FleetHandler(JsonRequestHandler):
             return
         if self.path == "/metrics":
             self._reply_metrics(app.journal)
+            return
+        parts = self.path.strip("/").split("/")
+        if len(parts) == 3 and parts[0] == "session" \
+                and parts[2] in ("state", "export"):
+            # Bracketed like do_POST: stop() must wait for this forward
+            # or closing the pooled clients mid-flight would fail it with
+            # an OSError that marks a healthy replica unreachable.
+            app.begin_request()
+            try:
+                self._session_forward(parts[1], "GET", self.path)
+            finally:
+                app.end_request()
             return
         self._reply(404, {"error": f"unknown path {self.path}"})
 
@@ -306,9 +382,157 @@ class _FleetHandler(JsonRequestHandler):
             if self.path == "/reload":
                 self._reload()
                 return
+            parts = self.path.strip("/").split("/")
+            if parts[0] == "session":
+                if len(parts) == 2 and parts[1] == "open":
+                    self._session_open()
+                    return
+                if len(parts) == 2 and parts[1] == "import":
+                    self._session_import()
+                    return
+                if len(parts) == 3 and parts[2] in ("samples", "close",
+                                                    "discard"):
+                    self._session_forward(parts[1], "POST", self.path,
+                                          body=self._read_body(),
+                                          drop=parts[2] in ("close",
+                                                            "discard"))
+                    return
             self._reply(404, {"error": f"unknown path {self.path}"})
         finally:
             app.end_request()
+
+    # -- session forwarding (sticky replica affinity) ----------------------
+    def _forward_headers(self) -> dict:
+        headers = {**trace.headers()}
+        for name in ("Content-Type",) + PASSTHROUGH_HEADERS:
+            if self.headers.get(name):
+                headers[name] = self.headers[name]
+        return headers
+
+    def _forward_to(self, replica: ms.Replica, method: str, path: str,
+                    body: bytes | None = None) -> tuple[int, bytes] | None:
+        import http.client as _http
+
+        try:
+            return replica.client.request(method, path, body=body,
+                                          headers=self._forward_headers())
+        except (OSError, _http.HTTPException) as exc:
+            self.app.membership.mark_unreachable(
+                replica, f"session forward: {type(exc).__name__}")
+            self._reply(503, {"error": f"replica {replica.replica_id} "
+                                       f"unreachable: "
+                                       f"{type(exc).__name__}"})
+            return None
+
+    def _session_forward(self, sid: str, method: str, path: str,
+                         body: bytes | None = None,
+                         drop: bool = False) -> None:
+        app = self.app
+        replica = app.session_replica(sid)
+        if replica is None:
+            self._reply(404, {"error": f"unknown session {sid!r}"})
+            return
+        if replica.state not in ms.DISPATCHABLE:
+            # Down (crashed, draining): the supervisor relaunches it with
+            # --resume on the same port; the client's resume handshake
+            # rides out the 503s until then.
+            self._reply(503, {"error": f"session {sid!r} replica "
+                                       f"{replica.replica_id} is "
+                                       f"{replica.state}; retry"})
+            return
+        result = self._forward_to(replica, method, path, body)
+        if result is None:
+            return
+        status, data = result
+        if status == 200 and drop:
+            app.drop_session(sid)
+        self._reply_bytes(status, data)
+
+    def _session_open(self) -> None:
+        app = self.app
+        body = self._read_body()
+        try:
+            payload = json.loads(body.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as exc:
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        sid = payload.get("session")
+        if not sid:
+            # Name anonymous sessions HERE: stickiness needs the id
+            # before the replica assigns one.
+            import os as _os
+
+            sid = payload["session"] = _os.urandom(6).hex()
+            body = json.dumps(payload).encode()
+        sid = str(sid)
+        with app.session_open_lock(sid):
+            # Affinity is resolved UNDER the per-sid lock: two racing
+            # opens of the same id must serialize, or both would pick a
+            # (possibly different) replica and the losing replica would
+            # hold a live orphan copy forever.
+            replica = app.session_replica(sid)
+            if replica is None or replica.state not in ms.DISPATCHABLE:
+                if replica is not None:
+                    # Known session on a down replica: opening it
+                    # elsewhere would fork the stream — hold the line
+                    # with 503 until the relaunch rejoins.
+                    self._reply(503, {"error": f"session {sid!r} replica "
+                                               f"{replica.replica_id} is "
+                                               f"{replica.state}; retry"})
+                    return
+                replica = app.pick_session_replica()
+                if replica is None:
+                    self._reply(503, {"error": "no live replicas for "
+                                               "sessions"})
+                    return
+            result = self._forward_to(replica, "POST", "/session/open",
+                                      body)
+            if result is None:
+                return
+            status, data = result
+            if status == 200:
+                app.assign_session(sid, replica.replica_id)
+        self._reply_bytes(status, data)
+
+    def _session_import(self) -> None:
+        app = self.app
+        body = self._read_body()
+        # Imports must be idempotent per session id: the cells front
+        # retries an import whose RESPONSE was lost after the fleet
+        # committed it, and expects the second attempt to hit the same
+        # store (409 SessionExists = "the stream is there").  Peek the id
+        # so a repeat routes to the replica that already holds it instead
+        # of forking the session onto a fresh least-loaded pick.
+        sid = session_store.peek_session_id(body)
+        lock = (app.session_open_lock(sid) if sid
+                else contextlib.nullcontext())
+        with lock:
+            replica = app.session_replica(sid) if sid else None
+            if replica is not None and replica.state not in ms.DISPATCHABLE:
+                self._reply(503, {"error": f"session {sid!r} replica "
+                                           f"{replica.replica_id} is "
+                                           f"{replica.state}; retry"})
+                return
+            if replica is None:
+                replica = app.pick_session_replica()
+            if replica is None:
+                self._reply(503, {"error": "no live replicas for sessions"})
+                return
+            result = self._forward_to(replica, "POST", "/session/import",
+                                      body)
+            if result is None:
+                return
+            status, data = result
+            if status == 200:
+                try:
+                    sid = json.loads(data.decode()).get("session") or sid
+                except (ValueError, UnicodeDecodeError):
+                    pass
+                if sid:
+                    app.assign_session(str(sid), replica.replica_id)
+        self._reply_bytes(status, data)
 
     def _predict(self) -> None:
         # The trace is born HERE (or inherited from an upstream edge):
@@ -327,19 +551,13 @@ class _FleetHandler(JsonRequestHandler):
         body = self._read_body()
         content_type = (self.headers.get("Content-Type")
                         or "application/json").split(";")[0].strip()
-        passthrough = {}
-        if self.headers.get("X-Deadline-Ms"):
-            passthrough["X-Deadline-Ms"] = self.headers["X-Deadline-Ms"]
-        if self.headers.get("X-Priority"):
-            # Two-class admission rides through the fleet: without this
-            # a control-class client behind the router would be shed as
-            # bulk by the replica's adaptive limit.
-            passthrough["X-Priority"] = self.headers["X-Priority"]
-        if self.headers.get("X-Model"):
-            # Zoo model addressing rides through too: a stripped X-Model
-            # would make the replica serve its DEFAULT tenant with a 200
-            # — the wrong model's answers, silently.
-            passthrough["X-Model"] = self.headers["X-Model"]
+        # The single-sourced passthrough set: X-Deadline-Ms (deadline
+        # enforcement), X-Priority (two-class admission — without it a
+        # control-class client behind the router would be shed as bulk),
+        # X-Model (zoo addressing — a stripped header would silently
+        # serve the default tenant's answers with a 200).
+        passthrough = {h: self.headers[h] for h in PASSTHROUGH_HEADERS
+                       if self.headers.get(h)}
         try:
             status, data, replica_id = app.router.dispatch(
                 body, content_type, headers=passthrough)
@@ -511,6 +729,29 @@ def main(argv=None) -> int:
                              "replica's --slo); breaches degrade replica "
                              "healthz and surface in the fleet's "
                              "aggregate /healthz.")
+    parser.add_argument("--sessionsDir", type=str, default=None,
+                        help="Root for per-replica durable session "
+                             "snapshots (<root>/r<i>); enables streaming "
+                             "sessions through the fleet front (sticky "
+                             "replica affinity) and makes replica "
+                             "relaunches resume their sessions.  This "
+                             "root doubles as the cell's snapshot spool "
+                             "when the fleet runs as one cell.")
+    parser.add_argument("--sessionSnapshotEvery", type=int, default=16,
+                        help="Forwarded to every replica with "
+                             "--sessionsDir: snapshot cadence in decided "
+                             "windows — the staleness bound for both a "
+                             "replica relaunch and a cross-cell "
+                             "failover.")
+    parser.add_argument("--resume", action="store_true",
+                        help="Restore replica sessions from --sessionsDir "
+                             "snapshots at startup (forwarded to every "
+                             "replica's first launch).  The supervisor "
+                             "appends this on a relaunch of a "
+                             "session-serving fleet — e.g. when the whole "
+                             "fleet runs as one cell under eegtpu-cells — "
+                             "so the flag must parse even without "
+                             "--sessionsDir (a no-op then).")
     parser.add_argument("--metricsDir", type=str, default=None)
     parser.add_argument("--startupTimeoutS", type=float, default=300.0)
     args = parser.parse_args(argv)
@@ -546,11 +787,28 @@ def main(argv=None) -> int:
         serve_args += ["--slo", args.slo]
     if args.admissionTargetMs > 0:
         serve_args += ["--admissionTargetMs", str(args.admissionTargetMs)]
+    per_replica_args = None
+    policy = None
+    if args.sessionsDir:
+        sessions_root = Path(args.sessionsDir)
+        per_replica_args = {
+            f"r{i}": ["--sessionsDir", str(sessions_root / f"r{i}"),
+                      "--sessionSnapshotEvery",
+                      str(args.sessionSnapshotEvery)]
+                     + (["--resume"] if args.resume else [])
+            for i in range(args.replicas)}
+        # Session-serving replicas DO have state to resume: a relaunch
+        # restores its own snapshot generation before rebinding.
+        policy = supervise.SupervisorPolicy(
+            grace_s=10.0, poll_s=0.25, resume_arg="--resume",
+            thresholds={"startup": 300.0})
     with obs_journal.run(metrics_dir, config=vars(args),
                          role="fleet") as journal, preempt.guard():
         sup, replicas = spawn_replica_fleet(
             args.checkpoint, args.replicas, run_dir=journal.dir,
-            host=args.host, serve_args=serve_args, journal=journal)
+            host=args.host, serve_args=serve_args,
+            per_replica_args=per_replica_args, policy=policy,
+            journal=journal)
         sup_thread = threading.Thread(target=sup.run, name="fleet-supervisor",
                                       daemon=True)
         sup_thread.start()

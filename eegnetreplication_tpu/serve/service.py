@@ -122,6 +122,15 @@ SERVE_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.05,
 # forward-plus-retry-budget allowance.
 SERVE_WATCHDOG_THRESHOLDS = {"serve_idle": 10.0, "serve_forward": 60.0}
 
+# The client headers every routing tier (fleet front, cell front) must
+# carry verbatim to the serving process on every dispatch AND every
+# failover retry.  Single-sourced here — the PR-10 review caught the
+# fleet silently dropping X-Model because the set was re-spelled by
+# hand; X-Trace-* propagation rides the trace context instead
+# (trace.headers() re-emits per attempt with the current span as
+# parent).
+PASSTHROUGH_HEADERS = ("X-Model", "X-Deadline-Ms", "X-Priority")
+
 
 def make_infer_fn(registry: ModelRegistry, breaker: CircuitBreaker | None
                   = None, chaos_tag: str | None = None):
@@ -750,6 +759,9 @@ class _ServeHandler(JsonRequestHandler):
                 "ladder_retunes": app.ladder_retunes,
                 "queue_depth_trials": app.batcher.queue_depth,
                 "queue_depth_requests": app.batcher.queue_depth_requests,
+                # Open streaming sessions: the cells tier mirrors this
+                # into each cell's membership snapshot.
+                "sessions": len(app.sessions),
                 # Adaptive overload control (null when running the legacy
                 # static queue cliff): the live AIMD limit + shed count.
                 "admission": (app.admission.snapshot()
@@ -770,6 +782,9 @@ class _ServeHandler(JsonRequestHandler):
         if len(parts) == 3 and parts[0] == "session" and parts[2] == "state":
             self._session_state(app, parts[1])
             return
+        if len(parts) == 3 and parts[0] == "session" and parts[2] == "export":
+            self._session_export(app, parts[1])
+            return
         self._reply(404, {"error": f"unknown path {self.path}"})
 
     def do_POST(self):  # noqa: N802 — stdlib naming
@@ -789,11 +804,17 @@ class _ServeHandler(JsonRequestHandler):
                 if len(parts) == 2 and parts[1] == "open":
                     self._session_open(app)
                     return
+                if len(parts) == 2 and parts[1] == "import":
+                    self._session_import(app)
+                    return
                 if len(parts) == 3 and parts[2] == "samples":
                     self._session_samples(app, parts[1])
                     return
                 if len(parts) == 3 and parts[2] == "close":
                     self._session_close(app, parts[1])
+                    return
+                if len(parts) == 3 and parts[2] == "discard":
+                    self._session_discard(app, parts[1])
                     return
             self._reply(404, {"error": f"unknown path {self.path}"})
         finally:
@@ -1127,10 +1148,77 @@ class _ServeHandler(JsonRequestHandler):
         finally:
             app.end_request()
 
+    # -- session migration (cells tier) ------------------------------------
+    def _session_export(self, app: ServeApp, sid: str) -> None:
+        """One session as a stamped single-session npz — the migration
+        wire format the cell front ships between cells.  A GET, not a
+        POST: the export mutates nothing (the session stays live here
+        until an explicit ``/discard``), so a failed import on the
+        target leaves this cell still authoritative."""
+        app.begin_request()
+        try:
+            try:
+                data = app.sessions.export_session(sid)
+            except KeyError:
+                self._reply(404, {"error": f"unknown session {sid!r}"})
+                return
+            self._reply_bytes(200, data,
+                              content_type="application/octet-stream")
+        finally:
+            app.end_request()
+
+    def _session_import(self, app: ServeApp) -> None:
+        """Re-materialize an exported session here (migration/failover
+        landing).  Integrity failures answer 400 with nothing changed; an
+        id already open here answers 409 — both leave every live session
+        untouched."""
+        from eegnetreplication_tpu.resil.integrity import IntegrityError
+        from eegnetreplication_tpu.serve.sessions.store import SessionExists
+
+        try:
+            session = app.sessions.import_session(self._read_body())
+        except SessionExists as exc:
+            self._reply(409, {"error": str(exc)})
+            return
+        except IntegrityError as exc:
+            self._reply(400, {"error": f"IntegrityError: {exc}"})
+            return
+        except Exception as exc:  # noqa: BLE001 — client error
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._reply(200, self._session_json(
+            session, imported=True, n_channels=session.n_channels))
+
+    def _session_discard(self, app: ServeApp, sid: str) -> None:
+        """Drop a session WITHOUT the close-time flush/decide: the
+        migration source calls this after the target confirmed the
+        import, so deciding the remaining buffered windows here would
+        double-decide them.  The removal is persisted immediately — a
+        restart must not resurrect a stream another cell now owns."""
+        # Consume the (empty-JSON) body even though nothing in it is
+        # used: an unread body left in the socket buffer desyncs pooled
+        # keep-alive clients (the cell front) on their NEXT request.
+        self._read_body()
+        session = app.sessions.take(sid)
+        if session is None:
+            self._reply(404, {"error": f"unknown session {sid!r}"})
+            return
+        with session.lock:
+            reply = self._session_json(session, discarded=True)
+            app.journal.event("session_end", session=session.session_id,
+                              windows=session.windows_decided,
+                              expired=session.n_expired,
+                              acked=session.acked, reason="migrated")
+        app.sessions.snapshot()
+        self._reply(200, reply)
+
     def _session_close(self, app: ServeApp, sid: str) -> None:
-        # Claim the session FIRST: racing closes must yield one winner
-        # (which drains and journals) and one clean 404, not a KeyError
-        # 500 and a doubled session_end.
+        # Consume the body first (nothing in it is used, but an unread
+        # body desyncs pooled keep-alive clients on the connection's
+        # next request), then claim the session: racing closes must
+        # yield one winner (which drains and journals) and one clean
+        # 404, not a KeyError 500 and a doubled session_end.
+        self._read_body()
         session = app.sessions.take(sid)
         if session is None:
             self._reply(404, {"error": f"unknown session {sid!r}"})

@@ -36,6 +36,7 @@ when the replay re-decides it.)
 
 from __future__ import annotations
 
+import io
 import json
 import re
 import threading
@@ -68,6 +69,132 @@ RESTORE_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.05,
 
 def valid_session_id(session_id: str) -> bool:
     return bool(_SESSION_ID_RE.match(session_id or ""))
+
+
+class SessionExists(ValueError):
+    """An imported session id is already open in this store (the HTTP
+    layer answers 409 — importing over a live stream would silently fork
+    its decision record)."""
+
+
+def _session_flat(session_id: str, state: dict[str, np.ndarray]
+                  ) -> dict[str, np.ndarray]:
+    """One session's state under the SAME key layout the full-store
+    snapshot uses (``s/<sid>/<key>`` + ``__meta__``) — a single-session
+    export is a one-session store snapshot, not a second format."""
+    flat = {f"s/{session_id}/{k}": v for k, v in state.items()}
+    flat["__meta__"] = np.frombuffer(json.dumps(
+        {"sessions": [session_id]}).encode(), dtype=np.uint8)
+    return flat
+
+
+def pack_session(session_id: str, state: dict[str, np.ndarray]) -> bytes:
+    """Serialize one session's state arrays into a stamped npz byte
+    string (the migration wire format)."""
+    flat = integrity.stamp(_session_flat(session_id, state))
+    buf = io.BytesIO()
+    np.savez(buf, **flat)
+    return buf.getvalue()
+
+
+def unpack_session(data: bytes) -> tuple[str, dict[str, np.ndarray]]:
+    """Parse and integrity-verify a single-session npz byte string;
+    returns ``(session_id, state_arrays)``.
+
+    Raises :class:`~eegnetreplication_tpu.resil.integrity.IntegrityError`
+    on ANY corruption or tampering — including bytes so damaged the zip
+    no longer parses, and exports missing their digest (unlike training
+    checkpoints there are no pre-integrity legacy session exports, so an
+    unstamped payload is refused rather than trusted).
+    """
+    try:
+        with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+            flat = {k: npz[k] for k in npz.files}
+    except Exception as exc:  # noqa: BLE001 — any parse failure is corruption
+        raise integrity.IntegrityError(
+            f"session import is not a readable npz: "
+            f"{type(exc).__name__}: {exc}") from exc
+    if integrity.stored_digest(flat) is None:
+        raise integrity.IntegrityError(
+            "session import carries no content digest")
+    integrity.verify(flat, what="session import")
+    flat.pop(integrity.DIGEST_KEY, None)
+    try:
+        meta = json.loads(bytes(flat.pop("__meta__")).decode())
+        sessions = meta["sessions"]
+    except (KeyError, ValueError, UnicodeDecodeError) as exc:
+        raise integrity.IntegrityError(
+            f"session import metadata unreadable: {exc}") from exc
+    if len(sessions) != 1:
+        raise integrity.IntegrityError(
+            f"session import must hold exactly one session, got "
+            f"{sessions!r}")
+    sid = str(sessions[0])
+    if not valid_session_id(sid):
+        raise integrity.IntegrityError(
+            f"session import names an invalid session id {sid!r}")
+    prefix = f"s/{sid}/"
+    state = {k[len(prefix):]: v for k, v in flat.items()
+             if k.startswith(prefix)}
+    if not state:
+        raise integrity.IntegrityError(
+            f"session import holds no state for its own id {sid!r}")
+    return sid, state
+
+
+def peek_session_id(data: bytes) -> str | None:
+    """Best-effort session id of a packed export WITHOUT verifying it —
+    only the ``__meta__`` zip entry is decompressed.
+
+    Routing tiers (the fleet front) need the id BEFORE choosing where to
+    forward an import: a repeated import of one session must land on the
+    replica that already holds it (409) rather than fork the stream onto
+    a fresh least-loaded pick.  Returns ``None`` for anything unreadable
+    — the serving store's :func:`unpack_session` is the integrity
+    authority and will refuse the payload with a proper error.
+    """
+    try:
+        with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+            meta = json.loads(bytes(npz["__meta__"]).decode())
+        sessions = meta["sessions"]
+        if len(sessions) == 1 and valid_session_id(str(sessions[0])):
+            return str(sessions[0])
+    except Exception:  # noqa: BLE001 — peek is advisory, never the gate
+        pass
+    return None
+
+
+def read_spooled_session(spool: str | Path, session_id: str) -> bytes | None:
+    """Extract ``session_id`` from a dead cell's snapshot spool as a
+    stamped single-session export, or ``None`` when no valid generation
+    holds it.
+
+    ``spool`` is either a store snapshot file (``.../sessions.npz``) or a
+    directory searched recursively for ``sessions.npz`` spools (a
+    fleet-shaped cell keeps one spool per replica).  Resolution walks the
+    same generation chain restores use — a corrupt newest generation is
+    quarantined and the previous one answers — so cross-cell failover
+    inherits the store's durability contract unchanged.
+    """
+    spool = Path(spool)
+    if not spool.exists():
+        return None
+    candidates = ([spool] if spool.is_file() or spool.suffix == ".npz"
+                  else sorted(spool.rglob("sessions.npz")))
+    for path in candidates:
+        try:
+            resolved = resolve_snapshot(path, consume=True)
+        except (OSError, FileNotFoundError):
+            continue
+        if resolved is None:
+            continue
+        _, flat = resolved
+        prefix = f"s/{session_id}/"
+        state = {k[len(prefix):]: v for k, v in flat.items()
+                 if k.startswith(prefix)}
+        if state:
+            return pack_session(session_id, state)
+    return None
 
 
 class SessionStore:
@@ -131,6 +258,53 @@ class SessionStore:
             session = StreamSession(session_id, **session_kwargs)
             self._sessions[session_id] = session
             return session, False
+
+    # -- migration (single-session export/import) -------------------------
+    def export_session(self, session_id: str) -> bytes:
+        """One live session as a stamped single-session npz (the
+        migration wire format).  The session's lock is held across the
+        serialization, so the export captures a quiesced decided-frontier
+        state — the same rollback contract as the full snapshot: any
+        produced-but-undecided window is re-extracted from the buffered
+        samples after the import.  Raises ``KeyError`` for an unknown id
+        (the HTTP layer's 404)."""
+        session = self.get(session_id)
+        with session.lock:
+            state = session.state_arrays()
+        return pack_session(session_id, state)
+
+    def import_session(self, data: bytes) -> StreamSession:
+        """Re-materialize an exported session in THIS store.
+
+        The payload is integrity-verified BEFORE any state changes: a
+        corrupt or tampered export raises
+        :class:`~eegnetreplication_tpu.resil.integrity.IntegrityError`
+        and the store — including any live session under the same id —
+        is left untouched.  An id already open here raises
+        :class:`SessionExists` (the HTTP layer's 409): importing over a
+        live stream would fork its decision record.  The imported
+        session is journaled as a ``session_resume`` (it IS one: the
+        client's next open/state read returns the acked cursor) and
+        persisted immediately, so a crash right after the import cannot
+        lose the migrated stream.
+        """
+        session_id, state = unpack_session(data)
+        session = StreamSession.from_state(session_id, state)
+        with self._lock:
+            if session_id in self._sessions:
+                raise SessionExists(
+                    f"session {session_id!r} is already open in this store")
+            self._sessions[session_id] = session
+        self._journal.event("session_resume", session=session_id,
+                            acked=session.acked,
+                            windows=session.windows_decided,
+                            snapshot="import")
+        self._journal.metrics.inc("session_imports")
+        self.snapshot()
+        logger.info("Session %s imported: acked %d samples, %d window(s) "
+                    "decided", session_id, session.acked,
+                    session.windows_decided)
+        return session
 
     def take(self, session_id: str) -> StreamSession | None:
         """Atomically claim a session out of the table (``None`` when it

@@ -418,6 +418,49 @@ def leg_gray(root: Path) -> None:
     assert "degraded" in states and states[-1] == "live", states
 
 
+def leg_cell_failover(root: Path) -> None:
+    """The multi-cell acceptance drill (ISSUE 12): two real serve-process
+    cells behind an in-process CellFront under mixed bulk+session load;
+    the session's entire cell is SIGKILLed.  Bulk requests fail over with
+    zero client-visible errors, the session resumes on the surviving cell
+    from the dead cell's snapshot spool (client replay-from-acked), the
+    final decision stream equals the uninterrupted reference with zero
+    conflicts — and the journal pins ``cell_member failed`` strictly
+    before ``session_failover``."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import serve_bench
+    import stream_bench
+
+    leg_root = root / "cell_failover"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    ckpt = serve_bench.make_synthetic_checkpoint(leg_root, 4, 64)
+    x = stream_bench.make_recording(4, 1500, seed=5)
+    with obs.run(root / "obs" / "cell_failover") as jr:
+        record = serve_bench.run_cells_kill_leg(
+            ckpt, x, hop=16, init_block=375, chunk=25, root=leg_root,
+            journal=jr, bulk_requests=120, bulk_submitters=4)
+    assert record["sessions_failed_over"] >= 1, record
+    assert record["duplicate_conflicts"] == 0, record
+    assert record["decisions_equal"], record
+    assert record["bulk"]["failures"] == 0, record["bulk"]
+    events = _events(jr)
+    kinds = [e["event"] for e in events]
+    failed_at = [i for i, e in enumerate(events)
+                 if e["event"] == "cell_member"
+                 and e.get("state") == "failed"
+                 and e.get("cell") == record["killed_cell"]]
+    failover_at = [i for i, e in enumerate(events)
+                   if e["event"] == "session_failover"
+                   and e.get("from_cell") == record["killed_cell"]]
+    assert failed_at and failover_at, set(kinds)
+    assert min(failed_at) < min(failover_at), (failed_at, failover_at)
+    # The failover restored real state from the spool (not a from-zero
+    # re-open), and the surviving cell journaled nothing anomalous.
+    assert [e for e in events if e["event"] == "session_failover"
+            and e.get("restored")], "failover did not restore from spool"
+
+
 def leg_combined(root: Path) -> None:
     """The acceptance drill: checkpoint.write corruption + train.step
     device fault + host.preempt on a 2-subject protocol; preempted mid-run,
@@ -477,6 +520,7 @@ LEGS = {
     "supervisor.hang": leg_supervisor_hang,
     "session.resume": leg_session_resume,
     "gray": leg_gray,
+    "cell.failover": leg_cell_failover,
     "combined": leg_combined,
 }
 

@@ -121,6 +121,11 @@ _COLUMNS = (
     # dead/failing replicas, and the last rolling reload's outcome.
     ("fleet_replicas", "fleet"), ("fleet_failovers", "failovers"),
     ("fleet_reload_status", "fleet_reload"),
+    # Multi-cell serving (cell_front_*/cell_member/session_migrate/
+    # session_failover events): cell count, planned migrations, and
+    # unplanned cross-cell session failovers.
+    ("cells", "cells"), ("session_migrations", "migrations"),
+    ("session_failovers", "cell_failovers"),
     # Gray-failure defenses (ISSUE 10): latency-outlier ejections,
     # hedged dispatches fired/won, and requests shed by adaptive
     # admission — the columns a gray drill run renders under.

@@ -95,11 +95,29 @@ G2. **overload ramp** — 2x-saturation offered load against the batcher:
     admission keeps it >= 70% of peak, sheds bulk first, and never sheds
     priority/session-class traffic.
 
+``--cells`` switches to the MULTI-CELL bench (artifact: BENCH_CELLS.json;
+ISSUE 12): two independent cells behind a real
+:class:`~eegnetreplication_tpu.serve.cells.front.CellFront`:
+
+C1. **planned drain-migration** — a paced 250 Hz session streams through
+    the front while its cell is drained mid-stream: the session migrates
+    (export -> integrity-verified import -> affinity flip) with ZERO
+    window expirations and the final decision stream byte-equal to the
+    uninterrupted offline reference;
+C2. **cell kill-failover** — two cells as real serve processes under
+    mixed bulk+session load; one cell (the session's home) is SIGKILLed:
+    bulk requests fail over with zero client-visible errors after the
+    detection window, the session resumes on the survivor from the dead
+    cell's snapshot spool via the client replay-from-acked handshake,
+    and the resumed decision stream equals the uninterrupted reference
+    with zero conflicts.
+
 Usage:
     python scripts/serve_bench.py --out BENCH_SERVE.json
     python scripts/serve_bench.py --selftest
     python scripts/serve_bench.py --fleet 4 --selftest
     python scripts/serve_bench.py --gray --selftest
+    python scripts/serve_bench.py --cells --selftest
 """
 
 from __future__ import annotations
@@ -2240,6 +2258,367 @@ def run_fleet_bench(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# Multi-cell bench (--cells): CellFront + migration/failover, BENCH_CELLS.json.
+# ---------------------------------------------------------------------------
+
+def _stream_bench():
+    """Late import of the sibling script (circular at module level: it
+    imports make_synthetic_checkpoint from here)."""
+    sys.path.insert(0, str(Path(__file__).resolve().parent))
+    import stream_bench
+
+    return stream_bench
+
+
+def _cells_post(url: str, data: bytes = b"{}",
+                ctype: str = "application/json", timeout: float = 60.0
+                ) -> dict:
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def run_cells_migration_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+                            init_block: int, chunk: int, rate_hz: float,
+                            root: Path, journal) -> dict:
+    """C1: drain the session's cell mid-stream; the migration must cost
+    zero window expirations and leave the decision stream byte-equal to
+    the uninterrupted offline reference."""
+    from eegnetreplication_tpu.serve.cells import CellFront, CellMember
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    stream_bench = _stream_bench()
+    apps, members = [], []
+    for i in range(2):
+        spool = root / f"mig_c{i}" / "sessions"
+        app = ServeApp(checkpoint, port=0, sessions_dir=spool / "r0",
+                       session_snapshot_every=16, journal=journal).start()
+        apps.append(app)
+        members.append(CellMember(f"c{i}", app.url, spool=spool,
+                                  journal=journal))
+    front = CellFront(members, port=0, poll_s=0.1, journal=journal)
+    try:
+        front.membership.start()
+        front.membership.wait_live(2, timeout_s=60.0)
+        front.start()
+        window = apps[0].registry.engine.geometry[1]
+        hop_interval_ms = 1000.0 * hop / rate_hz if rate_hz else None
+        deadline_ms = 4.0 * hop_interval_ms if hop_interval_ms else None
+        # Learn the session's home first (the open is idempotent: the
+        # streaming client re-attaches), so the drain targets the cell
+        # that actually holds it.
+        opened = _cells_post(front.url + "/session/open", json.dumps(
+            {"session": "mig", "hop": hop,
+             "ems_init_block_size": init_block,
+             "deadline_ms": deadline_ms}).encode())
+        home = opened["cell"]
+        drained = {"done": False}
+        drain_at = int(0.45 * x.shape[1])
+
+        def on_chunk(pos: int) -> None:
+            if not drained["done"] and pos >= drain_at:
+                drained["done"] = True
+                _cells_post(f"{front.url}/cell/{home}/drain")
+
+        log = stream_bench.DecisionLog()
+        final = stream_bench._stream_session(
+            front.url, "mig", x, hop=hop, init_block=init_block,
+            chunk=chunk, rate_hz=rate_hz, deadline_ms=deadline_ms,
+            log=log, on_chunk=on_chunk)
+    finally:
+        front.stop()
+        for app in apps:
+            app.stop()
+    reference = stream_bench.offline_reference(
+        checkpoint, x, window=window, hop=hop, init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    return {
+        "n_samples": int(x.shape[1]), "hop": hop, "window": window,
+        "rate_hz": rate_hz, "deadline_ms": deadline_ms,
+        "drained_cell": home,
+        "n_windows": int(final["windows"]),
+        "window_expirations": int(final["expired"]),
+        "sessions_migrated": front.sessions_migrated,
+        "duplicate_conflicts": len(log.conflicts),
+        "decisions_equal": bool(len(streamed) == len(reference)
+                                and np.array_equal(streamed, reference)),
+    }
+
+
+def _run_cells_bulk(front_url: str, bodies: list[bytes], n_requests: int,
+                    submitters: int, stop_flag: dict,
+                    per_request_deadline_s: float = 60.0) -> dict:
+    """Bulk /predict load through the front's HTTP endpoint.  429/503 and
+    transport blips are retried within a per-request deadline (the
+    detection window is the front's to absorb); a request that exhausts
+    it — or any other HTTP status — is a client-visible FAILURE."""
+    import urllib.error
+
+    lock = threading.Lock()
+    counter, ok, retried = [0], [0], [0]
+    failures: list[str] = []
+
+    def one(body: bytes) -> None:
+        deadline = time.monotonic() + per_request_deadline_s
+        while time.monotonic() < deadline:
+            try:
+                req = urllib.request.Request(
+                    front_url + "/predict", data=body,
+                    headers={"Content-Type": "application/octet-stream"})
+                with urllib.request.urlopen(req, timeout=30.0):
+                    with lock:
+                        ok[0] += 1
+                    return
+            except urllib.error.HTTPError as err:
+                if err.code in (429, 503):
+                    with lock:
+                        retried[0] += 1
+                    time.sleep(0.01)
+                    continue
+                with lock:
+                    failures.append(f"http {err.code}")
+                return
+            except (urllib.error.URLError, ConnectionError, OSError) as exc:
+                with lock:
+                    retried[0] += 1
+                time.sleep(0.02)
+                del exc
+                continue
+        with lock:
+            failures.append("deadline")
+
+    def submitter() -> None:
+        while not stop_flag.get("stop"):
+            with lock:
+                if counter[0] >= n_requests:
+                    return
+                i = counter[0]
+                counter[0] += 1
+            one(bodies[i % len(bodies)])
+
+    threads = [threading.Thread(target=submitter, daemon=True)
+               for _ in range(submitters)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    return {"n_requests": counter[0], "completed": ok[0],
+            "failures": len(failures), "failure_samples": failures[:3],
+            "availability_retries": retried[0],
+            "wall_s": round(wall, 3),
+            "rps": round(ok[0] / max(wall, 1e-9), 2)}
+
+
+def run_cells_kill_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+                       init_block: int, chunk: int, root: Path, journal,
+                       snapshot_every: int = 4, bulk_requests: int = 300,
+                       bulk_submitters: int = 4, bulk_batch: int = 2,
+                       kill_after_frac: float = 0.45) -> dict:
+    """C2: SIGKILL the session's entire cell under mixed bulk+session
+    load.  Bulk fails over through the front with zero client-visible
+    errors; the session resumes on the survivor from the dead cell's
+    snapshot spool and its final decision stream equals the
+    uninterrupted reference with zero conflicts.  (Shared with the chaos
+    drill's ``cell.failover`` leg, which additionally pins the journal
+    ordering.)"""
+    import subprocess
+
+    from eegnetreplication_tpu.serve.cells import CellFront, CellMember
+    from eegnetreplication_tpu.serve.engine import load_model_from_checkpoint
+    from eegnetreplication_tpu.serve.fleet.service import free_port
+
+    stream_bench = _stream_bench()
+    cells_root = root / "cells"
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:"
+               f"{os.environ.get('PYTHONPATH', '')}")
+    env.setdefault("EEGTPU_COMPILE_CACHE", str(root / "xla_cache"))
+    procs, members, ports = [], [], []
+    for i in range(2):
+        port = free_port()
+        spool = cells_root / f"c{i}" / "sessions"
+        cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
+               "--checkpoint", str(checkpoint), "--port", str(port),
+               "--metricsDir", str(root / f"kill_c{i}_obs"),
+               "--sessionsDir", str(spool / "r0"),
+               "--sessionSnapshotEvery", str(snapshot_every)]
+        procs.append(subprocess.Popen(cmd, env=env))
+        members.append(CellMember(f"c{i}", f"http://127.0.0.1:{port}",
+                                  spool=spool, journal=journal))
+        ports.append(port)
+    front = CellFront(members, port=0, poll_s=0.1, journal=journal)
+    killed = {"done": False}
+    try:
+        for port in ports:
+            stream_bench._wait_healthy(f"http://127.0.0.1:{port}")
+        front.membership.start()
+        front.membership.wait_live(2, timeout_s=60.0)
+        front.start()
+        model, _, _ = load_model_from_checkpoint(checkpoint)
+        c, t = model.n_channels, model.n_times
+        trials = np.random.RandomState(0).randn(
+            max(16, 4 * bulk_batch), c, t).astype(np.float32)
+        bodies = _npz_bodies(trials, bulk_batch)
+        opened = _cells_post(front.url + "/session/open", json.dumps(
+            {"session": "killres", "hop": hop,
+             "ems_init_block_size": init_block}).encode())
+        victim = int(opened["cell"][1:])  # "c0"/"c1" -> process index
+        kill_at = int(kill_after_frac * x.shape[1])
+
+        def on_chunk(pos: int) -> None:
+            if not killed["done"] and pos >= kill_at:
+                killed["done"] = True
+                procs[victim].kill()  # SIGKILL: the whole cell dies
+
+        stop_flag: dict = {}
+        bulk_result: dict = {}
+
+        def bulk() -> None:
+            bulk_result.update(_run_cells_bulk(
+                front.url, bodies, bulk_requests, bulk_submitters,
+                stop_flag))
+
+        bulk_thread = threading.Thread(target=bulk, daemon=True)
+        bulk_thread.start()
+        log = stream_bench.DecisionLog()
+        final = stream_bench._stream_session(
+            front.url, "killres", x, hop=hop, init_block=init_block,
+            chunk=chunk, rate_hz=0.0, deadline_ms=None, log=log,
+            on_chunk=on_chunk)
+        bulk_thread.join(timeout=300.0)
+        stop_flag["stop"] = True
+    finally:
+        front.stop()
+        for proc in procs:
+            proc.kill()
+            proc.wait(timeout=30.0)
+    window = int(final["window"])
+    reference = stream_bench.offline_reference(
+        checkpoint, x, window=window, hop=hop, init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    return {
+        "n_samples": int(x.shape[1]), "hop": hop, "window": window,
+        "chunk_samples": chunk,
+        "snapshot_every_windows": snapshot_every,
+        "killed_cell": f"c{victim}", "killed_at_sample": kill_at,
+        "bulk": bulk_result,
+        "sessions_failed_over": front.sessions_failed_over,
+        "n_windows": int(final["windows"]),
+        "n_reference_windows": int(len(reference)),
+        "duplicate_conflicts": len(log.conflicts),
+        "healed_redeliveries": log.healed,
+        "decisions_equal": bool(len(streamed) == len(reference)
+                                and np.array_equal(streamed, reference)),
+    }
+
+
+def run_cells_bench(args) -> int:
+    """The --cells mode: planned drain-migration + cell kill-failover;
+    write BENCH_CELLS.json."""
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    platform = select_platform()
+    os.environ.setdefault("EEGTPU_PLATFORM", platform)
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.obs import schema as obs_schema
+    from eegnetreplication_tpu.obs.schema import write_json_artifact
+
+    stream_bench = _stream_bench()
+    tmp = Path(args.workDir) if args.workDir \
+        else Path(tempfile.mkdtemp(prefix="cells_bench_"))
+    tmp.mkdir(parents=True, exist_ok=True)
+    checkpoint = (Path(args.checkpoint) if args.checkpoint
+                  else make_synthetic_checkpoint(tmp, args.channels,
+                                                 args.times))
+    n_channels, window = args.channels, args.times
+    if args.checkpoint:
+        from eegnetreplication_tpu.serve.engine import (
+            load_model_from_checkpoint,
+        )
+
+        model, _, _ = load_model_from_checkpoint(checkpoint)
+        n_channels, window = model.n_channels, model.n_times
+    hop = max(1, window // 4)
+    n_samples = int(args.cellsSeconds * stream_bench.HEADSET_RATE_HZ)
+    init_block = min(1000, max(window, n_samples // 4))
+    x = stream_bench.make_recording(n_channels, n_samples)
+    record: dict = {
+        "platform": platform, "selftest": bool(args.selftest),
+        "checkpoint": str(checkpoint),
+        "geometry": {"n_channels": n_channels, "n_times": window},
+        "hop": hop, "ems_init_block_size": init_block,
+    }
+    print(f"[cells] {n_channels}x{n_samples} recording, window {window}, "
+          f"hop {hop}", flush=True)
+    with obs_journal.run(tmp / "obs_migration", config={},
+                         role="cells_bench") as jr:
+        record["migration"] = run_cells_migration_leg(
+            checkpoint, x, hop=hop, init_block=init_block, chunk=25,
+            rate_hz=args.cellsRate, root=tmp / "migration",
+            journal=jr)
+    print(f"[cells] migration: {record['migration']}", flush=True)
+    with obs_journal.run(tmp / "obs_kill", config={},
+                         role="cells_bench") as jr:
+        record["cell_kill"] = run_cells_kill_leg(
+            checkpoint, x, hop=hop, init_block=init_block, chunk=25,
+            root=tmp / "kill", journal=jr,
+            bulk_requests=args.cellsBulkRequests)
+        kill_events = obs_schema.read_events(jr.events_path,
+                                             complete=False)
+    kinds = [e["event"] for e in kill_events]
+    record["cell_kill"]["journal_order_ok"] = bool(
+        "cell_member" in kinds and "session_failover" in kinds
+        and min(i for i, e in enumerate(kill_events)
+                if e["event"] == "cell_member"
+                and e.get("state") == "failed")
+        < kinds.index("session_failover"))
+    print(f"[cells] cell_kill: {record['cell_kill']}", flush=True)
+
+    out = Path(args.cellsOut) if args.cellsOut else (
+        tmp / "BENCH_CELLS_selftest.json" if args.selftest
+        else REPO / "BENCH_CELLS.json")
+    write_json_artifact(out, record, kind="bench", indent=1)
+    print(f"[cells] wrote {out}", flush=True)
+
+    if args.selftest:
+        failures = []
+        mig, kill = record["migration"], record["cell_kill"]
+        if mig["window_expirations"]:
+            failures.append(f"{mig['window_expirations']} window(s) "
+                            "expired during the planned migration")
+        if not mig["decisions_equal"]:
+            failures.append("migrated decision stream != offline "
+                            "reference")
+        if mig["sessions_migrated"] < 1:
+            failures.append("no session_migrate journaled by the drain")
+        if mig["duplicate_conflicts"]:
+            failures.append("re-delivered decisions disagreed across the "
+                            "migration")
+        if not kill["decisions_equal"]:
+            failures.append("failed-over decision stream != uninterrupted "
+                            "reference")
+        if kill["duplicate_conflicts"]:
+            failures.append(f"{kill['duplicate_conflicts']} decision "
+                            "conflict(s) across the cell failover")
+        if kill["sessions_failed_over"] < 1:
+            failures.append("no session_failover journaled by the kill")
+        if kill["bulk"].get("failures", 1):
+            failures.append(f"{kill['bulk'].get('failures')} bulk "
+                            "request(s) failed through the cell kill")
+        if not kill["journal_order_ok"]:
+            failures.append("journal does not pin cell_member failed "
+                            "before session_failover")
+        if failures:
+            print("[cells] SELFTEST FAIL:\n  - " + "\n  - ".join(failures))
+            return 1
+        print("[cells] SELFTEST PASS")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Benchmark the online serving subsystem.")
@@ -2329,6 +2708,26 @@ def main(argv=None) -> int:
                         help="Mixed open-loop requests per zoo arm.")
     parser.add_argument("--zooSubmitters", type=int, default=4,
                         help="Open-loop submitter threads per zoo arm.")
+    parser.add_argument("--cells", action="store_true",
+                        help="Multi-cell mode: two cells behind a "
+                             "CellFront — planned drain-migration and "
+                             "SIGKILL-a-cell failover legs under mixed "
+                             "bulk+session load; writes "
+                             "BENCH_CELLS.json.")
+    parser.add_argument("--cellsOut", default=None,
+                        help="Cells-mode artifact path (default "
+                             "BENCH_CELLS.json at the repo root; selftest "
+                             "defaults to a temp file).")
+    parser.add_argument("--cellsSeconds", type=float, default=12.0,
+                        help="Recording length at 250 Hz for the cells "
+                             "legs (selftest forces 6).")
+    parser.add_argument("--cellsRate", type=float, default=250.0,
+                        help="Replay pacing for the migration leg "
+                             "(selftest paces at 500 Hz — same deadline "
+                             "semantics, half the wall).")
+    parser.add_argument("--cellsBulkRequests", type=int, default=400,
+                        help="Bulk /predict requests riding the cell-kill "
+                             "leg.")
     parser.add_argument("--fleetBatch", type=int, default=16,
                         help="Trials per request in the fleet legs.")
     parser.add_argument("--fleetRequests", type=int, default=600,
@@ -2349,6 +2748,14 @@ def main(argv=None) -> int:
             args.channels, args.times = 4, 64
             args.zooRequests = min(args.zooRequests, 600)
         return run_zoo_bench(args)
+
+    if args.cells:
+        if args.selftest:
+            args.channels, args.times = 4, 64
+            args.cellsSeconds = min(args.cellsSeconds, 6.0)
+            args.cellsBulkRequests = min(args.cellsBulkRequests, 120)
+            args.cellsRate = max(args.cellsRate, 500.0)
+        return run_cells_bench(args)
 
     if args.gray:
         if args.grayReplicas < 3:

@@ -195,6 +195,37 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
     pos = int(reply["acked"])
     t0 = time.perf_counter()
     sent0 = pos
+
+    def resync() -> int:
+        """The replay-from-acked handshake: learn the server's cursor
+        (which also clears a cell front's post-failover resync latch)
+        and replay from there; a server that lost the session entirely
+        re-opens it from zero — still deterministic.  503s ARE the
+        handshake's normal weather (a sticky replica mid-relaunch, a
+        failed-over session without a live home yet): keep retrying
+        within the resume budget instead of dying in the exact window
+        the protocol exists to ride out."""
+        deadline = time.monotonic() + resume_poll_s
+        while True:
+            try:
+                try:
+                    state = _get(f"{base}/session/{sid}/state")
+                except urllib.error.HTTPError as err:
+                    if err.code == 503:
+                        raise  # retryable: re-enter the wait loop below
+                    # Session lost (404): re-open and replay from the
+                    # server's cursor (zero) — still deterministic.
+                    state = _post(base + "/session/open", open_body)
+                return int(state["acked"])
+            except (urllib.error.HTTPError, urllib.error.URLError,
+                    ConnectionError, OSError) as err:
+                code = getattr(err, "code", None)
+                if (code is None or code == 503) \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.2)
+                    continue
+                raise
+
     while pos < x.shape[1]:
         piece = x[:, pos:pos + chunk]
         if rate_hz > 0:
@@ -207,6 +238,23 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
                           piece.astype("<f4").tobytes(),
                           "application/octet-stream")
         except urllib.error.HTTPError as err:
+            if err.code == 409:
+                # Cross-cell failover (the cell front's resync latch):
+                # the session moved cells through a stale spool snapshot
+                # — re-read the acked cursor and replay the gap.
+                pos = resync()
+                t0 = time.perf_counter()
+                sent0 = pos
+                continue
+            if err.code == 503:
+                # The session's cell/replica is momentarily down (front
+                # still up): wait for capacity and resync.
+                time.sleep(0.1)
+                _wait_healthy(base, resume_poll_s)
+                pos = resync()
+                t0 = time.perf_counter()
+                sent0 = pos
+                continue
             if err.code != 404:
                 raise  # a real protocol error, not a dead server
             # Session unknown after a restart (no snapshot survived):
@@ -221,13 +269,7 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
             # Server down (killed / restarting): wait it out, then learn
             # where to resume from — the acked cursor is the contract.
             _wait_healthy(base, resume_poll_s)
-            try:
-                state = _get(f"{base}/session/{sid}/state")
-            except urllib.error.HTTPError:
-                # No snapshot survived (killed before the first one):
-                # re-open and replay from zero — still deterministic.
-                state = _post(base + "/session/open", open_body)
-            pos = int(state["acked"])
+            pos = resync()
             t0 = time.perf_counter()
             sent0 = pos
             continue
@@ -239,7 +281,11 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
         try:
             final = _post(f"{base}/session/{sid}/close", b"{}")
             break
-        except urllib.error.HTTPError:
+        except urllib.error.HTTPError as err:
+            if err.code == 503:  # the session's home is mid-relaunch
+                time.sleep(0.1)
+                _wait_healthy(base, resume_poll_s)
+                continue
             raise  # protocol error: the close itself was rejected
         except (urllib.error.URLError, ConnectionError, OSError):
             _wait_healthy(base, resume_poll_s)

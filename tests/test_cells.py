@@ -1,0 +1,826 @@
+"""Multi-cell serving (``eegnetreplication_tpu/serve/cells/``).
+
+Covers the ISSUE-12 surface: cell-level membership (dark -> failed,
+aggregate-SLO breach -> degraded, rejoin, the ``cell.partition``/
+``refuse=`` chaos seam), the CellFront routing tier (least-loaded bulk
+dispatch with the pinned header-forwarding set on every dispatch AND
+failover retry, sticky session affinity), planned drain-migration
+(export -> integrity-verified import -> affinity flip, ``session_migrate``
+journaled), unplanned cross-cell failover from the snapshot spool with
+the 409 replay-from-acked resync handshake (``cell_member failed``
+pinned before ``session_failover``), the FleetApp session-affinity
+forwarding that makes a fleet a session-capable cell, and the
+``serve_bench.py --cells`` tier-1 selftest (zero window expirations on
+planned migration, zero decision conflicts + bulk availability through
+a cell SIGKILL).
+
+The front/membership machinery is pure HTTP orchestration, so most
+tests run against scriptable stdlib fake cells — no JAX; the end-to-end
+truth (real engines, real processes, real SIGKILL) is the selftest leg
+and the chaos drill's ``cell.failover`` leg.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.resil import inject
+from eegnetreplication_tpu.serve.cells import membership as cms
+from eegnetreplication_tpu.serve.cells.front import CellFront
+from eegnetreplication_tpu.serve.cells.membership import (
+    CellMember,
+    CellMembership,
+)
+from eegnetreplication_tpu.serve.sessions import store as session_store
+from eegnetreplication_tpu.serve.sessions.session import (
+    StreamSession,
+    WindowDecision,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _session_state(sid: str = "s1", acked: int = 160) -> dict:
+    """A small but real StreamSession state (the export wire format is
+    built from exactly this)."""
+    session = StreamSession(sid, n_channels=2, window=16, hop=8,
+                            ems_init_block_size=8)
+    x = np.random.RandomState(7).randn(2, acked).astype(np.float32)
+    for idx, start, win in session.ingest(x):
+        session.record(WindowDecision(index=idx, start=start, pred=1,
+                                      status="ok", latency_ms=1.0))
+    return session.state_arrays()
+
+
+class FakeCell:
+    """A scriptable cell double: serve-protocol /healthz, /predict, and
+    the /session/* surface the front forwards to.  Knobs are plain
+    attributes mutated mid-test."""
+
+    def __init__(self, port: int = 0):
+        self.digest = "d0"
+        self.degraded: list[str] = []       # non-empty -> healthz 503
+        self.slo_any_breached = False
+        self.queue_depth = 0
+        self.predictions = [0, 1, 2]
+        self.predict_status = 200
+        self.sessions: dict[str, int] = {}  # sid -> acked advert
+        self.export_payload: bytes | None = None
+        self.import_status: int | None = None  # None = real behavior
+        self.imports: list[bytes] = []
+        self.log: list[tuple[str, bytes]] = []
+        self.headers_log: list[tuple[str, dict]] = []
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.0"  # a stopped fake must look DEAD
+
+            def log_message(self, *a):  # noqa: A003 — quiet
+                pass
+
+            def _reply(self, code, payload):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_octets(self, code, body):
+                self.send_response(code)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                parts = self.path.strip("/").split("/")
+                if self.path == "/healthz":
+                    code = 503 if fake.degraded else 200
+                    self._reply(code, {
+                        "status": "degraded" if fake.degraded else "ok",
+                        "degraded": fake.degraded,
+                        "variables_digest": fake.digest,
+                        "queue_depth_requests": fake.queue_depth,
+                        "sessions": len(fake.sessions),
+                        "slo": {"breached": [],
+                                "any_breached": fake.slo_any_breached}})
+                    return
+                if len(parts) == 3 and parts[0] == "session":
+                    sid = parts[1]
+                    if sid not in fake.sessions:
+                        self._reply(404, {"error": "unknown session"})
+                        return
+                    if parts[2] == "state":
+                        self._reply(200, {"session": sid,
+                                          "acked": fake.sessions[sid],
+                                          "windows": 0})
+                        return
+                    if parts[2] == "export":
+                        payload = fake.export_payload
+                        if payload is None:
+                            payload = session_store.pack_session(
+                                sid, _session_state(sid))
+                        self._reply_octets(200, payload)
+                        return
+                self._reply(404, {})
+
+            def do_POST(self):  # noqa: N802
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                body = self.rfile.read(n) if n else b""
+                fake.log.append((self.path, body))
+                fake.headers_log.append((self.path,
+                                         dict(self.headers.items())))
+                parts = self.path.strip("/").split("/")
+                if self.path == "/predict":
+                    if fake.predict_status != 200:
+                        self._reply(fake.predict_status,
+                                    {"error": "scripted"})
+                        return
+                    self._reply(200, {"predictions": fake.predictions,
+                                      "n": len(fake.predictions),
+                                      "model_digest": fake.digest})
+                    return
+                if self.path == "/session/open":
+                    payload = json.loads(body.decode() or "{}")
+                    sid = payload.get("session") or "anon"
+                    resumed = sid in fake.sessions
+                    fake.sessions.setdefault(sid, 0)
+                    self._reply(200, {"session": sid,
+                                      "acked": fake.sessions[sid],
+                                      "windows": 0, "resumed": resumed})
+                    return
+                if self.path == "/session/import":
+                    fake.imports.append(body)
+                    if fake.import_status is not None:
+                        self._reply(fake.import_status,
+                                    {"error": "scripted"})
+                        return
+                    try:
+                        sid, state = session_store.unpack_session(body)
+                    except Exception as exc:  # noqa: BLE001
+                        self._reply(400, {"error": str(exc)})
+                        return
+                    if sid in fake.sessions:
+                        self._reply(409, {"error": "already open"})
+                        return
+                    restored = StreamSession.from_state(sid, state)
+                    fake.sessions[sid] = restored.acked
+                    self._reply(200, {"session": sid,
+                                      "acked": restored.acked,
+                                      "imported": True})
+                    return
+                if len(parts) == 3 and parts[0] == "session":
+                    sid = parts[1]
+                    if sid not in fake.sessions:
+                        self._reply(404, {"error": "unknown session"})
+                        return
+                    if parts[2] == "samples":
+                        self._reply(200, {"session": sid,
+                                          "acked": fake.sessions[sid],
+                                          "decisions": []})
+                        return
+                    if parts[2] in ("close", "discard"):
+                        fake.sessions.pop(sid, None)
+                        self._reply(200, {"session": sid, "windows": 0,
+                                          "expired": 0, "acked": 0,
+                                          "preds": []})
+                        return
+                self._reply(404, {})
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def posts(self, path_suffix: str) -> list[bytes]:
+        return [b for p, b in self.log if p.endswith(path_suffix)]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+@pytest.fixture
+def journal(tmp_path):
+    with obs_journal.run(tmp_path / "obs", config={}) as jr:
+        yield jr
+
+
+def _members(fakes, journal, spools=None):
+    spools = spools or [None] * len(fakes)
+    return [CellMember(f"c{i}", fake.url, spool=spool, journal=journal)
+            for i, (fake, spool) in enumerate(zip(fakes, spools))]
+
+
+def _events(jr, kind):
+    return [e for e in schema.read_events(jr.events_path, complete=False)
+            if e["event"] == kind]
+
+
+def _post(url, data=b"{}", ctype="application/json", headers=None):
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": ctype, **(headers or {})})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def _get(url):
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+# ---------------------------------------------------------------------------
+# Cell membership: the fleet state machine one level up.
+
+
+class TestCellMembership:
+    def test_dark_cell_fails_and_rejoins_with_cell_member_events(
+            self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        membership = CellMembership(_members([fake0, fake1], journal),
+                                    journal=journal)
+        membership.poll_once()
+        assert [c.state for c in membership.replicas] == ["live", "live"]
+        port = fake0.port
+        fake0.stop()
+        membership.poll_once()
+        membership.poll_once()
+        assert membership.by_id("c0").state == cms.FAILED
+        assert membership.dispatchable() == [membership.by_id("c1")]
+        # Same port, fresh process: the first healthy poll rejoins it.
+        fake0b = FakeCell(port=port)
+        membership.poll_once()
+        assert membership.by_id("c0").state == "live"
+        events = _events(journal, "cell_member")
+        assert all("cell" in e for e in events)
+        c0 = [(e["state"], e["reason"]) for e in events
+              if e["cell"] == "c0"]
+        assert ("failed", "unreachable: ConnectionRefusedError") in c0 \
+            or any(s == "failed" for s, _ in c0)
+        assert c0[-1][0] == "live" and c0[-1][1] == "rejoined"
+        fake0b.stop()
+        fake1.stop()
+        membership.close()
+
+    def test_aggregate_slo_breach_degrades_and_recovers(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        membership = CellMembership(_members([fake0, fake1], journal),
+                                    journal=journal)
+        membership.poll_once()
+        fake0.slo_any_breached = True
+        membership.poll_once()
+        cell = membership.by_id("c0")
+        assert cell.state == "degraded" and cell.slo_any_breached
+        assert membership.dispatchable() == [membership.by_id("c1")]
+        fake0.slo_any_breached = False
+        membership.poll_once()
+        assert cell.state == "live"
+        reasons = [e["reason"] for e in _events(journal, "cell_member")
+                   if e["cell"] == "c0"]
+        assert any(r.startswith("slo_breached") for r in reasons)
+        fake0.stop()
+        fake1.stop()
+        membership.close()
+
+    def test_healthz_503_degrades_cell(self, journal):
+        fake = FakeCell()
+        membership = CellMembership(_members([fake], journal),
+                                    journal=journal)
+        membership.poll_once()
+        fake.degraded = ["circuit_open"]
+        membership.poll_once()
+        assert membership.by_id("c0").state == "degraded"
+        fake.stop()
+        membership.close()
+
+    def test_partition_site_fails_exactly_one_tagged_cell(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        membership = CellMembership(_members([fake0, fake1], journal),
+                                    journal=journal)
+        membership.poll_once()
+        with inject.scoped(inject.FaultSpec(site="cell.partition",
+                                            times=0, refuse=1,
+                                            if_tag="c0")):
+            membership.poll_once()
+            membership.poll_once()
+            assert membership.by_id("c0").state == cms.FAILED
+            assert membership.by_id("c1").state == "live"
+        membership.poll_once()
+        assert membership.by_id("c0").state == "live"  # partition healed
+        injected = _events(journal, "fault_injected")
+        assert all(e["site"] == "cell.partition" for e in injected)
+        fake0.stop()
+        fake1.stop()
+        membership.close()
+
+
+# ---------------------------------------------------------------------------
+# CellFront: bulk routing + the pinned header-forwarding set.
+
+
+def _front(fakes, journal, spools=None, **kw):
+    front = CellFront(_members(fakes, journal, spools), port=0,
+                      poll_s=60.0, journal=journal, **kw)
+    front.membership.poll_once()
+    front.start()
+    return front
+
+
+PINNED_HEADERS = {
+    "X-Model": "subject3",
+    "X-Deadline-Ms": "750",
+    "X-Priority": "high",
+    "X-Trace-Id": "0123456789abcdef",
+    "X-Trace-Sampled": "1",
+}
+
+
+class TestCellFrontRouting:
+    @pytest.mark.parametrize("header", sorted(PINNED_HEADERS))
+    def test_predict_forwards_pinned_header_set(self, journal, header):
+        """The ISSUE-12 header audit: every client header in the pinned
+        set must reach the cell on a dispatch (X-Trace-* through the
+        propagation context, the rest verbatim)."""
+        fake = FakeCell()
+        front = _front([fake], journal)
+        try:
+            status, _ = _post(front.url + "/predict",
+                              json.dumps({"trials": []}).encode(),
+                              headers=PINNED_HEADERS)
+            assert status == 200
+            path, sent = [(p, h) for p, h in fake.headers_log
+                          if p == "/predict"][0]
+            assert sent.get(header) == PINNED_HEADERS[header], (header,
+                                                                sent)
+        finally:
+            front.stop()
+            fake.stop()
+
+    @pytest.mark.parametrize("header", sorted(PINNED_HEADERS))
+    def test_failover_retry_forwards_pinned_header_set(self, journal,
+                                                       header):
+        """...and the same set must survive a transport failover onto
+        the sibling (the PR-10 regression, pinned one level up)."""
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)
+        try:
+            fake0.stop()  # c0 is least-loaded first pick; dies on contact
+            status, _ = _post(front.url + "/predict",
+                              json.dumps({"trials": []}).encode(),
+                              headers=PINNED_HEADERS)
+            assert status == 200
+            sent = [h for p, h in fake1.headers_log if p == "/predict"][0]
+            assert sent.get(header) == PINNED_HEADERS[header], (header,
+                                                                sent)
+            assert front.membership.by_id("c0").state == cms.FAILED
+        finally:
+            front.stop()
+            fake1.stop()
+
+    def test_predict_routes_least_loaded(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        fake0.queue_depth = 50
+        front = _front([fake0, fake1], journal)
+        try:
+            front.membership.poll_once()  # pick up the queue depths
+            _post(front.url + "/predict", json.dumps({"trials": []}).encode())
+            assert len(fake1.posts("/predict")) == 1
+            assert not fake0.posts("/predict")
+        finally:
+            front.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_no_live_cells_is_503(self, journal):
+        import urllib.error
+
+        fake = FakeCell()
+        front = _front([fake], journal)
+        try:
+            fake.degraded = ["wedged"]
+            front.membership.poll_once()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(front.url + "/predict", b"{}")
+            assert err.value.code == 503
+        finally:
+            front.stop()
+            fake.stop()
+
+
+class TestCellFrontSessions:
+    def test_sticky_affinity_and_close_drops_it(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)
+        try:
+            _, opened = _post(front.url + "/session/open",
+                              json.dumps({"session": "s1"}).encode())
+            home = opened["cell"]
+            for _ in range(3):
+                _post(front.url + "/session/s1/samples", b"{}")
+            fakes = {"c0": fake0, "c1": fake1}
+            assert len(fakes[home].posts("/samples")) == 3
+            other = fakes["c1" if home == "c0" else "c0"]
+            assert not other.posts("/samples")
+            _post(front.url + "/session/s1/close")
+            assert front.cell_of("s1") is None
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(front.url + "/session/s1/samples", b"{}")
+            assert err.value.code == 404
+        finally:
+            front.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_anonymous_open_gets_front_assigned_id(self, journal):
+        fake = FakeCell()
+        front = _front([fake], journal)
+        try:
+            _, opened = _post(front.url + "/session/open", b"{}")
+            sid = opened["session"]
+            assert sid and sid != "anon"  # the FRONT named it, not the fake
+            assert front.cell_of(sid).cell_id == opened["cell"]
+        finally:
+            front.stop()
+            fake.stop()
+
+    def test_drain_migrates_flips_affinity_and_journals(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)
+        try:
+            _, opened = _post(front.url + "/session/open",
+                              json.dumps({"session": "s1"}).encode())
+            fakes = {"c0": fake0, "c1": fake1}
+            home = opened["cell"]
+            target_id = "c1" if home == "c0" else "c0"
+            status, result = _post(f"{front.url}/cell/{home}/drain")
+            assert status == 200 and result["migrated"] == ["s1"], result
+            # Export left the source, the import landed on the target,
+            # and the source copy was discarded.
+            assert fakes[target_id].imports
+            assert fakes[home].posts("/discard")
+            # Affinity flipped: samples now land on the target, with no
+            # resync latch (the export was quiesced at the frontier).
+            _post(front.url + "/session/s1/samples", b"{}")
+            assert fakes[target_id].posts("/samples")
+            assert not fakes[home].posts("/samples")
+            # The drained cell is pinned out of bulk rotation...
+            assert front.membership.by_id(home).state == "draining"
+            front.membership.poll_once()  # ...and a healthy poll cannot
+            assert front.membership.by_id(home).state == "draining"
+            migrations = _events(journal, "session_migrate")
+            assert [(e["session"], e["from_cell"], e["to_cell"])
+                    for e in migrations] == [("s1", home, target_id)]
+            # Undrain releases the pin and the poller re-LIVEs it.
+            _post(f"{front.url}/cell/{home}/undrain")
+            front.membership.poll_once()
+            assert front.membership.by_id(home).state == "live"
+        finally:
+            front.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_tampered_migration_import_refused_session_stays(
+            self, journal):
+        """The integrity gate end-to-end: a tampered export is refused
+        by the target (400) and the drain reports the session failed —
+        still serving on the source."""
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)
+        try:
+            _, opened = _post(front.url + "/session/open",
+                              json.dumps({"session": "s1"}).encode())
+            fakes = {"c0": fake0, "c1": fake1}
+            home = opened["cell"]
+            good = session_store.pack_session("s1", _session_state("s1"))
+            bad = bytearray(good)
+            bad[len(bad) // 2] ^= 0xFF
+            fakes[home].export_payload = bytes(bad)
+            status, result = _post(f"{front.url}/cell/{home}/drain")
+            assert status == 207 and result["failed"] == ["s1"], result
+            assert front.cell_of("s1").cell_id == home
+            assert not fakes[home].posts("/discard")
+            assert not _events(journal, "session_migrate")
+        finally:
+            front.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_cell_kill_fails_over_from_spool_with_resync_handshake(
+            self, journal, tmp_path):
+        """The unplanned path end-to-end against fakes: kill the home
+        cell -> lazy failover restores from its spool on the survivor ->
+        the next /samples answers 409 (resume) -> a state read clears
+        the latch -> samples flow again.  The journal pins cell_member
+        failed before session_failover."""
+        import urllib.error
+
+        spool = tmp_path / "c0_spool"
+        store = session_store.SessionStore(spool / "r0" / "sessions.npz")
+        restored = StreamSession.from_state("s1", _session_state("s1"))
+        store._sessions["s1"] = restored
+        store.snapshot()
+        store.detach()
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal, spools=[spool, None])
+        try:
+            fake0.queue_depth = 0
+            fake1.queue_depth = 99  # pin the session's home to c0
+            front.membership.poll_once()
+            _, opened = _post(front.url + "/session/open",
+                              json.dumps({"session": "s1"}).encode())
+            assert opened["cell"] == "c0"
+            fake1.queue_depth = 0
+            fake0.stop()
+            # First touch hits the dead cell: 503 while the failover
+            # machinery reacts (mark_unreachable fired on the forward).
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(front.url + "/session/s1/samples", b"{}")
+            assert err.value.code == 503
+            assert front.membership.by_id("c0").state == cms.FAILED
+            # Next touch: the session has failed over (lazily or via the
+            # transition hook) and the resync latch answers 409.
+            deadline = time.monotonic() + 10.0
+            code = None
+            while time.monotonic() < deadline:
+                try:
+                    _post(front.url + "/session/s1/samples", b"{}")
+                    code = 200
+                except urllib.error.HTTPError as e:
+                    code = e.code
+                if code == 409:
+                    break
+                time.sleep(0.05)
+            assert code == 409
+            assert fake1.imports, "no import reached the survivor"
+            # The replay-from-acked handshake: a state read returns the
+            # restored cursor and clears the latch.
+            status, state = _get(front.url + "/session/s1/state")
+            assert status == 200 and state["acked"] == 160
+            status, _ = _post(front.url + "/session/s1/samples", b"{}")
+            assert status == 200
+            assert fake1.posts("/samples")
+            events = schema.read_events(journal.events_path,
+                                        complete=False)
+            kinds = [e["event"] for e in events]
+            failed_at = min(i for i, e in enumerate(events)
+                            if e["event"] == "cell_member"
+                            and e.get("state") == "failed")
+            assert failed_at < kinds.index("session_failover")
+            fo = _events(journal, "session_failover")[0]
+            assert fo["from_cell"] == "c0" and fo["to_cell"] == "c1"
+            assert fo["restored"] is True and fo["acked"] == 160
+        finally:
+            front.stop()
+            fake1.stop()
+
+    def test_failover_without_spool_reopens_from_zero(self, journal):
+        """No snapshot survived: affinity still moves, the session is
+        NOT restored, and the client's handshake lands on a fresh
+        session (404 on state -> re-open) — still deterministic."""
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)  # no spools at all
+        try:
+            fake1.queue_depth = 99
+            front.membership.poll_once()
+            _post(front.url + "/session/open",
+                  json.dumps({"session": "s1"}).encode())
+            fake1.queue_depth = 0
+            fake0.stop()
+            front.membership.poll_once()
+            front.membership.poll_once()
+            assert front.membership.by_id("c0").state == cms.FAILED
+            deadline = time.monotonic() + 10.0
+            while front.cell_of("s1").cell_id != "c1" \
+                    and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert front.cell_of("s1").cell_id == "c1"
+            fo = _events(journal, "session_failover")[0]
+            assert fo["restored"] is False
+            assert not fake1.imports
+            # The handshake: state 404s on the survivor, the client
+            # re-opens through the front and replays from zero.
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(front.url + "/session/s1/state")
+            assert err.value.code == 404
+            status, opened = _post(front.url + "/session/open",
+                                   json.dumps({"session": "s1"}).encode())
+            assert status == 200 and opened["acked"] == 0
+            assert opened["cell"] == "c1"
+            status, _ = _post(front.url + "/session/s1/samples", b"{}")
+            assert status == 200
+        finally:
+            front.stop()
+            fake1.stop()
+
+    def test_healthz_reports_cells_and_sessions(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)
+        try:
+            _post(front.url + "/session/open",
+                  json.dumps({"session": "s1"}).encode())
+            status, health = _get(front.url + "/healthz")
+            assert status == 200
+            assert health["n_cells"] == 2 and health["n_live"] == 2
+            assert health["sessions"] == 1
+            assert {c["cell"] for c in health["cells"]} == {"c0", "c1"}
+        finally:
+            front.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_event_summary_reports_cells_fields(self, journal):
+        fake0, fake1 = FakeCell(), FakeCell()
+        front = _front([fake0, fake1], journal)
+        try:
+            _, opened = _post(front.url + "/session/open",
+                              json.dumps({"session": "s1"}).encode())
+            _post(f"{front.url}/cell/{opened['cell']}/drain")
+        finally:
+            front.stop()
+            fake0.stop()
+            fake1.stop()
+        summary = schema.event_summary(
+            schema.read_events(journal.events_path, complete=False))
+        assert summary["cells"] == 2
+        assert summary["session_migrations"] == 1
+        assert summary["session_failovers"] == 0
+        assert summary["cell_member_transitions"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# FleetApp as a session-capable cell: sticky replica forwarding.
+
+
+class TestFleetSessionForwarding:
+    def test_fleet_forwards_sessions_sticky_and_import_assigns(
+            self, journal):
+        from eegnetreplication_tpu.serve.fleet import membership as ms
+        from eegnetreplication_tpu.serve.fleet.service import FleetApp
+
+        fake0, fake1 = FakeCell(), FakeCell()  # speak the serve protocol
+        replicas = [ms.Replica(f"r{i}", f.url, journal=journal)
+                    for i, f in enumerate((fake0, fake1))]
+        app = FleetApp(replicas, "ck.npz", port=0, poll_s=60.0,
+                       journal=journal)
+        app.membership.poll_once()
+        app.start()
+        try:
+            _, opened = _post(app.url + "/session/open",
+                              json.dumps({"session": "f1"}).encode())
+            assert opened["session"] == "f1"
+            for _ in range(2):
+                _post(app.url + "/session/f1/samples", b"{}")
+            served = [f for f in (fake0, fake1) if f.posts("/samples")]
+            assert len(served) == 1 and len(served[0].posts("/samples")) == 2
+            # Import lands on a replica and becomes sticky there.
+            data = session_store.pack_session("f2", _session_state("f2"))
+            status, reply = _post(app.url + "/session/import", data,
+                                  ctype="application/octet-stream")
+            assert status == 200 and reply["acked"] == 160
+            status, state = _get(app.url + "/session/f2/state")
+            assert status == 200 and state["acked"] == 160
+            # Close drops stickiness.
+            _post(app.url + "/session/f1/close")
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/f1/samples", b"{}")
+            assert err.value.code == 404
+        finally:
+            app.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_repeated_import_lands_on_the_same_replica(self, journal):
+        # The cells front retries an import whose response was lost after
+        # the fleet committed it, and relies on 409 = "the stream is
+        # there".  A repeat must route to the replica that already holds
+        # the session (409), never fork it onto a fresh least-loaded pick
+        # (which would answer 200 from a second live copy).
+        from eegnetreplication_tpu.serve.fleet import membership as ms
+        from eegnetreplication_tpu.serve.fleet.service import FleetApp
+
+        fake0, fake1 = FakeCell(), FakeCell()
+        replicas = [ms.Replica(f"r{i}", f.url, journal=journal)
+                    for i, f in enumerate((fake0, fake1))]
+        app = FleetApp(replicas, "ck.npz", port=0, poll_s=60.0,
+                       journal=journal)
+        app.membership.poll_once()
+        app.start()
+        try:
+            data = session_store.pack_session("f2", _session_state("f2"))
+            status, _ = _post(app.url + "/session/import", data,
+                              ctype="application/octet-stream")
+            assert status == 200
+            holder = next(f for f in (fake0, fake1)
+                          if f.posts("/session/import"))
+            other = fake1 if holder is fake0 else fake0
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/import", data,
+                      ctype="application/octet-stream")
+            assert err.value.code == 409
+            assert len(holder.posts("/session/import")) == 2
+            assert not other.posts("/session/import")
+        finally:
+            app.stop()
+            fake0.stop()
+            fake1.stop()
+
+    def test_fleet_parser_accepts_resume(self, capsys):
+        # The cells supervisor relaunches a crashed fleet-shaped cell
+        # with --resume appended; an unknown flag would argparse-exit 2
+        # (in fatal_exit_codes) and retire the cell permanently.
+        from eegnetreplication_tpu.serve.fleet import service as fleet_service
+
+        with pytest.raises(SystemExit) as exc:
+            fleet_service.main(["--help"])
+        assert exc.value.code == 0
+        assert "--resume" in capsys.readouterr().out
+
+    def test_session_on_down_replica_answers_503_not_a_fork(self, journal):
+        from eegnetreplication_tpu.serve.fleet import membership as ms
+        from eegnetreplication_tpu.serve.fleet.service import FleetApp
+
+        fake0, fake1 = FakeCell(), FakeCell()
+        replicas = [ms.Replica(f"r{i}", f.url, journal=journal)
+                    for i, f in enumerate((fake0, fake1))]
+        app = FleetApp(replicas, "ck.npz", port=0, poll_s=60.0,
+                       journal=journal)
+        app.membership.poll_once()
+        app.start()
+        try:
+            _, opened = _post(app.url + "/session/open",
+                              json.dumps({"session": "f1"}).encode())
+            sticky = app.session_replica("f1")
+            fakes = {fake0.url: fake0, fake1.url: fake1}
+            fakes[sticky.url].stop()
+            import urllib.error
+
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/f1/samples", b"{}")
+            assert err.value.code == 503
+            # A re-open while the sticky replica is down must NOT move
+            # the session to a sibling (that would fork the stream).
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + "/session/open",
+                      json.dumps({"session": "f1"}).encode())
+            assert err.value.code == 503
+            assert app.session_replica("f1") is sticky
+            survivor = fake1 if fakes[sticky.url] is fake0 else fake0
+            assert not survivor.posts("/session/open") \
+                or len(survivor.posts("/session/open")) == 0
+        finally:
+            app.stop()
+            fake0.stop()
+            fake1.stop()
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 selftest: real engines, real processes, real SIGKILL.
+
+
+class TestCellsBenchSelftest:
+    def test_cells_selftest_passes(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
+             "--cells", "--selftest",
+             "--cellsOut", str(tmp_path / "BENCH_CELLS_selftest.json")],
+            capture_output=True, text=True, timeout=420,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu", JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, (proc.stdout[-4000:]
+                                      + proc.stderr[-2000:])
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads(
+            (tmp_path / "BENCH_CELLS_selftest.json").read_text())
+        assert record["migration"]["window_expirations"] == 0
+        assert record["migration"]["decisions_equal"]
+        assert record["cell_kill"]["decisions_equal"]
+        assert record["cell_kill"]["duplicate_conflicts"] == 0
+        assert record["cell_kill"]["bulk"]["failures"] == 0
+        assert record["cell_kill"]["journal_order_ok"]

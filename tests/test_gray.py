@@ -91,6 +91,43 @@ class TestInjectGray:
                 inject.fire("replica.network")
             inject.fire("replica.network")  # times=1: spent
 
+    def test_refuse_action_raises_connection_refused(self):
+        """cell.partition (ISSUE 12): the client seam sees exactly what a
+        dead/partitioned cell produces — a ConnectionRefusedError (an
+        OSError, so the dispatch path classifies it as a dead
+        connection), confined by if_tag= to one cell id."""
+        with inject.scoped(inject.FaultSpec(site="cell.partition", times=0,
+                                            refuse=1, if_tag="c1")):
+            inject.fire("cell.partition", tag="c0")  # sibling untouched
+            with pytest.raises(ConnectionRefusedError):
+                inject.fire("cell.partition", tag="c1")
+        # refuse is the site's DEFAULT action: a bare spec partitions too.
+        with inject.scoped(inject.FaultSpec(site="cell.partition",
+                                            times=1)):
+            with pytest.raises(ConnectionRefusedError):
+                inject.fire("cell.partition")
+            inject.fire("cell.partition")  # times=1: spent
+
+    @pytest.mark.parametrize("spec", [
+        "cell.partition:refuse=0", "cell.partition:refuse=2",
+        "cell.partition:refuse=-1", "cell.partition:refuse=yes",
+        "cell.partition:refuse=1:action=raise",
+    ])
+    def test_malformed_refuse_fails_at_plan_parse_time(self, spec):
+        """refuse= gets the same parse-time strictness as slow=/sleep=:
+        a typo'd plan fails before the drill starts."""
+        with pytest.raises(ValueError):
+            inject.parse_plan(spec)
+
+    def test_refuse_parses_from_plan_text_and_file(self, tmp_path):
+        specs = inject.parse_plan("cell.partition:refuse=1:if_tag=c0")
+        assert specs[0].action == "refuse" and specs[0].if_tag == "c0"
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps(
+            [{"site": "cell.partition", "refuse": 1, "times": 0}]))
+        specs = inject.parse_plan(f"@{plan}")
+        assert specs[0].action == "refuse" and specs[0].times == 0
+
     @pytest.mark.parametrize("spec", [
         "serve.degrade:slow=-1", "serve.degrade:slow=inf",
         "serve.degrade:slow=nan", "serve.degrade:slow=oops",
@@ -351,6 +388,13 @@ class TestHedging:
                 HedgePolicy(quantile=0.9, budget_fraction=0.5,
                             min_samples=8, max_delay_ms=50.0))
             self._warm_window(router, 12)
+            # Deltas, not absolutes: with the delay floor at 1ms, a
+            # scheduler blip DURING warm-up can legitimately fire a
+            # hedge or two — the claim under test is that the slow
+            # dispatch fires exactly one more and the hedge wins it.
+            hedges_before = router.n_hedges
+            wins_before = router.n_hedge_wins
+            events_before = len(_events(journal, "hedge"))
             slow.predict_delay = 0.5
             slow.queue_depth, fast.queue_depth = 0, 10  # prefer slow
             membership.poll_once()
@@ -360,9 +404,9 @@ class TestHedging:
             assert status == 200
             assert replica_id == "r1"          # the hedge answered
             assert elapsed < 0.4               # did NOT wait out the 0.5s
-            assert router.n_hedges == 1
-            assert router.n_hedge_wins == 1
-            ev = _events(journal, "hedge")
+            assert router.n_hedges == hedges_before + 1
+            assert router.n_hedge_wins == wins_before + 1
+            ev = _events(journal, "hedge")[events_before:]
             assert len(ev) == 1
             assert ev[0]["primary"] == "r0" and ev[0]["hedge"] == "r1"
             assert ev[0]["winner"] == "hedge"
