@@ -50,6 +50,19 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
               "grad_norm", "n_folds"),
     "device_fault": ("error", "fold_lo", "fold_hi", "retry_fold_batch",
                      "elapsed_s"),
+    # Snapshot persistence (training/async_ckpt.py): one event per
+    # run-snapshot write.  dur_ms is the full serialize+write+rename wall,
+    # blocked_ms the part the step loop actually waited on (== dur_ms for
+    # synchronous writes, ~0 when the background writer overlaps the next
+    # chunk), overlapped_ms their difference, generation the writer's
+    # monotonically increasing write sequence number — so the async
+    # overlap is provable from the journal alone.  An extra drain=True
+    # marks the close()-time join of a run's final async write (shutdown
+    # tail — there is no next chunk to overlap — so stall accounting
+    # skips it); ok=False (+error) marks a write whose snapshot did NOT
+    # land — summaries count only landed writes as durable.
+    "checkpoint_write": ("dur_ms", "async", "overlapped_ms", "blocked_ms",
+                         "generation"),
     # resil/: deterministic fault injection, shared retry policy, and
     # checkpoint quarantine all journal through these.
     "fault_injected": ("site", "action", "hit"),
@@ -466,6 +479,29 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         still = sorted(o for o, s in last_state.items()
                        if s == "slo_breach")
         out["slo_breached_now"] = still
+    # Snapshot persistence: total write time vs the part the step loop
+    # actually stalled on — ckpt_blocked_ms ~0 with overlapped (async)
+    # writes is the journal-derived proof the checkpoint cost left the
+    # critical path; only reported when the run wrote snapshots.
+    ckpt_writes = [e for e in events if e["event"] == "checkpoint_write"]
+    if ckpt_writes:
+        # ok=False writes never landed (the run saw the error at the next
+        # submit/close) — they must not count as durable snapshots.  Their
+        # wall/stall time WAS spent though, so the time sums cover every
+        # write: the run where a write failed is exactly the one whose
+        # checkpoint cost an operator is trying to see.
+        landed = [e for e in ckpt_writes if e.get("ok", True)]
+        out["checkpoint_writes"] = len(landed)
+        if len(landed) < len(ckpt_writes):
+            out["ckpt_failed"] = len(ckpt_writes) - len(landed)
+        out["ckpt_ms"] = round(sum(
+            e["dur_ms"] for e in ckpt_writes
+            if isinstance(e.get("dur_ms"), numbers.Real)), 3)
+        out["ckpt_blocked_ms"] = round(sum(
+            e["blocked_ms"] for e in ckpt_writes
+            if isinstance(e.get("blocked_ms"), numbers.Real)
+            and not e.get("drain")), 3)
+        out["ckpt_async"] = all(e.get("async") for e in ckpt_writes)
     if injected:
         out["faults_injected"] = len(injected)
     if retries:

@@ -1,4 +1,5 @@
-"""Parallel execution: device meshes, fold sharding, data-parallel steps."""
+"""Parallel execution: device meshes, sharding-spec trees, fold sharding,
+data-parallel steps."""
 
 from eegnetreplication_tpu.parallel.dp import (  # noqa: F401
     make_dp_eval_step,
@@ -7,8 +8,16 @@ from eegnetreplication_tpu.parallel.dp import (  # noqa: F401
 from eegnetreplication_tpu.parallel.mesh import (  # noqa: F401
     DATA_AXIS,
     FOLD_AXIS,
+    MODEL_AXIS,
     initialize_distributed,
     make_hybrid_mesh,
     make_mesh,
     mesh_size,
+)
+from eegnetreplication_tpu.parallel.shardspec import (  # noqa: F401
+    StateShardSpec,
+    fold_stacked_spec_tree,
+    place_fold_stacked,
+    shard_state,
+    state_shard_spec,
 )

@@ -6,6 +6,16 @@ computes gradients on its batch shard, gradients are globally reduced with
 ``psum`` over the mesh's data axis (riding ICI), and BatchNorm statistics are
 synchronized across shards (``BatchNorm(axis_name="data")``), making the step
 numerically equivalent to the same global batch on one device.
+
+Placement is no longer hand-rolled: the step's in/out specs come from a
+:class:`~eegnetreplication_tpu.parallel.shardspec.StateShardSpec` tree.
+Without one (or with a singleton model axis) the state is replicated — the
+original behaviour, bit for bit.  With a spec over a model axis > 1 the
+optimizer moments live partitioned across that axis (ZeRO-style): each
+model rank updates only its slice of the moments and its slice of the
+parameters, and one ``all_gather`` of the parameter update per step
+rebuilds the replicated params.  The math is elementwise, so the sharded
+step is bit-identical to the replicated one.
 """
 
 from __future__ import annotations
@@ -25,8 +35,34 @@ from eegnetreplication_tpu.training.steps import (
 )
 
 
+def _model_dim(spec: P, model_axis: str) -> int | None:
+    """The dimension ``spec`` shards over the model axis, or ``None``."""
+    for dim, ax in enumerate(spec):
+        if ax == model_axis:
+            return dim
+    return None
+
+
+def _slice_to_model_shard(full, spec: P, model_axis: str, n_model: int):
+    """This model rank's block of ``full`` along the spec's model dim."""
+    dim = _model_dim(spec, model_axis)
+    if dim is None:
+        return full
+    chunk = full.shape[dim] // n_model
+    start = jax.lax.axis_index(model_axis) * chunk
+    return jax.lax.dynamic_slice_in_dim(full, start, chunk, axis=dim)
+
+
+def _gather_model_shards(local, spec: P, model_axis: str):
+    """Rebuild the full array from per-rank blocks along the spec's dim."""
+    dim = _model_dim(spec, model_axis)
+    if dim is None:
+        return local
+    return jax.lax.all_gather(local, model_axis, axis=dim, tiled=True)
+
+
 def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
-                       data_axis: str = DATA_AXIS):
+                       data_axis: str = DATA_AXIS, spec=None):
     """Build a jitted data-parallel train step over ``mesh``'s data axis.
 
     The model must be constructed with ``bn_axis_name=data_axis`` so batch
@@ -35,13 +71,25 @@ def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
 
     Returns ``step(state, x, y, w, rng) -> (state, loss)`` where ``x``/``y``/
     ``w`` carry a leading global batch dimension sharded over ``data_axis``
-    and ``state`` is replicated.
+    and ``state`` is placed per ``spec`` (a
+    :func:`~eegnetreplication_tpu.parallel.shardspec.state_shard_spec`
+    tree; ``None`` replicates everything — the pre-spec behaviour).  With
+    a model axis > 1 in the spec, optimizer moments stay partitioned
+    across steps: pre-place the incoming state with
+    :func:`~eegnetreplication_tpu.parallel.shardspec.shard_state` so the
+    first dispatch does not pay a resharding copy.
     """
     if model.bn_axis_name != data_axis:
         raise ValueError(
             f"model.bn_axis_name={model.bn_axis_name!r} must equal the mesh "
             f"data axis {data_axis!r} for synced BatchNorm under DP"
         )
+    n_model = spec.n_model if spec is not None else 1
+    model_axis = spec.model_axis if spec is not None else None
+    if n_model > 1 and int(mesh.shape.get(model_axis, 1)) != n_model:
+        raise ValueError(
+            f"spec was built for a {n_model}-wide {model_axis!r} axis but "
+            f"the mesh carries {dict(mesh.shape)}")
 
     def sharded_step(state: TrainState, x, y, w, rng):
         # Decorrelate dropout across shards; params/updates stay replicated.
@@ -71,7 +119,27 @@ def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
         limits = getattr(model, "MAXNORM_LIMITS", {})
         if maxnorm_mode == "reference":
             grads = clamp_reference_maxnorm(grads, limits)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        if n_model > 1:
+            # ZeRO-style update: each model rank owns a slice of the Adam
+            # moments (delivered sliced by the in_specs below), so it
+            # updates only its slice of grads/params — elementwise math,
+            # identical results — and one tiled all_gather rebuilds the
+            # full update.  Moments are returned sliced (out_specs keep
+            # them partitioned across steps).
+            grads = jax.tree_util.tree_map(
+                lambda g, s: _slice_to_model_shard(g, s, model_axis, n_model),
+                grads, spec.update)
+            params_slice = jax.tree_util.tree_map(
+                lambda p, s: _slice_to_model_shard(p, s, model_axis, n_model),
+                state.params, spec.update)
+            updates, new_opt_state = tx.update(grads, state.opt_state,
+                                               params_slice)
+            updates = jax.tree_util.tree_map(
+                lambda u, s: _gather_model_shards(u, s, model_axis),
+                updates, spec.update)
+        else:
+            updates, new_opt_state = tx.update(grads, state.opt_state,
+                                               state.params)
         new_params = optax.apply_updates(state.params, updates)
         if maxnorm_mode == "paper":
             new_params = project_paper_maxnorm(new_params, limits)
@@ -81,11 +149,14 @@ def make_dp_train_step(model, tx, mesh, *, maxnorm_mode: str = "reference",
 
     replicated = P()
     batch_sharded = P(data_axis)
+    # A bare P() is a valid pytree-prefix spec for the whole TrainState;
+    # a StateShardSpec supplies the full per-leaf tree instead.
+    state_specs = spec.state if spec is not None else replicated
     mapped = shard_map(
         sharded_step, mesh=mesh,
-        in_specs=(replicated, batch_sharded, batch_sharded, batch_sharded,
+        in_specs=(state_specs, batch_sharded, batch_sharded, batch_sharded,
                   replicated),
-        out_specs=(replicated, replicated),
+        out_specs=(state_specs, replicated),
         check=False,
     )
     return jax.jit(mapped)

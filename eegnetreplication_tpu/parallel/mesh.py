@@ -11,8 +11,15 @@ Axis convention:
   ensemble members).  No collectives cross this axis.
 - ``"data"`` — batch shards within one run.  Gradients/BN stats are reduced
   over this axis every step, so it should map to the fastest links (ICI);
-  ``make_mesh`` orders it as the *minor* (last) mesh dimension, which
-  ``mesh_utils.create_device_mesh`` assigns to nearest-neighbour devices.
+  ``make_mesh`` orders it before the model axis, and
+  ``mesh_utils.create_device_mesh`` assigns minor dimensions to
+  nearest-neighbour devices.
+- ``"model"`` — state shards within one run: optimizer moments (and any
+  other per-parameter state a sharding-spec tree places there, see
+  ``parallel/shardspec.py``) are partitioned over this axis instead of
+  replicated, ZeRO-style.  Collectives over this axis are one
+  ``all_gather`` of the parameter update per step, so it is the *minor*
+  (last, fastest-links) mesh dimension.
 
 For multi-host slices, ``make_hybrid_mesh`` places a leading DCN axis over
 hosts (fold-parallelism across hosts — zero cross-host traffic during
@@ -31,51 +38,57 @@ from jax.sharding import Mesh
 
 FOLD_AXIS = "fold"
 DATA_AXIS = "data"
+MODEL_AXIS = "model"
 
 
 def make_mesh(n_fold: int | None = None, n_data: int = 1,
-              devices=None) -> Mesh:
-    """Build a (fold, data) mesh over the available devices.
+              devices=None, n_model: int = 1) -> Mesh:
+    """Build a named (fold, data, model) mesh over the available devices.
 
     With defaults, all devices go to the fold axis (run-parallelism, the
-    dominant regime for this workload's 36/90 independent folds).
+    dominant regime for this workload's 36/90 independent folds) and the
+    data/model axes are singleton — every sharding spec over them is then
+    the identity, so existing fold-only callers are unchanged.
     """
     devices = list(devices if devices is not None else jax.devices())
     n_dev = len(devices)
     if n_fold is None:
-        n_fold = n_dev // n_data
-    if n_fold * n_data != n_dev:
+        n_fold = n_dev // (n_data * n_model)
+    if n_fold * n_data * n_model != n_dev:
         raise ValueError(
-            f"mesh shape ({n_fold} fold x {n_data} data) != {n_dev} devices"
+            f"mesh shape ({n_fold} fold x {n_data} data x {n_model} model) "
+            f"!= {n_dev} devices"
         )
-    arr = mesh_utils.create_device_mesh((n_fold, n_data),
+    arr = mesh_utils.create_device_mesh((n_fold, n_data, n_model),
                                         devices=np.asarray(devices))
-    return Mesh(arr, (FOLD_AXIS, DATA_AXIS))
+    return Mesh(arr, (FOLD_AXIS, DATA_AXIS, MODEL_AXIS))
 
 
-def make_hybrid_mesh(n_data_per_host: int = 1) -> Mesh:
-    """Multi-host mesh: fold axis spans DCN (across hosts), data axis stays
-    on ICI within each host's devices."""
+def make_hybrid_mesh(n_data_per_host: int = 1,
+                     n_model_per_host: int = 1) -> Mesh:
+    """Multi-host mesh: fold axis spans DCN (across hosts), data/model axes
+    stay on ICI within each host's devices."""
     n_proc = jax.process_count()
     local = jax.local_device_count()
     if n_proc == 1:
-        return make_mesh(n_data=n_data_per_host)
-    if local % n_data_per_host:
+        return make_mesh(n_data=n_data_per_host, n_model=n_model_per_host)
+    if local % (n_data_per_host * n_model_per_host):
         raise ValueError(
-            f"mesh shape: data axis ({n_data_per_host}) must divide the "
-            f"{local} local devices per host")
-    n_fold_per_host = local // n_data_per_host
-    # DCN shape (n_proc, 1) demands exactly one granule per process, so
+            f"mesh shape: data x model axes ({n_data_per_host} x "
+            f"{n_model_per_host}) must divide the {local} local devices "
+            "per host")
+    n_fold_per_host = local // (n_data_per_host * n_model_per_host)
+    # DCN shape (n_proc, 1, 1) demands exactly one granule per process, so
     # granulate by process unconditionally — equivalent to slice
     # granulation when slices==processes, and the only valid choice
     # everywhere else (incl. multi-process CPU, where every device reports
     # slice 0).
     arr = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(n_fold_per_host, n_data_per_host),
-        dcn_mesh_shape=(n_proc, 1),
+        mesh_shape=(n_fold_per_host, n_data_per_host, n_model_per_host),
+        dcn_mesh_shape=(n_proc, 1, 1),
         process_is_granule=True,
     )
-    return Mesh(arr, (FOLD_AXIS, DATA_AXIS))
+    return Mesh(arr, (FOLD_AXIS, DATA_AXIS, MODEL_AXIS))
 
 
 def initialize_distributed(coordinator_address: str | None = None,

@@ -1,0 +1,199 @@
+"""Per-parameter sharding-spec trees over the named (fold, data, model) mesh.
+
+Before this module, placement was hand-rolled at every call site: the DP
+step hard-coded ``P()``/``P("data")`` pairs, the fold-sharded protocol
+trainers rebuilt ``P("fold")`` tuples inline, and optimizer state was
+always replicated — every device carried a full copy of both Adam moments
+even on meshes with spare axes.  Here one module owns the mapping from
+*tree leaf* to *named sharding*:
+
+- :func:`state_shard_spec` maps every leaf of a ``TrainState`` (params,
+  batch_stats, optimizer moments) to a ``PartitionSpec`` over the mesh's
+  ``model`` axis — params/BN stats replicated (every data shard consumes
+  them whole each step), each optimizer-moment leaf partitioned along its
+  largest ``model``-divisible dimension (ZeRO-style; the per-step cost is
+  one ``all_gather`` of the parameter update).  ``make_dp_train_step``
+  consumes this spec tree instead of hand-placed specs.
+- :func:`fold_stacked_spec_tree` maps every leaf of a fold-stacked tree
+  (states, specs, epoch keys, the chunked-scan carry) to
+  ``P("fold", ...)`` — fold-major leaves live on the fold axis with zero
+  cross-fold collectives, which is what makes the protocol path's
+  run-parallelism communication-free.
+- :func:`place` / :func:`place_fold_stacked` / :func:`replicate` commit a
+  tree to devices with ``jax.device_put`` + ``NamedSharding`` so dispatch
+  never pays a per-call resharding of inputs that were already placed.
+
+The pattern follows SNIPPETS.md [1] (``shard_params``/``get_sharding_tree``)
+generalized from a 1-D batch mesh to the framework's named 3-axis mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from eegnetreplication_tpu.parallel.mesh import DATA_AXIS, FOLD_AXIS, MODEL_AXIS
+
+
+def model_axis_size(mesh: Mesh | None,
+                    model_axis: str = MODEL_AXIS) -> int:
+    """The mesh's model-axis width (1 for no mesh / no such axis)."""
+    if mesh is None:
+        return 1
+    return int(mesh.shape.get(model_axis, 1))
+
+
+def model_leaf_spec(leaf: Any, n_model: int, *,
+                    model_axis: str = MODEL_AXIS,
+                    leading_fold: bool = False) -> P:
+    """PartitionSpec sharding ``leaf`` over the model axis when possible.
+
+    Picks the LARGEST dimension divisible by ``n_model`` (ties go to the
+    later dimension — for conv kernels that is the output-channel dim,
+    whose slices are contiguous filters); leaves with no divisible
+    dimension, scalars, and everything under a singleton model axis stay
+    replicated.  ``leading_fold`` reserves dim 0 for the fold axis
+    (fold-stacked trees) and shards over the remaining dims.
+    """
+    shape = getattr(leaf, "shape", ())
+    if leading_fold and not shape:
+        # A scalar has no fold dimension to pin; replicate rather than
+        # emit an over-ranked P(fold) (fold-stacked trees are fold-major
+        # by contract, but a stray scalar must not crash placement).
+        return P()
+    start = 1 if leading_fold else 0
+    axes: list[str | None] = [FOLD_AXIS] if leading_fold else []
+    best_dim, best_size = None, 0
+    if n_model > 1:
+        for dim in range(start, len(shape)):
+            if shape[dim] % n_model == 0 and shape[dim] >= best_size:
+                best_dim, best_size = dim, shape[dim]
+    axes += [None] * (len(shape) - start)
+    if best_dim is not None:
+        axes[best_dim] = model_axis
+    # Trailing Nones are redundant in a PartitionSpec; trim for readability.
+    while axes and axes[-1] is None:
+        axes.pop()
+    return P(*axes)
+
+
+@dataclass(frozen=True)
+class StateShardSpec:
+    """Sharding-spec trees for one ``TrainState`` under a named mesh.
+
+    ``state`` mirrors the TrainState structure with one ``PartitionSpec``
+    per leaf (params/batch_stats replicated, optimizer moments on the
+    model axis); ``update`` mirrors the *params* structure and names the
+    dimension each parameter's gradient/update is sliced and re-gathered
+    along inside the sharded step — by construction identical to the spec
+    its Adam moments carry (both derive from :func:`model_leaf_spec` on
+    the same shape), so moment shards and update shards always align.
+    """
+
+    state: Any
+    update: Any
+    n_model: int
+    model_axis: str = MODEL_AXIS
+
+    @property
+    def sharded(self) -> bool:
+        return self.n_model > 1
+
+
+def state_shard_spec(state: Any, mesh: Mesh | None, *,
+                     model_axis: str = MODEL_AXIS) -> StateShardSpec:
+    """Build the per-leaf spec tree for an (unstacked) ``TrainState``.
+
+    Params and BatchNorm statistics are replicated — the forward/backward
+    pass consumes every element each step, so sharding them would buy an
+    all_gather per *use* instead of one per *update*.  Optimizer moments
+    are touched exactly once per step, elementwise, which is why they are
+    the profitable leaves to partition (the ZeRO observation).
+    """
+    n_model = model_axis_size(mesh, model_axis)
+
+    def moment_spec(leaf):
+        return model_leaf_spec(leaf, n_model, model_axis=model_axis)
+
+    state_tree = type(state)(
+        params=jax.tree_util.tree_map(lambda _: P(), state.params),
+        batch_stats=jax.tree_util.tree_map(lambda _: P(), state.batch_stats),
+        opt_state=jax.tree_util.tree_map(moment_spec, state.opt_state),
+    )
+    update_tree = jax.tree_util.tree_map(moment_spec, state.params)
+    return StateShardSpec(state=state_tree, update=update_tree,
+                          n_model=n_model, model_axis=model_axis)
+
+
+def fold_stacked_spec_tree(tree: Any, *, fold_axis: str = FOLD_AXIS,
+                           n_model: int = 1,
+                           model_axis: str = MODEL_AXIS) -> Any:
+    """Spec tree for a fold-stacked tree: every leaf's leading dimension on
+    the fold axis (zero cross-fold collectives), remaining dims optionally
+    over the model axis."""
+    return jax.tree_util.tree_map(
+        lambda leaf: model_leaf_spec(leaf, n_model, model_axis=model_axis,
+                                     leading_fold=True), tree)
+
+
+def fold_mapped_specs(mapped: tuple[bool, ...],
+                      fold_axis: str = FOLD_AXIS) -> tuple[P, ...]:
+    """Positional in_specs for a fold-sharded runner: ``P(fold)`` for each
+    argument carrying the leading fold dimension, replicated otherwise.
+    Single home for the contract ``loop.shard_over_fold_axis`` applies."""
+    return tuple(P(fold_axis) if m else P() for m in mapped)
+
+
+def sharding_tree(spec_tree: Any, mesh: Mesh) -> Any:
+    """Lift a tree of ``PartitionSpec`` into a tree of ``NamedSharding``."""
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def place(tree: Any, mesh: Mesh, spec_tree: Any) -> Any:
+    """Commit ``tree`` to devices per ``spec_tree`` (tree of PartitionSpec).
+
+    Explicit placement before dispatch: a jitted/shard_mapped program whose
+    inputs already carry the program's shardings skips the implicit
+    per-call resharding copy.
+    """
+    return jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+        tree, spec_tree)
+
+
+def place_fold_stacked(tree: Any, mesh: Mesh,
+                       fold_axis: str = FOLD_AXIS) -> Any:
+    """Place every leaf of a fold-stacked tree with its leading dim sharded
+    over the mesh's fold axis (leaves must be pre-padded to a multiple of
+    the axis size — the protocol path pads before placing)."""
+    return place(tree, mesh, fold_stacked_spec_tree(tree,
+                                                    fold_axis=fold_axis))
+
+
+def replicate(tree: Any, mesh: Mesh) -> Any:
+    """Place every leaf fully replicated over ``mesh`` (the shared data
+    pool: one committed copy per device, no per-dispatch broadcast)."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.device_put(leaf, NamedSharding(mesh, P())), tree)
+
+
+def shard_state(state: Any, mesh: Mesh,
+                spec: StateShardSpec | None = None) -> Any:
+    """Place a ``TrainState`` per its spec tree: params/BN replicated,
+    optimizer moments partitioned over the model axis — the state is then
+    physically sharded (1/n_model of the moment bytes per model rank)
+    before the first step runs."""
+    if spec is None:
+        spec = state_shard_spec(state, mesh)
+    return place(state, mesh, spec.state)
+
+
+def batch_spec(data_axis: str = DATA_AXIS) -> P:
+    """The batch-sharding spec consumed by the DP step's inputs."""
+    return P(data_axis)

@@ -26,6 +26,12 @@ site                  action     effect
                                  compiled-program dispatch
 ``checkpoint.write``  corrupt    truncate+garble the staged snapshot bytes
                                  (the crash-mid-``tmp.replace`` shape)
+``checkpoint.write_async``  corrupt  same staged-byte garbling, but fired
+                                 INSIDE the background snapshot writer
+                                 (``training/async_ckpt.py``) — the
+                                 SIGKILL-mid-async-write shape; resume
+                                 must quarantine the torn generation and
+                                 fall back to the previous one
 ``host.preempt``      preempt    request a graceful stop (same path as
                                  SIGTERM), honored at the next snapshot
                                  boundary
@@ -106,9 +112,10 @@ from eegnetreplication_tpu.utils.logging import logger
 # rejects names outside this set so a chaos-plan typo fails loudly
 # instead of silently never firing.
 SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
-         "host.preempt", "train.chunk", "serve.forward", "train.hang",
-         "serve.hang", "session.snapshot", "session.restore",
-         "serve.degrade", "replica.network", "cell.partition")
+         "checkpoint.write_async", "host.preempt", "train.chunk",
+         "serve.forward", "train.hang", "serve.hang", "session.snapshot",
+         "session.restore", "serve.degrade", "replica.network",
+         "cell.partition")
 
 ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate",
            "refuse")
@@ -151,6 +158,9 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                    "train.step, hit {hit})"),
     "checkpoint.write": ("corrupt", "OSError",
                          "injected fault: checkpoint.write (hit {hit})"),
+    "checkpoint.write_async": ("corrupt", "OSError",
+                               "injected fault: checkpoint.write_async "
+                               "(hit {hit})"),
     "host.preempt": ("preempt", None, "injected host.preempt (hit {hit})"),
     "train.chunk": ("raise", "RuntimeError",
                     "injected crash after chunk {hit}"),

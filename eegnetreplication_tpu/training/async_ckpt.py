@@ -1,0 +1,217 @@
+"""Overlapped run-snapshot persistence for the chunked training loop.
+
+Before this module, every chunk boundary in ``training/protocols.py``
+blocked the step loop on a synchronous ``save_run_snapshot`` — serialize,
+compress, write, rename — while the accelerator sat idle.  At CS scale the
+gap between protocol-only and end-to-end throughput (83.55 vs 45.59
+fold-epochs/s, BENCH_CS_SCALE.json) was mostly these blocking writes.
+
+:class:`SnapshotWriter` moves the write off the critical path: ``submit``
+hands the (immutable) scan carry to a background thread and returns
+immediately; the device→host fetch, sha256 stamp, atomic tmp+rename and
+keep-N generation rotation (all via
+:func:`~eegnetreplication_tpu.training.checkpoint.save_run_snapshot`, so
+the durability contracts are shared, not reimplemented) overlap the next
+chunk's compiled scan.  At most one write is in flight: a ``submit`` that
+arrives while the previous write is still running waits for it first —
+snapshots land in order and a slow disk degrades to the old synchronous
+behaviour instead of queueing unboundedly.
+
+Failure semantics:
+
+- A failed background write surfaces as :class:`SnapshotWriteError` at the
+  next ``submit``/``close`` — a run must not silently lose its resume seed.
+- ``close`` is called on every exit path of the chunk loop (success,
+  device fault, injected crash, :class:`~eegnetreplication_tpu.resil.preempt.Preempted`),
+  so the in-flight snapshot is durable before the exception propagates —
+  what makes crash/preempt resume see the newest chunk.
+- A :func:`~eegnetreplication_tpu.resil.preempt.add_drain_hook` is
+  registered while a writer is open: a SIGTERM that unwinds past the
+  protocol still commits the pending write before ``run_end``.
+
+Every write is journaled as a ``checkpoint_write`` event (``dur_ms``,
+``async``, ``blocked_ms``, ``overlapped_ms``, ``generation``) from the
+submitting thread, so the overlap is provable post-hoc from the journal
+alone; the ``checkpoint.write_async`` injection site fires inside the
+background thread (the SIGKILL-mid-async-write drill).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import preempt
+from eegnetreplication_tpu.training import checkpoint as ckpt_lib
+from eegnetreplication_tpu.utils.logging import logger
+
+
+class SnapshotWriteError(RuntimeError):
+    """A background snapshot write failed; the resume seed did not land."""
+
+
+class SnapshotWriter:
+    """Ordered, at-most-one-in-flight run-snapshot writer.
+
+    ``async_=False`` degrades to the synchronous write (same journaling,
+    ``blocked_ms == dur_ms``) so the two modes are comparable from the
+    journal — the A/B the ``cs_at_scale.py --selftest`` arms measure.
+    """
+
+    def __init__(self, path: str | Path, signature: dict, *,
+                 async_: bool = True, keep: int | None = None,
+                 journal=None):
+        self.path = Path(path)
+        self.signature = signature
+        self.async_ = async_
+        self.keep = keep
+        self._jr = journal if journal is not None else obs_journal.current()
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._record: dict | None = None  # the in-flight write's record
+        self._seq = 0
+        self._closed = False
+        if async_:
+            preempt.add_drain_hook(self._drain)
+
+    # -- internal ---------------------------------------------------------
+    def _join_pending(self) -> float:
+        """Wait out the in-flight write; returns seconds actually blocked
+        (exactly 0.0 when the write already finished — the journal's
+        "zero blocking-write stalls" evidence is this exactness)."""
+        blocked = 0.0
+        if self._thread is not None:
+            if self._thread.is_alive():
+                t0 = time.perf_counter()
+                self._thread.join()
+                blocked = time.perf_counter() - t0
+            else:
+                self._thread.join()
+            self._thread = None
+        return blocked
+
+    def _journal_record(self, blocked_s: float, *,
+                        drain: bool = False) -> None:
+        rec, self._record = self._record, None
+        if rec is None:
+            return
+        dur_ms = round(rec["dur_s"] * 1000.0, 3)
+        blocked_ms = round(blocked_s * 1000.0, 3)
+        overlapped_ms = round(max(0.0, dur_ms - blocked_ms), 3)
+        # drain=True marks the close()-time join of the FINAL write: there
+        # is no next chunk left to overlap it with, so its wait is the
+        # run's shutdown tail, not a step-loop stall — consumers measuring
+        # blocking-write stalls must filter it out.  ok=False marks a
+        # write whose snapshot did NOT land (the error also surfaces at
+        # the next submit/close) — "provable from the journal" requires a
+        # failed write to be distinguishable from a durable one.
+        ok = rec.get("error") is None
+        extra = {"async": self.async_}
+        if not ok:
+            extra["error"] = rec["error"]
+        self._jr.event("checkpoint_write", dur_ms=dur_ms,
+                       overlapped_ms=overlapped_ms, blocked_ms=blocked_ms,
+                       generation=rec["seq"], epochs_done=rec["epochs_done"],
+                       path=str(self.path), drain=drain, ok=ok, **extra)
+        if ok:
+            self._jr.metrics.observe("ckpt_write_s", rec["dur_s"])
+            if not drain:
+                self._jr.metrics.observe("ckpt_block_s", blocked_s)
+
+    def _raise_pending_error(self) -> None:
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise SnapshotWriteError(
+                f"background snapshot write to {self.path} failed: "
+                f"{type(error).__name__}: {error}") from error
+
+    def _write(self, carry: Any, metrics: dict, epochs_done: int,
+               rec: dict) -> None:
+        t0 = time.perf_counter()
+        try:
+            # Device→host fetch happens HERE, overlapping the next chunk's
+            # scan (jax arrays are immutable, so sharing with the training
+            # thread is safe); the staged write + rotation + rename reuse
+            # the synchronous path's contracts verbatim.
+            host_carry = jax.tree_util.tree_map(np.asarray, carry)
+            # Metric histories may arrive as lists of per-chunk arrays:
+            # the O(epochs-so-far) concatenation happens HERE so the step
+            # loop never pays it (the submitter hands over shallow copies,
+            # so its own lists can keep growing concurrently).
+            metrics = {k: (np.concatenate(v, axis=1)
+                           if isinstance(v, (list, tuple)) else v)
+                       for k, v in metrics.items()}
+            ckpt_lib.save_run_snapshot(
+                self.path, host_carry, metrics, epochs_done=epochs_done,
+                signature=self.signature, keep=self.keep,
+                _async_site=self.async_)
+        except BaseException as exc:  # noqa: BLE001 — surfaced on submit/close
+            self._error = exc
+            rec["error"] = f"{type(exc).__name__}: {exc}"
+        finally:
+            rec["dur_s"] = time.perf_counter() - t0
+
+    # -- public -----------------------------------------------------------
+    def submit(self, carry: Any, metrics: dict, epochs_done: int) -> None:
+        """Persist one chunk-boundary snapshot (returns immediately in
+        async mode; blocks only while a previous write is still running).
+
+        ``metrics`` values may be arrays OR lists of per-chunk arrays —
+        lists are concatenated along axis 1 on the writer thread, keeping
+        that growing join off the step loop; pass a shallow copy of any
+        list the caller keeps appending to."""
+        if self._closed:
+            raise SnapshotWriteError(f"writer for {self.path} is closed")
+        blocked = self._join_pending()
+        self._journal_record(blocked)
+        self._raise_pending_error()
+        self._seq += 1
+        rec = {"seq": self._seq, "epochs_done": epochs_done, "dur_s": 0.0}
+        self._record = rec
+        if not self.async_:
+            self._write(carry, metrics, epochs_done, rec)
+            self._journal_record(rec["dur_s"])  # sync: fully blocking
+            self._raise_pending_error()
+            return
+        # Propagate the submitting thread's context (active journal,
+        # armed-injection visibility through logging) into the worker.
+        ctx = contextvars.copy_context()
+        self._thread = threading.Thread(
+            target=ctx.run, args=(self._write, carry, metrics, epochs_done,
+                                  rec),
+            name="eegtpu-snapshot-writer", daemon=True)
+        self._thread.start()
+
+    def _drain(self) -> None:
+        """Preemption drain hook: commit the pending write, never raise."""
+        try:
+            self.close(raise_errors=False)
+        except Exception as exc:  # noqa: BLE001 — drain must complete
+            logger.warning("Snapshot writer drain failed: %s", exc)
+
+    def close(self, *, raise_errors: bool = True) -> None:
+        """Wait for the in-flight write and release the writer.
+
+        ``raise_errors=False`` is for exception paths (an injected crash
+        must propagate as itself, not be masked by a write failure — the
+        failure is still logged).
+        """
+        blocked = self._join_pending()
+        self._journal_record(blocked, drain=self.async_)
+        if not self._closed:
+            self._closed = True
+            if self.async_:
+                preempt.remove_drain_hook(self._drain)
+        if self._error is not None and not raise_errors:
+            logger.warning(
+                "Background snapshot write to %s failed during shutdown: "
+                "%s", self.path, self._error)
+            self._error = None
+        self._raise_pending_error()

@@ -304,7 +304,8 @@ def load_checkpoint(path: str | Path) -> tuple[dict, dict, dict]:
 
 def save_run_snapshot(path: str | Path, carry: Any,
                       metrics: dict[str, np.ndarray], epochs_done: int,
-                      signature: dict, *, keep: int | None = None) -> Path:
+                      signature: dict, *, keep: int | None = None,
+                      _async_site: bool = False) -> Path:
     """Persist a mid-protocol training snapshot (all folds' carry + metrics).
 
     ``carry`` is the stacked epoch-scan carry from
@@ -336,6 +337,12 @@ def save_run_snapshot(path: str | Path, carry: Any,
         np.savez(fh, **flat)
     inject.fire("checkpoint.write", path=tmp, what="run_snapshot",
                 epochs_done=epochs_done)
+    if _async_site:
+        # The background writer's own phase: armed separately from the
+        # synchronous site so a drill can tear exactly the overlapped
+        # write (``training/async_ckpt.py`` sets this flag).
+        inject.fire("checkpoint.write_async", path=tmp, what="run_snapshot",
+                    epochs_done=epochs_done)
     rotate_generations(path, keep if keep is not None else snapshot_keep())
     tmp.replace(path)
     return path

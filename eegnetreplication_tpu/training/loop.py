@@ -365,17 +365,22 @@ def shard_over_fold_axis(fn, mesh, fold_axis: str, mapped: tuple[bool, ...]):
     """Wrap a vmapped runner in ``shard_map`` over the mesh's fold axis.
 
     ``mapped`` marks, per positional argument, whether it carries the leading
-    fold/run dimension (sharded) or is replicated.  Single home for the
-    fold-axis sharding contract (used by the protocol trainer and the
-    permutation test); callers pad the mapped axis to a multiple of
-    ``mesh.shape[fold_axis]``.
+    fold/run dimension (sharded) or is replicated.  The specs themselves
+    come from the sharding-spec-tree module
+    (``parallel/shardspec.py:fold_mapped_specs``) — the single home for
+    the fold-major placement contract, shared with the protocol path's
+    explicit ``place_fold_stacked`` device placement, so the program's
+    in_specs and its inputs' committed shardings can never drift apart.
+    Callers pad the mapped axis to a multiple of ``mesh.shape[fold_axis]``;
+    no collective crosses the fold axis.
     """
     from jax.sharding import PartitionSpec as P
 
+    from eegnetreplication_tpu.parallel import shardspec
     from eegnetreplication_tpu.utils.compat import shard_map
 
-    in_specs = tuple(P(fold_axis) if m else P() for m in mapped)
-    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+    return shard_map(fn, mesh=mesh,
+                     in_specs=shardspec.fold_mapped_specs(mapped, fold_axis),
                      out_specs=P(fold_axis), check=False)
 
 
@@ -458,14 +463,32 @@ def make_multi_fold_segment(model, tx, *, batch_size: int,
         vmapped, mesh, fold_axis, mapped=(False, False, True, True, True))))
 
 
-def make_multi_fold_evaluator(model, *, batch_size: int):
+def make_multi_fold_evaluator(model, *, batch_size: int, mesh=None,
+                              fold_axis: str = "fold"):
     """Vmapped, jitted test evaluation: ``(pool_x, pool_y, specs, states)`` ->
-    per-fold test accuracy (percentage)."""
+    per-fold test accuracy (percentage).
+
+    With ``mesh`` the evaluation shards over the fold axis under explicit
+    SPMD, exactly like the trainers.  This is a correctness requirement,
+    not an optimization: feeding the mesh-sharded best states of a chunked
+    run into the plain jitted evaluator lets GSPMD auto-partition the
+    vmapped pool gather, which MISCOMPUTES every fold shard but the first
+    on the multi-device CPU backend (measured 2026-08-04: CS test accs
+    38% vs the correct 95% — the fused single-program path, whose eval
+    runs inside ``shard_map``, was always right).  Explicit fold specs
+    from the sharding-spec module pin the same zero-collective layout the
+    training step uses.  Callers pad the fold axis to a multiple of
+    ``mesh.shape[fold_axis]``, as for the trainers.
+    """
     def eval_one(pool_x, pool_y, spec: FoldSpec, state: TrainState):
         return evaluate_pool(model, state, pool_x, pool_y, spec.test_idx,
                              spec.test_n, batch_size)
 
-    return jax.jit(jax.vmap(eval_one, in_axes=(None, None, 0, 0)))
+    vmapped = jax.vmap(eval_one, in_axes=(None, None, 0, 0))
+    if mesh is None:
+        return jax.jit(vmapped)
+    return jax.jit(shard_over_fold_axis(
+        vmapped, mesh, fold_axis, mapped=(False, False, True, True)))
 
 
 def init_fold_states(model, tx, n_folds: int, sample_shape, seed: int = 0):

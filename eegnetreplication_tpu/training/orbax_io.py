@@ -28,6 +28,7 @@ hyperparameters including T — quirk Q4 stays fixed in both formats).
 from __future__ import annotations
 
 import json
+import threading
 from pathlib import Path
 from typing import Any
 
@@ -38,8 +39,58 @@ from eegnetreplication_tpu.training.steps import TrainState
 
 _METADATA_FILE = "metadata.json"
 # (checkpointer, committed path, metadata) per in-flight background save;
-# the metadata twin is written only after the directory commit.
+# the metadata twin is written only after the directory commit.  Guarded
+# by _ASYNC_LOCK: background saves may be issued from worker threads (the
+# protocol path's async snapshot writer journals the precedent), and an
+# unguarded list append/pop pair loses entries under concurrency.
 _ASYNC_PENDING: list[tuple[Any, Path, dict]] = []
+_ASYNC_LOCK = threading.Lock()
+_ASYNC_COND = threading.Condition(_ASYNC_LOCK)
+# Slots claimed by saves still being ISSUED (AsyncCheckpointer.save blocks
+# until the full host copy of the state is staged, so it must run outside
+# the lock — a reservation keeps the bound airtight in the meantime).
+_ASYNC_RESERVED = 0
+# Hard bound on in-flight background saves: a caller outrunning the disk
+# drains the OLDEST entry before a new one is admitted, so pending work
+# (and the host memory its checkpointers pin) cannot grow without limit.
+MAX_ASYNC_PENDING = 4
+
+
+def _pending_count() -> int:
+    with _ASYNC_LOCK:
+        return len(_ASYNC_PENDING)
+
+
+def _commit_entry(ckptr: Any, path: Path, metadata: dict) -> None:
+    """Wait out one background save and write its metadata twin.
+
+    On failure, raises with the entry's REMAINING work attached as
+    ``exc.pending_entry`` so the caller re-pends exactly what is left: a
+    failed ``wait`` keeps its handle (the commit never happened — writing
+    the metadata twin anyway would forge the commit marker ``_restore``
+    trusts), while a failed metadata write after a successful wait retries
+    the metadata only (a closed checkpointer cannot be waited on again —
+    ADVICE r2).
+    """
+    if ckptr is not None:  # None: wait/close already done, only the
+        # metadata write is being retried
+        try:
+            ckptr.wait_until_finished()
+        except Exception as exc:
+            exc.pending_entry = (ckptr, path, metadata)
+            raise
+        # The commit is durable once the wait returns; close() only
+        # releases host resources.  Drop the handle whether or not
+        # close() raises.
+        try:
+            ckptr.close()
+        finally:
+            ckptr = None
+    try:
+        (path / _METADATA_FILE).write_text(json.dumps(metadata))
+    except Exception as exc:
+        exc.pending_entry = (None, path, metadata)
+        raise
 
 
 def wait_for_async_saves() -> None:
@@ -52,29 +103,31 @@ def wait_for_async_saves() -> None:
     every entry is attempted even when one fails (a failed save must not
     orphan an older, successfully committed checkpoint); failed entries
     stay pending for a retry and their errors are re-raised aggregated.
+
+    Also registered as a preemption drain hook while saves are pending
+    (``resil/preempt.py``): a SIGTERM that unwinds past the caller still
+    commits in-flight checkpoints before ``run_end``.
     """
     failures: list[tuple[tuple, Exception]] = []
-    while _ASYNC_PENDING:
-        ckptr, path, metadata = _ASYNC_PENDING.pop(0)  # oldest first
+    while True:
+        with _ASYNC_COND:
+            if not _ASYNC_PENDING:
+                if _ASYNC_RESERVED:
+                    # A save is mid-issue on another thread; its entry
+                    # lands (or its reservation is released) momentarily —
+                    # returning now would let the drain miss it.
+                    _ASYNC_COND.wait(timeout=0.1)
+                    continue
+                break
+            ckptr, path, metadata = _ASYNC_PENDING.pop(0)  # oldest first
         try:
-            if ckptr is not None:  # None: wait/close already done, only the
-                # metadata write is being retried (a closed checkpointer
-                # cannot be waited on again)
-                ckptr.wait_until_finished()
-                # The commit is durable once the wait returns; close() only
-                # releases host resources.  Drop the handle whether or not
-                # close() raises — re-waiting a half-closed checkpointer is
-                # undefined in Orbax, so a retry of this entry must skip
-                # straight to the metadata write (ADVICE r2).
-                try:
-                    ckptr.close()
-                finally:
-                    ckptr = None
-            (path / _METADATA_FILE).write_text(json.dumps(metadata))
+            _commit_entry(ckptr, path, metadata)
         except Exception as exc:  # noqa: BLE001 — aggregate, keep going
-            failures.append(((ckptr, path, metadata), exc))
+            failures.append((getattr(exc, "pending_entry",
+                                     (None, path, metadata)), exc))
     if failures:
-        _ASYNC_PENDING.extend(entry for entry, _ in failures)
+        with _ASYNC_LOCK:
+            _ASYNC_PENDING.extend(entry for entry, _ in failures)
         raise RuntimeError(
             "async checkpoint save(s) failed (still pending for retry): "
             + "; ".join(f"{e[1]}: {type(exc).__name__}: {exc}"
@@ -112,9 +165,55 @@ def save_orbax_checkpoint(path: str | Path, params: Any, batch_stats: Any,
     path.parent.mkdir(parents=True, exist_ok=True)
     state = _state_dict(params, batch_stats, opt_state, step)
     if background:
-        ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
-        ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
-        _ASYNC_PENDING.append((ckptr, path, dict(metadata or {})))
+        global _ASYNC_RESERVED
+        # Bound the in-flight set: drain the oldest entries until there
+        # is room, so a caller outrunning the disk backpressures instead
+        # of accumulating checkpointers.  The capacity check RESERVES a
+        # slot under the lock (counting saves still being issued, so N
+        # concurrent savers cannot all observe a free slot and overshoot
+        # the bound), but the save itself is issued OUTSIDE the lock:
+        # AsyncCheckpointer.save blocks until the full device→host copy
+        # of the state is staged, and holding the lock for that long
+        # would stall the SIGTERM drain hook (and sibling savers) on a
+        # large state exactly when the preemption grace window is ticking.
+        while True:
+            with _ASYNC_COND:
+                if len(_ASYNC_PENDING) + _ASYNC_RESERVED < MAX_ASYNC_PENDING:
+                    _ASYNC_RESERVED += 1
+                    break
+                if not _ASYNC_PENDING:
+                    # Every slot is a save mid-issue on another thread;
+                    # wait for one to land rather than spinning.
+                    _ASYNC_COND.wait(timeout=0.1)
+                    continue
+                old_ckptr, old_path, old_meta = _ASYNC_PENDING.pop(0)
+            try:
+                _commit_entry(old_ckptr, old_path, old_meta)
+            except Exception as exc:  # noqa: BLE001 — re-pend + surface
+                with _ASYNC_COND:
+                    _ASYNC_PENDING.insert(0, getattr(
+                        exc, "pending_entry", (None, old_path, old_meta)))
+                    _ASYNC_COND.notify_all()
+                raise
+        try:
+            ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+            ckptr.save(path, args=ocp.args.StandardSave(state), force=True)
+        except BaseException:
+            with _ASYNC_COND:
+                _ASYNC_RESERVED -= 1
+                _ASYNC_COND.notify_all()
+            raise
+        with _ASYNC_COND:
+            _ASYNC_RESERVED -= 1
+            _ASYNC_PENDING.append((ckptr, path, dict(metadata or {})))
+            _ASYNC_COND.notify_all()
+        # Graceful-stop drain: a SIGTERM honored at a safe point commits
+        # (or cleanly surfaces) pending async saves before run_end.
+        # add_drain_hook dedupes, so re-registering per save is free, and
+        # preempt.clear() (test teardown) unregisters it wholesale.
+        from eegnetreplication_tpu.resil import preempt
+
+        preempt.add_drain_hook(wait_for_async_saves)
         return path
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(path, state, force=True)

@@ -252,6 +252,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                checkpoint_path=None, resume: bool = False,
                signature: dict | None = None,
                fold_batch: int | None = None,
+               checkpoint_async: bool = True,
                _states=None, _keys=None, _keep_snapshot: bool = False):
     """Train all folds fused; returns ``(results, wall, fold_epochs,
     fault_retry_wall_s)`` with ``results`` a stacked FoldResult.
@@ -264,6 +265,12 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     §5); ``None`` (default): auto — runs over :data:`AUTO_CHUNK_THRESHOLD`
     epochs chunk at :func:`_auto_chunk_size` (long fused scans hit an XLA
     compile cliff, BENCH_NOTES.md), shorter runs stay single-program.
+
+    ``checkpoint_async`` (default) hands chunk-boundary snapshots to the
+    background :class:`~eegnetreplication_tpu.training.async_ckpt.SnapshotWriter`
+    so serialization/rotation overlaps the next chunk's compiled scan;
+    ``False`` restores the blocking write (the synchronous A/B arm).
+    Either way every write is journaled as a ``checkpoint_write`` event.
 
     ``fold_batch`` — at most this many folds per compiled program: groups
     run sequentially through the same chunked machinery and results are
@@ -413,6 +420,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
                     epochs=epochs, seed=seed, mesh=None,
                     checkpoint_every=checkpoint_every, checkpoint_path=gpath,
                     resume=gresume, signature=gsig,
+                    checkpoint_async=checkpoint_async,
                     _states=jax.tree_util.tree_map(
                         lambda l: l[lo:hi], states),
                     _keys=keys[lo:hi], _keep_snapshot=True)
@@ -486,6 +494,7 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     if mesh is not None:
         # Pad the fold axis to a multiple of the mesh's fold-axis size so the
         # shard is even; surplus folds repeat fold 0 and are dropped after.
+        from eegnetreplication_tpu.parallel import shardspec
         from eegnetreplication_tpu.parallel.mesh import FOLD_AXIS
 
         n_dev = mesh.shape[FOLD_AXIS]
@@ -498,8 +507,16 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
             stacked = jax.tree_util.tree_map(pad_leaf, stacked)
             states = jax.tree_util.tree_map(pad_leaf, states)
             keys = pad_leaf(keys)
-
-    pool_x, pool_y = jnp.asarray(pool_x), jnp.asarray(pool_y)
+        # Commit every fold-major tree to its home shard (leading dim on
+        # the fold axis — the spec tree places it, zero cross-fold
+        # collectives) and the shared pool replicated, so no dispatch of
+        # the chunk loop pays a per-call resharding copy.
+        stacked, states, keys = shardspec.place_fold_stacked(
+            (stacked, states, keys), mesh)
+        pool_x, pool_y = shardspec.replicate(
+            (jnp.asarray(pool_x), jnp.asarray(pool_y)), mesh)
+    else:
+        pool_x, pool_y = jnp.asarray(pool_x), jnp.asarray(pool_y)
 
     if checkpoint_every is not None and checkpoint_every < 0:
         raise ValueError(f"checkpoint_every must be >= 0, got {checkpoint_every}")
@@ -664,61 +681,105 @@ def _run_folds(model, specs: list[FoldSpec], pool_x, pool_y, *,
     # resolve memo so a declined snapshot's arrays are not pinned in the
     # checkpoint module for the rest of the run.
     ckpt_lib.clear_resolve_memo()
+    if mesh is not None:
+        # A resumed carry arrives as host numpy; (re)commit it to its
+        # fold-axis home so the first dispatch does not reshard it.
+        from eegnetreplication_tpu.parallel import shardspec
+
+        carry = shardspec.place_fold_stacked(carry, mesh)
+    writer = None
+    if checkpoint_path is not None:
+        from eegnetreplication_tpu.training.async_ckpt import SnapshotWriter
+
+        writer = SnapshotWriter(checkpoint_path, signature,
+                                async_=checkpoint_async, journal=jr)
     timer = StepTimer()
     chunk_no = 0
-    for lo in range(start_epoch, epochs, checkpoint_every):
-        hi = min(lo + checkpoint_every, epochs)
-        if chunk_no == 0:
-            # First segment call compiles (or hits the persistent cache);
-            # later chunks reuse the executable, so chunk-0 wall minus a
-            # later chunk's wall bounds the compile cost.
-            jr.event("compile_begin", what="epoch_segment")
-        with timer:
-            carry, per_epoch = segment(pool_x, pool_y, stacked, carry,
-                                       epoch_keys[:, lo:hi])
-            carry = jax.block_until_ready(carry)
-        if chunk_no == 0:
-            jr.event("compile_end", what="epoch_segment",
-                     elapsed_s=round(timer.times[-1], 3),
-                     includes_execution=True)
-            jr.sample_device_memory()
-        jr.metrics.observe("chunk_wall_s", timer.times[-1])
-        for name, arr in zip(
-                ("train_losses", "val_losses", "val_accuracies",
-                 "grad_norms"), per_epoch):
-            metrics[name].append(np.asarray(arr))
-        _log_epoch_cadence(per_epoch, lo, hi, epochs, n_folds)
-        _journal_epochs(jr, per_epoch, lo, hi, epochs, n_folds)
-        if checkpoint_path is not None:
-            ckpt_lib.save_run_snapshot(
-                checkpoint_path, carry,
-                {k: np.concatenate(v, axis=1) for k, v in metrics.items()},
-                epochs_done=hi, signature=signature)
-            logger.info("Checkpointed %d/%d epochs to %s", hi, epochs,
-                        checkpoint_path)
-        # The chunk boundary is the safe point: the snapshot (when this
-        # run keeps one) just landed, so a pending SIGTERM/SIGINT (or the
-        # armed host.preempt chaos site) stops the run HERE, losing
-        # nothing — raises Preempted, which the entrypoint journals as
-        # run_end(status="preempted").  Snapshot-less chunked runs honor
-        # the stop too (no resume seed, but a journaled graceful end
-        # beats burning the grace window to be SIGKILLed mid-flight).
-        preempt.check(chunk=chunk_no, epochs_done=hi, n_folds=n_folds)
-        # The chunk boundary is also the training loop's liveness beat:
-        # a run that stops reaching boundaries (stuck dispatch, wedged
-        # host) goes silent here and the watchdog/supervisor act on it.
-        heartbeat.beat("step", epochs_done=hi, n_folds=n_folds)
-        chunk_no += 1
-        # Legacy _crash_after_chunk shim + chaos plans: a plain (non-
-        # device-fault) crash after a completed chunk, exercising resume.
-        inject.fire("train.chunk", chunk=chunk_no, n_folds=n_folds)
-        # Chaos hang site (action="sleep"): a silent stall right after a
-        # completed chunk/snapshot — deterministically testable hang with
-        # a valid resume seed already on disk (the supervisor drill).
-        inject.fire("train.hang", chunk=chunk_no, n_folds=n_folds)
+    try:
+        for lo in range(start_epoch, epochs, checkpoint_every):
+            hi = min(lo + checkpoint_every, epochs)
+            if chunk_no == 0:
+                # First segment call compiles (or hits the persistent
+                # cache); later chunks reuse the executable, so chunk-0
+                # wall minus a later chunk's wall bounds the compile cost.
+                jr.event("compile_begin", what="epoch_segment")
+            with timer:
+                carry, per_epoch = segment(pool_x, pool_y, stacked, carry,
+                                           epoch_keys[:, lo:hi])
+                carry = jax.block_until_ready(carry)
+            if chunk_no == 0:
+                jr.event("compile_end", what="epoch_segment",
+                         elapsed_s=round(timer.times[-1], 3),
+                         includes_execution=True)
+                jr.sample_device_memory()
+            # chunk_wall_s is the compiled scan strictly; snapshot cost is
+            # its own pair of series (ckpt_write_s total write time,
+            # ckpt_block_s the part the step loop actually waited on — ~0
+            # when writes overlap) so the journal proves the overlap.
+            jr.metrics.observe("chunk_wall_s", timer.times[-1])
+            for name, arr in zip(
+                    ("train_losses", "val_losses", "val_accuracies",
+                     "grad_norms"), per_epoch):
+                metrics[name].append(np.asarray(arr))
+            _log_epoch_cadence(per_epoch, lo, hi, epochs, n_folds)
+            _journal_epochs(jr, per_epoch, lo, hi, epochs, n_folds)
+            if writer is not None:
+                # Hand the immutable carry to the background writer: the
+                # device→host fetch + serialization + fsync/rename AND the
+                # O(epochs-so-far) metric-history concatenation overlap
+                # the next chunk's scan (sync mode writes inline here).
+                # Shallow list copies: the writer concatenates them on its
+                # own thread while these lists keep growing.
+                writer.submit(
+                    carry,
+                    {k: list(v) for k, v in metrics.items()},
+                    epochs_done=hi)
+                logger.info("Checkpoint %d/%d epochs -> %s%s", hi, epochs,
+                            checkpoint_path,
+                            " (async)" if checkpoint_async else "")
+            # The chunk boundary is the safe point: the snapshot (when this
+            # run keeps one) just landed — or is in flight and committed by
+            # the writer's close/drain hook before the exception escapes —
+            # so a pending SIGTERM/SIGINT (or the armed host.preempt chaos
+            # site) stops the run HERE, losing nothing — raises Preempted,
+            # which the entrypoint journals as run_end(status="preempted").
+            # Snapshot-less chunked runs honor the stop too (no resume
+            # seed, but a journaled graceful end beats burning the grace
+            # window to be SIGKILLed mid-flight).
+            preempt.check(chunk=chunk_no, epochs_done=hi, n_folds=n_folds)
+            # The chunk boundary is also the training loop's liveness beat:
+            # a run that stops reaching boundaries (stuck dispatch, wedged
+            # host) goes silent here and the watchdog/supervisor act on it.
+            heartbeat.beat("step", epochs_done=hi, n_folds=n_folds)
+            chunk_no += 1
+            # Legacy _crash_after_chunk shim + chaos plans: a plain (non-
+            # device-fault) crash after a completed chunk, exercising resume.
+            inject.fire("train.chunk", chunk=chunk_no, n_folds=n_folds)
+            # Chaos hang site (action="sleep"): a silent stall right after a
+            # completed chunk/snapshot — deterministically testable hang with
+            # a valid resume seed already on disk (the supervisor drill).
+            inject.fire("train.hang", chunk=chunk_no, n_folds=n_folds)
+    except BaseException:
+        # The in-flight snapshot must be durable before the exception
+        # (device fault, injected crash, Preempted) escapes — that write
+        # is exactly what --resume will seed from.  Never mask the
+        # propagating error with a write failure.
+        if writer is not None:
+            writer.close(raise_errors=False)
+        raise
+    else:
+        if writer is not None:
+            # Success path: a silently failed final write would leave a
+            # stale resume seed — surface it.
+            writer.close()
 
     _, best_state, best_acc, min_loss = carry
-    evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size)
+    # mesh matters here (not just for speed): the sharded best states must
+    # be evaluated under the same explicit fold-axis SPMD as the trainer —
+    # see make_multi_fold_evaluator's docstring for the GSPMD miscompute
+    # this guards against.
+    evaluator = make_multi_fold_evaluator(model, batch_size=config.batch_size,
+                                          mesh=mesh)
     # Separate timer: fold-epochs/s and MFU measure TRAINING strictly;
     # folding the one-off test-set pass into the same wall deflated them
     # (VERDICT r4 weak #5).  The single-program path above cannot split
@@ -956,6 +1017,7 @@ def within_subject_training(epochs: int | None = None, *,
                             fold_batch: int | None = None,
                             checkpoint_every: int | None = None,
                             resume: bool = False,
+                            checkpoint_async: bool = True,
                             _crash_after_chunk: int | None = None,
                             _fault_if_folds_over: int | None = None) -> ProtocolResult:
     """Within-subject protocol: per subject, 4-fold CV over both sessions."""
@@ -1001,7 +1063,7 @@ def within_subject_training(epochs: int | None = None, *,
             checkpoint_every=checkpoint_every,
             checkpoint_path=(paths.models
                              / f"within_subject_{model_name}.run.npz"),
-            resume=resume,
+            resume=resume, checkpoint_async=checkpoint_async,
             signature={"protocol": "within_subject", "model": model_name,
                        "subjects": list(subjects)})
 
@@ -1139,6 +1201,7 @@ def cross_subject_training(epochs: int | None = None, *,
                            fold_batch: int | None = None,
                            checkpoint_every: int | None = None,
                            resume: bool = False,
+                           checkpoint_async: bool = True,
                            _crash_after_chunk: int | None = None,
                            _fault_if_folds_over: int | None = None) -> ProtocolResult:
     """Cross-subject protocol: 5-train/3-val/1-test subjects, 10 repeats."""
@@ -1195,7 +1258,7 @@ def cross_subject_training(epochs: int | None = None, *,
             checkpoint_every=checkpoint_every,
             checkpoint_path=(paths.models
                              / f"cross_subject_{model_name}.run.npz"),
-            resume=resume,
+            resume=resume, checkpoint_async=checkpoint_async,
             signature={"protocol": "cross_subject", "model": model_name,
                        "subjects": list(subjects)})
 

@@ -142,6 +142,41 @@ def leg_checkpoint_write(root: Path) -> None:
     assert np.isfinite(result.avg_test_acc)
 
 
+def leg_checkpoint_write_async(root: Path) -> None:
+    """Torn BACKGROUND snapshot write (the SIGKILL-mid-async-write shape)
+    -> resume quarantines the torn newest generation and seeds from the
+    previous valid one.
+
+    The ``checkpoint.write_async`` site fires INSIDE the background
+    writer thread on the SECOND write (epoch-4 generation), garbling its
+    staged bytes; the armed ``train.chunk`` crash then unwinds the run —
+    the writer's exception-path close() commits the torn write first,
+    exactly what a SIGKILL landing mid-async-write leaves on disk.
+    """
+    paths = _fresh(root, "checkpoint.write_async")
+    baseline = _run_ws(paths, checkpoint_every=2)
+    try:
+        with inject.scoped(
+                inject.FaultSpec(site="checkpoint.write_async", after=1,
+                                 times=1),
+                inject.FaultSpec(site="train.chunk", after=1, times=1)):
+            _run_ws(paths, checkpoint_every=2)
+        raise AssertionError("armed train.chunk did not crash")
+    except RuntimeError as exc:
+        assert "injected crash" in str(exc), exc
+    with obs.run(root / "obs" / "checkpoint_write_async") as jr:
+        resumed = _run_ws(paths, checkpoint_every=2, resume=True)
+    events = _events(jr)
+    assert "checkpoint_quarantine" in _kinds(events), _kinds(events)
+    # Seeded from the PREVIOUS valid generation (epochs_done=2), not from
+    # scratch: the resumed run's first snapshot then lands at the next
+    # chunk boundary, epoch 4 (a from-scratch run's would land at 2).
+    writes = [e for e in events if e["event"] == "checkpoint_write"]
+    assert writes and writes[0]["epochs_done"] == 4, writes
+    np.testing.assert_array_equal(resumed.fold_test_acc,
+                                  baseline.fold_test_acc)
+
+
 def leg_host_preempt(root: Path) -> None:
     """Armed preemption -> snapshot + preempted run_end -> --resume."""
     paths = _fresh(root, "host.preempt")
@@ -514,6 +549,7 @@ LEGS = {
     "train.step": leg_train_step,
     "train.chunk": leg_train_chunk,
     "checkpoint.write": leg_checkpoint_write,
+    "checkpoint.write_async": leg_checkpoint_write_async,
     "host.preempt": leg_host_preempt,
     "data.read": leg_data_read,
     "fetch.download": leg_fetch_download,

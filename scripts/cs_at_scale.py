@@ -16,6 +16,16 @@ trajectories) and wall-clock + fold-epochs/s recorded to
 Run with the ambient chip pin:  ``python scripts/cs_at_scale.py --out
 /tmp/cs_scale``; CI-sized dress: ``--epochs 10 --foldBatch 5`` under
 ``EEGTPU_PLATFORM=cpu``.
+
+``--meshFold/--meshData/--meshModel`` shard the run over a named
+(fold, data, model) mesh (``parallel/shardspec.py`` places the fold-major
+carry on the fold axis); ``--syncCheckpoint`` restores the blocking
+snapshot write the async ``SnapshotWriter`` replaced.  ``--selftest``
+runs the CI-sized sharded+async vs unsharded+sync A/B on forced host
+devices, asserts sharded throughput >= unsharded with zero
+blocking-write stalls (from the journal's ``checkpoint_write`` events),
+and writes ``BENCH_CS_SHARD.json`` — the tier-1 leg
+(``tests/test_shard_async.py``) invokes exactly this.
 """
 
 from __future__ import annotations
@@ -23,6 +33,7 @@ from __future__ import annotations
 import argparse
 import hashlib
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -31,6 +42,17 @@ import numpy as np
 
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
+
+
+def _build_mesh(args):
+    """The run's device mesh from --meshFold/--meshData/--meshModel
+    (None when all three are unset — the unsharded path)."""
+    if not (args.meshFold or args.meshData > 1 or args.meshModel > 1):
+        return None
+    from eegnetreplication_tpu.parallel import make_mesh
+
+    return make_mesh(n_fold=args.meshFold or None, n_data=args.meshData,
+                     n_model=args.meshModel)
 
 
 def main(argv=None) -> int:
@@ -45,6 +67,23 @@ def main(argv=None) -> int:
     parser.add_argument("--checkpointEvery", type=int, default=50)
     parser.add_argument("--trials", type=int, default=288,
                         help="Trials per session (competition: 288).")
+    parser.add_argument("--meshFold", type=int, default=0,
+                        help="Shard the fold axis over this many devices "
+                             "(0 = no mesh unless --meshData/--meshModel "
+                             "ask for one).")
+    parser.add_argument("--meshData", type=int, default=1,
+                        help="Within-fold data-parallel shards.")
+    parser.add_argument("--meshModel", type=int, default=1,
+                        help="Model-axis shards (optimizer-state "
+                             "partitioning via the sharding-spec tree).")
+    parser.add_argument("--syncCheckpoint", action="store_true",
+                        help="Blocking snapshot writes (the pre-async "
+                             "behaviour; default overlaps them with the "
+                             "next chunk's scan).")
+    parser.add_argument("--selftest", action="store_true",
+                        help="CI-sized sharded+async vs unsharded+sync A/B "
+                             "on forced host devices; writes "
+                             "BENCH_CS_SHARD.json under --out.")
     parser.add_argument("--pool", default=None,
                         help="Path to an equiv_task pool (.npz): trains on "
                              "the NON-saturating task instead of the easy "
@@ -57,6 +96,9 @@ def main(argv=None) -> int:
 
     out = Path(args.out)
     out.mkdir(parents=True, exist_ok=True)
+
+    if args.selftest:
+        return selftest(out, epochs=min(args.epochs, 10))
 
     from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
     from eegnetreplication_tpu.training.protocols import (
@@ -84,19 +126,23 @@ def main(argv=None) -> int:
 
         loader = make_loader(n_trials=args.trials, n_channels=22,
                              n_times=257, class_sep=1.0)
+    mesh = _build_mesh(args)
     record = {"platform": platform, "epochs": args.epochs,
               "pool": args.pool,
               "fold_batch_arg": args.foldBatch,
               "checkpoint_every": args.checkpointEvery,
               "trials_per_session": args.trials,
+              "mesh": dict(mesh.shape) if mesh is not None else None,
+              "checkpoint_async": not args.syncCheckpoint,
               "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
     t0 = time.time()
     try:
         result = cross_subject_training(
             epochs=args.epochs, config=DEFAULT_TRAINING, loader=loader,
             paths=Paths.from_root(out), save_models=False,
-            fold_batch=args.foldBatch,
-            checkpoint_every=args.checkpointEvery)
+            fold_batch=args.foldBatch, mesh=mesh,
+            checkpoint_every=args.checkpointEvery,
+            checkpoint_async=not args.syncCheckpoint)
         wall = time.time() - t0
         n_folds = len(result.fold_test_acc)
         # Freshness evidence: 90 independently-initialized folds yield a
@@ -145,6 +191,183 @@ def main(argv=None) -> int:
                                    kind="bench", indent=1)
     print(json.dumps(record))
     return 0 if record.get("ok") else 1
+
+
+def selftest(out: Path, epochs: int = 10) -> int:
+    """CI-sized sharded+async vs unsharded+sync A/B (the tier-1 leg).
+
+    Two arms over the SAME host and the same tiny synthetic cross-subject
+    protocol (4 subjects x 1 repeat = 4 folds, 2-epoch chunks):
+
+    - ``unsharded_sync`` — no mesh, blocking snapshot writes (the pre-PR
+      training path);
+    - ``sharded_async``  — folds sharded over the mesh fold axis via the
+      sharding-spec tree placement, snapshots overlapped by the
+      background writer.
+
+    Throughput is compared STEADY-STATE: the compile chunk (each arm's
+    max ``chunk_wall_s``) is excluded because the two arms compile
+    different programs and compile noise would swamp a CI-sized run; the
+    sync arm's blocked write time counts toward its steady wall (that is
+    exactly the stall the async writer removes).  Asserts sharded+async
+    >= unsharded+sync, ZERO stalled writes in the async arm (a stall = an
+    in-loop write whose join cost the step loop real time — see the
+    threshold comment in ``run_arm``; the final write's close()-time
+    drain is shutdown tail, not a stall), and test-accuracy parity
+    between the arms (the sharded evaluator must agree with the plain
+    one), then writes ``BENCH_CS_SHARD.json`` through the shared atomic
+    writer.
+    """
+    # Forced host devices (a no-op when a harness — e.g. the test suite's
+    # conftest — already forced them before jax initialized).
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    os.environ.setdefault("EEGTPU_NO_LOG_FILE", "1")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from eegnetreplication_tpu import obs
+    from eegnetreplication_tpu.config import DEFAULT_TRAINING, Paths
+    from eegnetreplication_tpu.obs import schema as obs_schema
+    from eegnetreplication_tpu.parallel import make_mesh
+    from eegnetreplication_tpu.training.protocols import (
+        cross_subject_training,
+    )
+
+    sys.path.insert(0, str(REPO / "tests"))
+    from synthetic import make_loader
+
+    # 4 subjects x 1 repeat with a 2-train/1-val/1-test split = 4 folds:
+    # the smallest fold set that still exercises the full CS machinery.
+    subjects = (1, 2, 3, 4)
+    cfg = DEFAULT_TRAINING.replace(batch_size=16, cs_train_subjects=2,
+                                   cs_repeats_per_subject=1)
+    # Sized so one 2-epoch chunk comfortably outlasts one ~40 ms snapshot
+    # write: the overlap claim is only testable when the next chunk gives
+    # the background writer room to finish (at real scale chunks are
+    # seconds; 96 trials keeps that proportion at CI cost).
+    loader = make_loader(n_trials=96, n_channels=8, n_times=64)
+    n_folds, checkpoint_every = len(subjects), 2
+    n_dev = len(jax.devices())
+    fold_shards = min(n_folds, n_dev)
+    mesh = make_mesh(n_fold=fold_shards, n_data=1,
+                     devices=jax.devices()[:fold_shards])
+
+    def run_arm(name: str, arm_mesh, async_: bool) -> dict:
+        with obs.run(out / f"obs_{name}") as jr:
+            t0 = time.perf_counter()
+            result = cross_subject_training(
+                epochs=epochs, config=cfg, loader=loader, subjects=subjects,
+                paths=Paths.from_root(out / name), save_models=False,
+                fold_batch=0, checkpoint_every=checkpoint_every,
+                checkpoint_async=async_, mesh=arm_mesh)
+            wall = time.perf_counter() - t0
+            snap = jr.metrics.snapshot(jr.run_id)
+            events = schema_events(jr)
+        chunks = snap["histograms"]["chunk_wall_s"][0]
+        writes = [e for e in events if e["event"] == "checkpoint_write"]
+        # drain=True is the run's final close()-time join — no next chunk
+        # existed to overlap it, so it is shutdown tail, not a stall.
+        in_loop = [e for e in writes if not e.get("drain")]
+        blocked_ms = sum(e["blocked_ms"] for e in in_loop)
+        drain_ms = sum(e["blocked_ms"] for e in writes if e.get("drain"))
+        # Steady state: drop the compile chunk (the max — compile happens
+        # inside the first dispatch) and one write's share of the blocked
+        # time with it; what remains is the per-chunk train + stall loop
+        # the async writer optimizes.
+        n_chunks = int(chunks["count"])
+        steady_chunks = max(1, n_chunks - 1)
+        steady_s = (chunks["sum"] - chunks["max"]
+                    + (blocked_ms / 1000.0) * steady_chunks / max(n_chunks, 1))
+        steady_fold_epochs = n_folds * epochs * steady_chunks / n_chunks
+        return {
+            "mesh": dict(arm_mesh.shape) if arm_mesh is not None else None,
+            "checkpoint_async": async_,
+            "wall_s": round(wall, 3),
+            "n_chunks": n_chunks,
+            "checkpoint_writes": len(writes),
+            # A stall = an in-loop write the step loop genuinely waited
+            # for.  Synchronous writes block by construction and count
+            # unconditionally (even a sub-5ms one on a fast disk); async
+            # writes count only when blocked beyond both a 5 ms floor
+            # (thread-join jitter) and 10% of the write's own duration
+            # (an overlapped write's residual tail).
+            "stalled_writes": sum(
+                1 for e in in_loop
+                if not e["async"]
+                or e["blocked_ms"] > max(5.0, 0.1 * e["dur_ms"])),
+            "ckpt_write_ms": round(sum(e["dur_ms"] for e in writes), 3),
+            "ckpt_blocked_ms": round(blocked_ms, 3),
+            "ckpt_drain_ms": round(drain_ms, 3),
+            "steady_wall_s": round(steady_s, 3),
+            "steady_fold_epochs_per_s": round(steady_fold_epochs
+                                              / max(steady_s, 1e-9), 2),
+            "avg_test_acc": round(float(result.avg_test_acc), 2),
+        }
+
+    def schema_events(jr):
+        return obs_schema.read_events(jr.events_path, complete=False)
+
+    def judge(sync_arm: dict, shard_arm: dict) -> "tuple[list, float]":
+        ratio = (shard_arm["steady_fold_epochs_per_s"]
+                 / max(sync_arm["steady_fold_epochs_per_s"], 1e-9))
+        failures = []
+        if shard_arm["stalled_writes"] != 0:
+            failures.append(
+                f"async arm stalled the step loop on "
+                f"{shard_arm['stalled_writes']} write(s) "
+                f"({shard_arm['ckpt_blocked_ms']} ms) — writes must overlap")
+        if ratio < 1.0:
+            failures.append(
+                f"sharded+async steady throughput "
+                f"{shard_arm['steady_fold_epochs_per_s']} < unsharded+sync "
+                f"{sync_arm['steady_fold_epochs_per_s']} fold-epochs/s")
+        # Accuracy parity is the sharded-evaluator regression gate: GSPMD
+        # auto-partitioning of the external evaluator used to miscompute
+        # every fold shard but the first (make_multi_fold_evaluator
+        # docstring).
+        if abs(shard_arm["avg_test_acc"] - sync_arm["avg_test_acc"]) > 0.5:
+            failures.append(
+                f"sharded test accuracy {shard_arm['avg_test_acc']} != "
+                f"unsharded {sync_arm['avg_test_acc']} — sharded "
+                f"evaluation diverged")
+        return failures, ratio
+
+    arms = {
+        "unsharded_sync": run_arm("unsharded_sync", None, False),
+        "sharded_async": run_arm("sharded_async", mesh, True),
+    }
+    sync_arm = arms["unsharded_sync"]
+    failures, ratio = judge(sync_arm, arms["sharded_async"])
+    measure_attempts = 1
+    if failures:
+        # One noise re-measure of the async arm (serve_bench.py floor
+        # precedent): a loaded CI disk/scheduler can turn a single
+        # thread-join into a >5 ms blip that reads as a stall, or dent
+        # the steady throughput below the sync arm. Accuracy parity is
+        # deterministic, so re-running only the timed arm is sound.
+        measure_attempts = 2
+        arms["sharded_async"] = run_arm("sharded_async_retry", mesh, True)
+        failures, ratio = judge(sync_arm, arms["sharded_async"])
+    record = {
+        "platform": "cpu", "selftest": True, "epochs": epochs,
+        "n_folds": n_folds, "n_devices": n_dev,
+        "fold_shards": fold_shards,
+        "checkpoint_every": checkpoint_every,
+        "arms": arms,
+        "sharded_over_unsharded": round(ratio, 3),
+        "measure_attempts": measure_attempts,
+        "ok": not failures,
+    }
+    if failures:
+        record["error"] = "; ".join(failures)
+    obs_schema.write_json_artifact(out / "BENCH_CS_SHARD.json", record,
+                                   kind="bench", indent=1)
+    print(json.dumps(record, indent=1))
+    return 0 if record["ok"] else 1
 
 
 if __name__ == "__main__":

@@ -96,6 +96,10 @@ _COLUMNS = (
     ("faults_injected", "injected"), ("retries", "retries"),
     ("last_train_loss", "train_loss"), ("last_val_acc", "val_acc%"),
     ("last_grad_norm", "grad_norm"),
+    # Snapshot persistence: total write wall vs the step loop's actual
+    # stall (ckpt_stall_ms ~0 = the writes overlapped training; equal to
+    # ckpt_ms = every write blocked, the pre-async behaviour).
+    ("ckpt_ms", "ckpt_ms"), ("ckpt_blocked_ms", "ckpt_stall_ms"),
     # Serving runs (serve_start/request/model_swap/serve_end streams);
     # training rows show "-" here and vice versa.
     ("n_requests", "reqs"), ("latency_p95_ms", "p95_ms"),

@@ -29,20 +29,22 @@ from eegnetreplication_tpu.utils.platform import force_cpu
 force_cpu(4)  # 4 virtual CPU devices per process, before any backend init
 
 from eegnetreplication_tpu.parallel.mesh import (
-    DATA_AXIS, FOLD_AXIS, initialize_distributed, make_hybrid_mesh,
+    DATA_AXIS, FOLD_AXIS, MODEL_AXIS, initialize_distributed,
+    make_hybrid_mesh,
 )
 initialize_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from eegnetreplication_tpu.utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 assert jax.process_count() == 2, jax.process_count()
 assert jax.device_count() == 8, jax.device_count()
 
 mesh = make_hybrid_mesh(n_data_per_host=2)
-assert dict(mesh.shape) == {FOLD_AXIS: 4, DATA_AXIS: 2}, dict(mesh.shape)
+assert dict(mesh.shape) == {FOLD_AXIS: 4, DATA_AXIS: 2, MODEL_AXIS: 1}, \
+    dict(mesh.shape)
 
 def f(x):
     # reduce over BOTH axes: crosses the process (DCN-analog) boundary

@@ -10,12 +10,23 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
 from eegnetreplication_tpu.models import EEGNet
 from eegnetreplication_tpu.parallel import (
     DATA_AXIS,
     make_dp_eval_step,
     make_dp_train_step,
     make_mesh,
+    shard_state,
+    state_shard_spec,
+)
+from eegnetreplication_tpu.parallel.mesh import make_hybrid_mesh
+from eegnetreplication_tpu.parallel.shardspec import (
+    fold_stacked_spec_tree,
+    model_leaf_spec,
+    place_fold_stacked,
 )
 from eegnetreplication_tpu.training import TrainState, make_optimizer, train_step
 from eegnetreplication_tpu.training.protocols import within_subject_training
@@ -36,15 +47,32 @@ def devices8():
 class TestMesh:
     def test_fold_only_mesh(self, devices8):
         mesh = make_mesh()
-        assert mesh.shape == {"fold": 8, "data": 1}
+        assert dict(mesh.shape) == {"fold": 8, "data": 1, "model": 1}
 
     def test_fold_data_mesh(self, devices8):
         mesh = make_mesh(n_fold=4, n_data=2)
-        assert mesh.shape == {"fold": 4, "data": 2}
+        assert dict(mesh.shape) == {"fold": 4, "data": 2, "model": 1}
+
+    def test_fold_data_model_mesh(self, devices8):
+        mesh = make_mesh(n_fold=2, n_data=2, n_model=2)
+        assert dict(mesh.shape) == {"fold": 2, "data": 2, "model": 2}
+        # model is the minor (fastest-links) axis; fold the major one.
+        assert mesh.axis_names == ("fold", "data", "model")
+
+    def test_model_axis_defaults_to_fold_remainder(self, devices8):
+        mesh = make_mesh(n_model=4)
+        assert dict(mesh.shape) == {"fold": 2, "data": 1, "model": 4}
+
+    def test_hybrid_mesh_single_process(self, devices8):
+        # process_count == 1 collapses to make_mesh with the same axes.
+        mesh = make_hybrid_mesh(n_data_per_host=2, n_model_per_host=2)
+        assert dict(mesh.shape) == {"fold": 2, "data": 2, "model": 2}
 
     def test_bad_shape_raises(self, devices8):
         with pytest.raises(ValueError, match="mesh shape"):
             make_mesh(n_fold=3, n_data=3)
+        with pytest.raises(ValueError, match="mesh shape"):
+            make_mesh(n_fold=4, n_data=1, n_model=3)
 
 
 class TestDataParallelStep:
@@ -111,6 +139,127 @@ class TestDataParallelStep:
         loss_sum, correct = eval_step(state, x, y, w)
         assert 0 <= float(correct) <= 32
         assert np.isfinite(float(loss_sum))
+
+
+class TestShardSpec:
+    """The per-leaf sharding-spec trees (parallel/shardspec.py)."""
+
+    def _state(self):
+        model = EEGNet(n_channels=C, n_times=T, dropout_rate=0.0,
+                       bn_axis_name=DATA_AXIS)
+        tx = make_optimizer()
+        variables = model.init(jax.random.PRNGKey(0),
+                               jnp.zeros((1, C, T)), train=False)
+        return model, tx, TrainState.create(variables, tx)
+
+    def test_model_leaf_spec_picks_largest_divisible_dim(self):
+        leaf = jnp.zeros((4, 16, 8))
+        assert model_leaf_spec(leaf, 4) == P(None, "model")
+        # Tie goes to the LATER dimension (contiguous output-channel
+        # slices for conv kernels).
+        assert model_leaf_spec(jnp.zeros((8, 8)), 4) == P(None, "model")
+        # No divisible dimension / scalar / singleton axis -> replicated.
+        assert model_leaf_spec(jnp.zeros((3, 5)), 4) == P()
+        assert model_leaf_spec(jnp.zeros(()), 4) == P()
+        assert model_leaf_spec(jnp.zeros((8, 8)), 1) == P()
+        # leading_fold reserves dim 0 for the fold axis.
+        assert model_leaf_spec(jnp.zeros((8, 16)), 4,
+                               leading_fold=True) == P("fold", "model")
+        assert model_leaf_spec(jnp.zeros((8,)), 4,
+                               leading_fold=True) == P("fold")
+
+    def test_state_spec_tree_places_only_moments(self, devices8):
+        mesh = make_mesh(n_fold=1, n_data=2, n_model=4)
+        _, _, state = self._state()
+        spec = state_shard_spec(state, mesh)
+        assert spec.sharded and spec.n_model == 4
+        # Params and BN stats replicated — every data shard consumes them
+        # whole each step.
+        for leaf_spec in jax.tree_util.tree_leaves(
+                spec.state.params, is_leaf=lambda x: isinstance(x, P)):
+            assert leaf_spec == P()
+        # At least the Adam moment tensors land on the model axis.
+        moment_specs = jax.tree_util.tree_leaves(
+            spec.state.opt_state, is_leaf=lambda x: isinstance(x, P))
+        assert any("model" in s for s in moment_specs)
+        # The update tree mirrors params' structure with the SAME specs
+        # the moments carry (shards always align).
+        assert (jax.tree_util.tree_structure(spec.update)
+                == jax.tree_util.tree_structure(
+                    jax.tree_util.tree_map(lambda _: 0, state.params)))
+
+    def test_singleton_model_axis_replicates_everything(self, devices8):
+        _, _, state = self._state()
+        spec = state_shard_spec(state, make_mesh())
+        assert not spec.sharded
+        for leaf_spec in jax.tree_util.tree_leaves(
+                spec.state, is_leaf=lambda x: isinstance(x, P)):
+            assert leaf_spec == P()
+
+    def test_place_fold_stacked_commits_fold_axis(self, devices8):
+        mesh = make_mesh()
+        tree = {"a": jnp.zeros((8, 4)), "b": jnp.zeros((8,))}
+        placed = place_fold_stacked(tree, mesh)
+        for key, leaf in placed.items():
+            want = fold_stacked_spec_tree({key: tree[key]})[key]
+            assert leaf.sharding == NamedSharding(mesh, want), key
+        # The fold axis really is split: one shard-per-device leading dim.
+        assert placed["a"].sharding.shard_shape((8, 4)) == (1, 4)
+
+    def test_shard_state_partitions_moment_bytes(self, devices8):
+        mesh = make_mesh(n_fold=1, n_data=2, n_model=4)
+        _, _, state = self._state()
+        spec = state_shard_spec(state, mesh)
+        placed = shard_state(state, mesh, spec)
+        shardings = [leaf.sharding.spec for leaf in
+                     jax.tree_util.tree_leaves(placed.opt_state)]
+        assert any("model" in s for s in shardings)
+
+    def test_zero_sharded_step_matches_replicated(self, devices8):
+        """ZeRO-partitioned moments: bit-level equivalence to the
+        replicated step on the same mesh (elementwise math, sliced)."""
+        mesh = make_mesh(n_fold=1, n_data=2, n_model=4)
+        model, tx, state = self._state()
+        spec = state_shard_spec(state, mesh)
+
+        x = jax.random.normal(jax.random.PRNGKey(1), (64, C, T))
+        y = jax.random.randint(jax.random.PRNGKey(2), (64,), 0, 4)
+        w = jnp.ones(64)
+        rng = jax.random.PRNGKey(3)
+
+        step_rep = make_dp_train_step(model, tx, mesh)
+        step_zero = make_dp_train_step(model, tx, mesh, spec=spec)
+        s_rep, l_rep = step_rep(state, x, y, w, rng)
+        s_zero, l_zero = step_zero(shard_state(state, mesh, spec),
+                                   x, y, w, rng)
+
+        np.testing.assert_allclose(float(l_zero), float(l_rep), rtol=1e-7)
+        # Moments: the slice/update/keep-sharded path is elementwise, so
+        # the gathered moments match the replicated ones exactly (to f32).
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(s_zero.opt_state),
+                jax.tree_util.tree_leaves_with_path(s_rep.opt_state)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-7, err_msg=str(pa))
+        # Params: one all_gather of the update sits between otherwise
+        # identical programs; XLA may contract FMAs differently, so allow
+        # a ~1-ulp tolerance (measured: 2/128 elements off by 9e-10).
+        for (pa, a), (_, b) in zip(
+                jax.tree_util.tree_leaves_with_path(s_zero.params),
+                jax.tree_util.tree_leaves_with_path(s_rep.params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6, err_msg=str(pa))
+        # And the moments STAY partitioned across steps (out_specs hold).
+        out_specs = [leaf.sharding.spec for leaf in
+                     jax.tree_util.tree_leaves(s_zero.opt_state)]
+        assert any("model" in s for s in out_specs)
+
+    def test_spec_mesh_mismatch_raises(self, devices8):
+        mesh = make_mesh(n_fold=1, n_data=2, n_model=4)
+        model, tx, state = self._state()
+        spec = state_shard_spec(state, mesh)
+        with pytest.raises(ValueError, match="spec was built"):
+            make_dp_train_step(model, tx, make_mesh(), spec=spec)
 
 
 class TestFoldSharding:
