@@ -1,0 +1,44 @@
+"""eegtpu-lint: AST-based contract linter for the framework's string seams.
+
+The framework is stitched together by string-keyed contracts — journal
+event types (``obs/schema.py`` ``EVENT_REQUIRED``), chaos-inject sites
+(``resil/inject.py`` ``SITES``), the pinned ``PASSTHROUGH_HEADERS`` set
+(``serve/service.py``), child-process CLI flags resolved by argparse, and
+the ``*_locked`` method convention — and every recent review round caught
+a drift bug in exactly these seams.  This package makes that bug class a
+tier-1 test failure instead of a postmortem: stdlib-``ast`` passes (no
+new dependencies, no imports of the linted code) check every literal call
+site against the single-sourced contract tables, statically.
+
+Passes (see each module's docstring for the precise rules):
+
+- :mod:`.journal_events` — ``*.event("type", ...)`` call sites vs
+  ``EVENT_REQUIRED`` (unknown types, missing required kwargs, declared
+  types nobody emits / documents / summarizes);
+- :mod:`.inject_sites`  — ``fire``/``arm``/``FaultSpec``/chaos-plan site
+  literals vs ``SITES`` (unknown sites, unknown plan options, declared
+  sites no probe fires);
+- :mod:`.spawn_args`    — literal ``--flags`` on child command lines vs
+  the target entry point's ``add_argument`` set (the PR-11 ``--resume``
+  argparse-exit bug class);
+- :mod:`.lock_discipline` — ``*_locked`` methods called outside a
+  ``with self._lock:`` block / non-``*_locked`` caller;
+- :mod:`.jit_purity`    — functions reachable from ``jax.jit`` /
+  ``lax.scan`` / ``shard_map`` call sites must not journal, log, read
+  wall clocks, touch the metrics registry, or use Python-level RNG;
+- :mod:`.single_source` — hand-spelled copies of the pinned header set
+  (the PR-10 dropped-``X-Model`` bug class).
+
+Run via ``eegtpu-lint`` / ``scripts/lint.py`` (text or ``--json``,
+``--baseline`` for grandfathered findings that must only shrink), or
+programmatically through :func:`run_all`.
+"""
+
+from eegnetreplication_tpu.analysis.core import (  # noqa: F401
+    Contracts,
+    Finding,
+    Project,
+    apply_baseline,
+    load_baseline,
+)
+from eegnetreplication_tpu.analysis.runner import PASSES, run_all  # noqa: F401

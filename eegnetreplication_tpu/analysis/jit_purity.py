@@ -1,0 +1,223 @@
+"""Pass 5 — jit purity: no host side effects inside compiled code.
+
+Functions handed to ``jax.jit`` / ``lax.scan`` / ``shard_map`` trace
+once and replay as compiled programs: a ``time.time()``, ``logger`` /
+``logging`` call, journal event, metrics-registry update, or
+Python-level RNG draw inside one either burns into the program as a
+constant (silently wrong forever after) or fires once at trace time and
+never again — both are observability lies.  The telemetry convention
+here is strict: side effects live in the *dispatch wrappers*
+(``_armed_dispatch``, engine warmup), never in traced bodies.
+
+Roots are collected from:
+
+- ``@jax.jit`` / ``@partial(jax.jit, ...)`` / ``@functools.partial(
+  jax.jit, ...)`` decorators;
+- ``jax.jit(f)`` / ``jit(f)`` calls where ``f`` is a name, a lambda, a
+  ``shard_map(...)`` expression, or a local variable assigned from
+  ``jax.vmap(f)`` / ``shard_map(f, ...)`` (one resolution hop);
+- the first argument of ``lax.scan`` / ``jax.lax.scan`` and
+  ``shard_map`` calls.
+
+Each root's full lexical body is checked, plus a one-level static call
+graph: same-file functions the root calls by name.  Cross-module calls
+are not followed (their modules get their own roots when jitted).
+
+Rule: ``jit-impure``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from eegnetreplication_tpu.analysis.core import (
+    Contracts,
+    Finding,
+    Project,
+    SourceFile,
+    dotted_name,
+)
+
+RULE = "jit-impure"
+
+RULES = (RULE,)
+
+_TIME_FNS = ("time", "perf_counter", "monotonic", "time_ns",
+             "perf_counter_ns", "monotonic_ns", "process_time")
+
+
+def _import_map(sf: SourceFile) -> tuple[dict[str, str], dict[str, str]]:
+    """(module alias -> real dotted module, bare name -> dotted origin)
+    so ``import time as t; t.time()`` and ``from time import
+    perf_counter; perf_counter()`` both resolve to their true names."""
+    mod_aliases: dict[str, str] = {}
+    func_aliases: dict[str, str] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod_aliases[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name if alias.asname else alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom) and node.module \
+                and node.level == 0:
+            for alias in node.names:
+                func_aliases[alias.asname or alias.name] = \
+                    f"{node.module}.{alias.name}"
+    return mod_aliases, func_aliases
+
+
+def _impure_dotted(cdn: str) -> str | None:
+    """Why a canonical dotted call name is impure, or None."""
+    base, _, tail = cdn.rpartition(".")
+    if base in ("time", "_time") and tail in _TIME_FNS:
+        return f"wall-clock read {cdn}()"
+    # Segment match so the repo's own `from utils.logging import logger`
+    # (canonical eegnetreplication_tpu.utils.logging.logger.info) counts.
+    if "logging" in cdn.split(".") or "logger" in cdn.split("."):
+        return f"logging call {cdn}()"
+    if base == "random" or cdn.startswith(("numpy.random.",
+                                           "np.random.")):
+        return f"Python-level RNG {cdn}()"
+    return None
+
+
+def _forbidden_call(node: ast.Call,
+                    imports: tuple[dict[str, str], dict[str, str]],
+                    ) -> str | None:
+    """A human-readable description of why this call is impure, or None."""
+    mod_aliases, func_aliases = imports
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr == "event":
+            return "journal .event(...) emission"
+        dn = dotted_name(func)
+        if dn is not None:
+            segs = dn.split(".")
+            # `from jax import random` must canonicalize random.uniform
+            # to jax.random.uniform (pure), not stdlib random.uniform.
+            if segs[0] in func_aliases:
+                segs[0:1] = func_aliases[segs[0]].split(".")
+            else:
+                segs[0] = mod_aliases.get(segs[0], segs[0])
+            why = _impure_dotted(".".join(segs))
+            if why is not None:
+                return why
+        # jr.metrics.inc(...) / registry chains through a .metrics attr.
+        chain = []
+        cur: ast.AST = func
+        while isinstance(cur, ast.Attribute):
+            chain.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            chain.append(cur.id)
+        if "metrics" in chain[1:]:
+            return "metrics-registry update"
+    elif isinstance(func, ast.Name):
+        if func.id == "print":
+            return "print(...) side effect"
+        origin = func_aliases.get(func.id)
+        if origin is not None:
+            return _impure_dotted(origin)
+    return None
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    dn = dotted_name(node)
+    return dn in ("jax.jit", "jit") if dn else False
+
+
+def _first_arg_func(call: ast.Call):
+    return call.args[0] if call.args else None
+
+
+def _collect_roots(sf: SourceFile) -> list[tuple[ast.AST, str, int]]:
+    """(body node, label, line) for every traced-code root in the file."""
+    roots: list[tuple[ast.AST, str, int]] = []
+    # Local assignments like ``vmapped = jax.vmap(run_one)`` so that
+    # ``jax.jit(vmapped)`` resolves one hop to run_one.
+    assigns: dict[str, ast.AST] = {}
+    funcs: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Call):
+            assigns[node.targets[0].id] = node.value
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, []).append(node)
+
+    def resolve(expr: ast.AST, depth: int = 0):
+        """Map a jitted expression to concrete body nodes to check."""
+        if depth > 2 or expr is None:
+            return
+        if isinstance(expr, ast.Lambda):
+            yield expr, "<lambda>", expr.lineno
+        elif isinstance(expr, ast.Name):
+            for fn in funcs.get(expr.id, []):
+                yield fn, fn.name, fn.lineno
+            if expr.id not in funcs and expr.id in assigns:
+                inner = assigns[expr.id]
+                dn = dotted_name(inner.func) or ""
+                if dn.split(".")[-1] in ("vmap", "shard_map", "jit",
+                                         "partial", "checkpoint", "remat"):
+                    yield from resolve(_first_arg_func(inner), depth + 1)
+        elif isinstance(expr, ast.Call):
+            dn = dotted_name(expr.func) or ""
+            if dn.split(".")[-1] in ("vmap", "shard_map", "partial",
+                                     "checkpoint", "remat"):
+                yield from resolve(_first_arg_func(expr), depth + 1)
+
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec) or (isinstance(dec, ast.Call)
+                                        and (_is_jit_ref(dec.func)
+                                             or any(_is_jit_ref(a)
+                                                    for a in dec.args))):
+                    roots.append((node, node.name, node.lineno))
+        elif isinstance(node, ast.Call):
+            dn = dotted_name(node.func) or ""
+            tail = dn.split(".")[-1]
+            if _is_jit_ref(node.func) or tail == "shard_map":
+                roots.extend(resolve(_first_arg_func(node)))
+            elif tail == "scan" and dn in ("lax.scan", "jax.lax.scan"):
+                roots.extend(resolve(_first_arg_func(node)))
+    return roots
+
+
+def check(project: Project, contracts: Contracts) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in project.python_files():
+        imports = _import_map(sf)
+        funcs: dict[str, list[ast.FunctionDef]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, []).append(node)
+
+        seen_roots: set[int] = set()
+        for body, label, _line in _collect_roots(sf):
+            if id(body) in seen_roots:
+                continue
+            seen_roots.add(id(body))
+            checked: set[int] = {id(body)}
+            # The root's lexical body, then one level of same-file callees.
+            frontier: list[tuple[ast.AST, str, bool]] = [(body, label, True)]
+            while frontier:
+                node, name, expand = frontier.pop()
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    why = _forbidden_call(sub, imports)
+                    if why is not None:
+                        findings.append(Finding(
+                            rule=RULE, file=sf.rel, line=sub.lineno,
+                            symbol=f"{label}:{name}",
+                            message=f"{why} inside jit/scan/shard_map-"
+                                    f"traced code (root {label!r}, via "
+                                    f"{name!r}); traced bodies must stay "
+                                    f"pure — side effects belong in the "
+                                    f"dispatch wrapper"))
+                    elif expand and isinstance(sub.func, ast.Name):
+                        for fn in funcs.get(sub.func.id, []):
+                            if id(fn) not in checked:
+                                checked.add(id(fn))
+                                frontier.append((fn, fn.name, False))
+    return list(dict.fromkeys(findings))

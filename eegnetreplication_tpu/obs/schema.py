@@ -483,6 +483,13 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
     # actually stalled on — ckpt_blocked_ms ~0 with overlapped (async)
     # writes is the journal-derived proof the checkpoint cost left the
     # critical path; only reported when the run wrote snapshots.
+    # A quarantined snapshot generation is a loud signal (torn write →
+    # fallback to the previous generation) an operator must see in the
+    # report table, not only by grepping the journal.
+    quarantines = [e for e in events
+                   if e["event"] == "checkpoint_quarantine"]
+    if quarantines:
+        out["checkpoint_quarantines"] = len(quarantines)
     ckpt_writes = [e for e in events if e["event"] == "checkpoint_write"]
     if ckpt_writes:
         # ok=False writes never landed (the run saw the error at the next
@@ -532,7 +539,11 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
     if fleet_starts or any(e["event"] in ("fleet_member", "fleet_reload")
                            for e in events):
         if fleet_starts:
-            out["fleet_replicas"] = len(fleet_starts[-1].get("replicas", []))
+            # Validation pins key presence, not types: guard like the
+            # zoo section's isinstance(e.get("tenants"), list) does.
+            replicas = fleet_starts[-1].get("replicas")
+            if isinstance(replicas, (list, tuple)):
+                out["fleet_replicas"] = len(replicas)
         members = [e for e in events if e["event"] == "fleet_member"]
         out["fleet_member_transitions"] = len(members)
         out["fleet_rejoins"] = sum(1 for e in members
@@ -560,7 +571,9 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
                       if e["event"] == "session_failover"]
     if front_starts or cell_members or migrations or cell_failovers:
         if front_starts:
-            out["cells"] = len(front_starts[-1].get("cells", []))
+            cells = front_starts[-1].get("cells")
+            if isinstance(cells, (list, tuple)):
+                out["cells"] = len(cells)
         out["cell_member_transitions"] = len(cell_members)
         out["cells_failed"] = sum(1 for e in cell_members
                                   if e.get("state") == "failed")

@@ -560,6 +560,19 @@ LEGS = {
     "combined": leg_combined,
 }
 
+# Legs named after a scenario rather than the single inject site they
+# drill.  Every OTHER leg name must be a real `inject.SITES` member —
+# single-sourced here so a site rename (or a typo'd new leg) breaks the
+# drill at import, not by silently never matching a site.
+_SCENARIO_LEGS = ("supervisor.hang", "session.resume", "gray",
+                  "cell.failover", "combined")
+_bad_legs = [name for name in LEGS
+             if name not in _SCENARIO_LEGS and name not in inject.SITES]
+if _bad_legs:  # a plain raise survives python -O, an assert would not
+    raise ValueError(
+        f"chaos_drill legs re-spell unknown inject sites {_bad_legs}; "
+        f"SITES in resil/inject.py is the single source")
+
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="Run the resilience chaos drill.")

@@ -1,0 +1,20 @@
+#!/usr/bin/env python
+"""Shim for ``eegtpu-lint`` (``analysis/cli.py``) so the contract linter
+runs straight from a checkout without installing the package:
+
+    python scripts/lint.py            # text findings, exit 1 on new ones
+    python scripts/lint.py --json     # machine-readable record for CI
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from eegnetreplication_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

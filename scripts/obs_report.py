@@ -100,6 +100,9 @@ _COLUMNS = (
     # stall (ckpt_stall_ms ~0 = the writes overlapped training; equal to
     # ckpt_ms = every write blocked, the pre-async behaviour).
     ("ckpt_ms", "ckpt_ms"), ("ckpt_blocked_ms", "ckpt_stall_ms"),
+    # Quarantined snapshot generations (torn write -> fallback): the
+    # data-loss-adjacent signal an operator must see without grepping.
+    ("checkpoint_quarantines", "quarantines"),
     # Serving runs (serve_start/request/model_swap/serve_end streams);
     # training rows show "-" here and vice versa.
     ("n_requests", "reqs"), ("latency_p95_ms", "p95_ms"),

@@ -96,7 +96,13 @@ def main(argv=None) -> int:
                                          time.gmtime())}
     subj_list = ",".join(str(s) for s in range(1, args.subjects + 1))
     py = sys.executable
+    # Static contract lint first: seconds of AST checking before hours of
+    # training/serving — a drifted journal event, inject site, child flag,
+    # or header set fails the rehearsal before any chip time is spent.
     ok = run_stage(
+        "lint", [py, str(REPO / "scripts" / "lint.py")],
+        root, record, platform="cpu", timeout=120.0)
+    ok = ok and run_stage(
         "make-data",
         [py, str(REPO / "scripts" / "make_full_dataset.py"),
          "--root", str(root), "--subjects", str(args.subjects),

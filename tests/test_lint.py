@@ -1,0 +1,988 @@
+"""eegtpu-lint tests: the whole-tree tier-1 gate plus per-pass fixtures.
+
+Two layers:
+
+- **Gate** — all passes over the real ``eegnetreplication_tpu/`` +
+  ``scripts/`` tree must produce zero non-baseline findings and zero
+  stale baseline entries, in under 10 s (the linter is a tier-1
+  pre-stage; it must stay cheap).
+- **Fixtures** — per rule, a bad snippet the pass must catch and a good
+  snippet it must not, including re-introductions of the two bug shapes
+  that motivated the linter: the PR-10 hand-spelled passthrough-header
+  set (dropped ``X-Model``) and the PR-11 unknown-child-flag
+  argparse-exit (``--resume`` appended to an entry point that does not
+  accept it).
+"""
+
+import json
+import time
+from pathlib import Path
+
+import pytest
+
+from eegnetreplication_tpu.analysis import (
+    Contracts,
+    Project,
+    apply_baseline,
+    cli,
+    inject_sites,
+    jit_purity,
+    journal_events,
+    load_baseline,
+    lock_discipline,
+    run_all,
+    single_source,
+    spawn_args,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+# Mini single-sourced contract files every fixture tree starts from.
+SCHEMA_SRC = '''\
+EVENT_REQUIRED = {
+    "thing_done": ("a", "b"),
+    "ghost_event": ("x",),
+}
+
+
+def event_summary(events):
+    return [e for e in events if e["event"] == "thing_done"
+            or e["event"] == "ghost_event"]
+'''
+
+INJECT_SRC = '''\
+SITES = ("good.site", "other.site")
+
+
+class FaultSpec:
+    site: str
+    after: int = 0
+    times: int = 1
+    sleep: float | None = None
+
+
+def fire(site, **ctx):
+    pass
+
+
+def arm(spec, **options):
+    pass
+
+
+def parse_plan(text):
+    pass
+'''
+
+SERVICE_SRC = '''\
+PASSTHROUGH_HEADERS = ("X-Model", "X-Deadline-Ms", "X-Priority")
+'''
+
+BENCH_NOTES_SRC = "Documented here: thing_done and ghost_event.\n"
+
+
+def make_project(tmp_path, files, bench_notes=BENCH_NOTES_SRC):
+    """A fixture tree with the contract skeleton plus ``files``."""
+    base = {
+        "eegnetreplication_tpu/obs/schema.py": SCHEMA_SRC,
+        "eegnetreplication_tpu/resil/inject.py": INJECT_SRC,
+        "eegnetreplication_tpu/serve/service.py": SERVICE_SRC,
+    }
+    base.update(files)
+    for rel, src in base.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    (tmp_path / "BENCH_NOTES.md").write_text(bench_notes)
+    project = Project.scan(tmp_path)
+    return project, Contracts.from_project(project)
+
+
+def rules_for(findings, rel=None):
+    return [(f.rule, f.symbol) for f in findings
+            if rel is None or f.file == rel]
+
+
+class TestLintGate:
+    """The tier-1 contract: the real tree is clean and the linter cheap."""
+
+    def test_whole_tree_zero_non_baseline_findings(self):
+        t0 = time.monotonic()
+        findings = run_all(REPO)
+        baseline = load_baseline(REPO / "lint_baseline.json")
+        new, matched, stale = apply_baseline(findings, baseline)
+        wall = time.monotonic() - t0
+        assert not new, "new lint findings:\n" + "\n".join(
+            f.render() for f in new)
+        assert not stale, ("stale baseline entries (issue fixed — delete "
+                           f"them, baselines only shrink): {stale}")
+        # The baseline is exceptions-only: every entry must carry a
+        # justification.
+        for entry in baseline.values():
+            assert entry.get("why"), f"baseline entry without why: {entry}"
+        # Tier-1 pre-stage budget: the whole-package run stays cheap.
+        assert wall < 10.0, f"lint took {wall:.1f}s (budget 10s)"
+
+    def test_cli_json_schema(self, capsys):
+        rc = cli.main(["--root", str(REPO), "--json"])
+        record = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert record["schema_version"] == 1
+        assert set(record["counts"]) == {"total", "new", "baselined",
+                                         "stale_baseline"}
+        assert record["counts"]["new"] == 0
+        for f in record["findings"]:
+            assert set(f) == {"rule", "file", "line", "symbol", "message",
+                              "severity", "baselined"}
+
+
+class TestJournalEventsPass:
+    def test_unknown_event_type_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("thing_dome", a=1, b=2)\n'})
+        found = rules_for(journal_events.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert ("journal-event-unknown", "thing_dome") in found
+
+    def test_missing_required_keys_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("thing_done", a=1)\n'})
+        found = rules_for(journal_events.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert ("journal-event-missing-keys", "thing_done") in found
+
+    def test_good_call_and_splat_not_flagged(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr, payload):\n'
+                '    jr.event("thing_done", a=1, b=2)\n'
+                '    jr.event("ghost_event", **payload)\n'})
+        assert not rules_for(journal_events.check(project, contracts),
+                             "eegnetreplication_tpu/mod.py")
+
+    def test_unemitted_undocumented_unsummarized(self, tmp_path):
+        # Only thing_done is emitted; ghost_event is declared + summarized
+        # + documented, dead_event is declared and invisible everywhere.
+        schema = SCHEMA_SRC.replace(
+            '"ghost_event": ("x",),',
+            '"ghost_event": ("x",),\n    "dead_event": (),')
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/obs/schema.py": schema,
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("thing_done", a=1, b=2)\n'
+                'def g(jr):\n    jr.event("ghost_event", x=1)\n'})
+        found = rules_for(journal_events.check(project, contracts))
+        assert ("journal-event-unemitted", "dead_event") in found
+        assert ("journal-event-undocumented", "dead_event") in found
+        assert ("journal-event-unsummarized", "dead_event") in found
+        assert ("journal-event-unemitted", "ghost_event") not in found
+        assert ("journal-event-undocumented", "thing_done") not in found
+
+    def test_missing_event_summary_is_loud(self, tmp_path):
+        # A renamed/moved event_summary must not silently kill the
+        # unsummarized rule (and stale out the whole baseline).
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/obs/schema.py":
+                'EVENT_REQUIRED = {\n    "thing_done": ("a", "b"),\n}\n',
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("thing_done", a=1, b=2)\n'})
+        found = rules_for(journal_events.check(project, contracts))
+        assert ("contract-missing", "event_summary") in found
+        assert ("journal-event-unsummarized", "thing_done") not in found
+
+    def test_missing_bench_notes_is_loud(self, tmp_path):
+        # An absent/empty BENCH_NOTES.md must surface as one contract-
+        # missing finding, not silently disable the undocumented rule.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("thing_done", a=1, b=2)\n'},
+            bench_notes="")
+        found = rules_for(journal_events.check(project, contracts))
+        assert ("contract-missing", "BENCH_NOTES.md") in found
+
+    @pytest.mark.parametrize("decl", ['MEMBER_EVENT = "ghost_event"',
+                                      'MEMBER_EVENT: str = "ghost_event"'])
+    def test_member_event_class_attr_counts_as_emission(self, tmp_path,
+                                                        decl):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'class M:\n'
+                f'    {decl}\n'
+                'def f(jr):\n    jr.event("thing_done", a=1, b=2)\n'})
+        found = rules_for(journal_events.check(project, contracts))
+        assert ("journal-event-unemitted", "ghost_event") not in found
+
+    def test_suppression_comment_silences_line(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n'
+                '    jr.event("odd_one")  '
+                '# lint: ignore[journal-event-unknown]\n'})
+        from eegnetreplication_tpu.analysis.core import filter_suppressed
+        findings = filter_suppressed(
+            project, journal_events.check(project, contracts))
+        assert ("journal-event-unknown", "odd_one") not in rules_for(findings)
+
+
+class TestInjectSitesPass:
+    def test_bad_fire_and_faultspec_site_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import '
+                'FaultSpec, fire\n'
+                'def f():\n'
+                '    fire("good.site")\n'
+                '    fire("bad.site")\n'
+                '    FaultSpec(site="also.bad")\n'})
+        found = rules_for(inject_sites.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert ("inject-site-unknown", "bad.site") in found
+        assert ("inject-site-unknown", "also.bad") in found
+        assert ("inject-site-unknown", "good.site") not in found
+
+    def test_unrelated_local_arm_not_flagged(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def arm(name):\n    pass\n'
+                'def f():\n    arm("not.a.site")\n'})
+        assert not rules_for(inject_sites.check(project, contracts),
+                             "eegnetreplication_tpu/mod.py")
+
+    def test_chaos_plan_literals_checked(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "scripts/drill.py":
+                'cmd = ["x", "--chaos",\n'
+                '       "good.site:times=1,bad.site:after=2"]\n'
+                'def run(child):\n'
+                '    child(chaos="good.site:tmies=1")\n'})
+        found = rules_for(inject_sites.check(project, contracts),
+                          "scripts/drill.py")
+        assert ("chaos-plan-unknown-site", "bad.site") in found
+        assert ("chaos-plan-unknown-option", "good.site:tmies") in found
+        assert ("chaos-plan-unknown-site", "good.site") not in found
+
+    def test_keyword_form_fire_checked_and_probes(self, tmp_path):
+        # fire(site="...") is a legal call shape (fire's signature is
+        # fire(site, **ctx)); the keyword form must be checked and earn
+        # probe credit exactly like the positional one.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import fire\n'
+                'def f():\n'
+                '    fire(site="bad.site")\n'
+                '    fire(site="good.site")\n'
+                '    fire(site="other.site")\n'})
+        found = rules_for(inject_sites.check(project, contracts))
+        assert ("inject-site-unknown", "bad.site") in found
+        assert ("inject-site-unprobed", "good.site") not in found
+        assert ("inject-site-unprobed", "other.site") not in found
+
+    def test_unrelated_site_kwarg_is_not_probe_credit(self, tmp_path):
+        # retry policies / journal events carry site= labels too; those
+        # must not mask dead-site detection.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import fire\n'
+                'def f(retry):\n'
+                '    fire("good.site")\n'
+                '    retry.call(lambda: 0, site="other.site")\n'})
+        found = rules_for(inject_sites.check(project, contracts))
+        assert ("inject-site-unprobed", "other.site") in found
+
+    def test_dead_site_detection_and_site_default_probe(self, tmp_path):
+        # good.site is fired directly; other.site only through a probe
+        # wrapper's site= default (the _armed_dispatch idiom — the body
+        # fires the param, which is what makes the default a probe).
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import fire\n'
+                'def f():\n    fire("good.site")\n'
+                'def wrap(fn, site="other.site"):\n'
+                '    fire(site)\n    return fn\n'
+                'def labeled(fn, site="not.a.site"):\n'
+                '    return fn\n'})  # label namespace: no fire -> ignored
+        found = rules_for(inject_sites.check(project, contracts))
+        assert ("inject-site-unprobed", "other.site") not in found
+        assert ("inject-site-unprobed", "good.site") not in found
+        assert ("inject-site-unknown", "not.a.site") not in found
+        # Drop the default-probe wrapper: other.site goes dead.
+        project2, contracts2 = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import fire\n'
+                'def f():\n    fire("good.site")\n'})
+        found2 = rules_for(inject_sites.check(project2, contracts2))
+        assert ("inject-site-unprobed", "other.site") in found2
+
+
+CHILD_SRC = '''\
+import argparse
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--checkpoint", required=True)
+    parser.add_argument("--port", type=int)
+    return 0
+'''
+
+
+class TestSpawnArgsPass:
+    def test_pr11_unknown_child_flag_caught(self, tmp_path):
+        # The PR-11 shape: a relaunch policy appends --resume to a child
+        # whose argparse does not accept it (argparse exits 2 -> the
+        # supervisor retires the child permanently).
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/childmod.py": CHILD_SRC,
+            "eegnetreplication_tpu/spawner.py":
+                'import sys\n'
+                'def spawn(SupervisorPolicy):\n'
+                '    cmd = [sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod",\n'
+                '           "--checkpoint", "x.npz"]\n'
+                '    policy = SupervisorPolicy(resume_arg="--resume")\n'
+                '    return cmd, policy\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "eegnetreplication_tpu/spawner.py")
+        assert ("spawn-arg-unknown", "--resume") in found
+        assert ("spawn-arg-unknown", "--checkpoint") not in found
+
+    def test_unknown_literal_flag_in_cmd_list_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/childmod.py": CHILD_SRC,
+            "scripts/bench.py":
+                'import sys\n'
+                'def run():\n'
+                '    cmd = [sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod",\n'
+                '           "--port", "80"]\n'
+                '    cmd += ["--chekpoint", "x.npz"]\n'
+                '    cmd.append("--verbose")\n'
+                '    return cmd\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/bench.py")
+        assert ("spawn-arg-unknown", "--chekpoint") in found
+        assert ("spawn-arg-unknown", "--verbose") in found
+        assert ("spawn-arg-unknown", "--port") not in found
+
+    def test_reassigned_cmd_var_first_spawn_still_checked(self, tmp_path):
+        # cmd = [...bad...]; run(cmd); cmd = [...ok...] — rebuilding the
+        # variable must not un-check the first command.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/childmod.py": CHILD_SRC,
+            "scripts/bench.py":
+                'import sys, subprocess\n'
+                'def run():\n'
+                '    cmd = [sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod", "--badflag"]\n'
+                '    subprocess.run(cmd)\n'
+                '    cmd = [sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod",\n'
+                '           "--port", "0"]\n'
+                '    subprocess.run(cmd)\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/bench.py")
+        assert ("spawn-arg-unknown", "--badflag") in found
+        assert ("spawn-arg-unknown", "--port") not in found
+
+    def test_inline_concat_expression_checked(self, tmp_path):
+        # subprocess.run(cmd + ["--flag"]) and ([...] + [...]) — concat
+        # at expression position must not lose the target.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/childmod.py": CHILD_SRC,
+            "scripts/bench.py":
+                'import sys, subprocess\n'
+                'def run():\n'
+                '    cmd = [sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod"]\n'
+                '    subprocess.run(cmd + ["--inlineBad"])\n'
+                '    subprocess.run([sys.executable, "-m",\n'
+                '                    "eegnetreplication_tpu.childmod"]\n'
+                '                   + ["--alsoBad", "--port", "1"])\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/bench.py")
+        assert ("spawn-arg-unknown", "--inlineBad") in found
+        assert ("spawn-arg-unknown", "--alsoBad") in found
+        assert ("spawn-arg-unknown", "--port") not in found
+
+    def test_self_referential_extend_keeps_tracking(self, tmp_path):
+        # cmd = [...]; cmd = cmd + ["--flag"] — the natural way to
+        # extend a command line must inherit the target.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/childmod.py": CHILD_SRC,
+            "scripts/bench.py":
+                'import sys\n'
+                'def run():\n'
+                '    cmd = [sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod"]\n'
+                '    cmd = cmd + ["--nope"]\n'
+                '    cmd = cmd + ["--port", "0"]\n'
+                '    return cmd\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/bench.py")
+        assert ("spawn-arg-unknown", "--nope") in found
+        assert ("spawn-arg-unknown", "--port") not in found
+
+    def test_py_suffixed_flag_value_does_not_retarget(self, tmp_path):
+        # ["scripts/x.py", "--plan", <anything ending .py>, "--bad"] —
+        # a flag's value must not steal the target, or the flags after
+        # it silently escape checking.
+        project, contracts = make_project(tmp_path, {
+            "scripts/target.py":
+                'import argparse\n'
+                'def main():\n'
+                '    p = argparse.ArgumentParser()\n'
+                '    p.add_argument("--plan")\n'
+                '    p.add_argument("--ok")\n',
+            "scripts/caller.py":
+                'import sys\n'
+                'def run(root):\n'
+                '    cmd = [sys.executable, "scripts/target.py",\n'
+                '           "--plan", str(root / "chaos.py"),\n'
+                '           "--bad", "1"]\n'
+                '    return cmd\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/caller.py")
+        assert ("spawn-arg-unknown", "--bad") in found
+        assert ("spawn-arg-unknown", "--plan") not in found
+
+    def test_augassign_to_untracked_var_still_scanned(self, tmp_path):
+        # cmd = list(base); cmd += ["python", "scripts/x.py", "--bad"] —
+        # the augmented literal carries its own target and must not be
+        # swallowed just because `cmd` itself is untracked.
+        project, contracts = make_project(tmp_path, {
+            "scripts/target.py":
+                'import argparse\n'
+                'def main():\n'
+                '    p = argparse.ArgumentParser()\n'
+                '    p.add_argument("--ok")\n',
+            "scripts/caller.py":
+                'def run(base):\n'
+                '    cmd = list(base)\n'
+                '    cmd += ["python", "scripts/target.py", "--bad"]\n'
+                '    return cmd\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/caller.py")
+        assert ("spawn-arg-unknown", "--bad") in found
+
+    def test_separator_retargets_and_unknown_targets_skipped(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/childmod.py": CHILD_SRC,
+            "scripts/outer.py":
+                'import argparse, sys\n'
+                'def main():\n'
+                '    p = argparse.ArgumentParser()\n'
+                '    p.add_argument("--graceS")\n'
+                'def run():\n'
+                '    cmd = [sys.executable, "outer.py", "--graceS", "5",\n'
+                '           "--", sys.executable, "-m",\n'
+                '           "eegnetreplication_tpu.childmod",\n'
+                '           "--prot", "x"]\n'
+                '    other = ["git", "--no-pager", "log"]\n'
+                '    return cmd, other\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/outer.py")
+        assert ("spawn-arg-unknown", "--prot") in found
+        assert ("spawn-arg-unknown", "--graceS") not in found
+        # No resolvable target -> never guess, never flag.
+        assert ("spawn-arg-unknown", "--no-pager") not in found
+
+    def test_bare_literal_script_path_sets_target(self, tmp_path):
+        # ["python", "scripts/x.py", "--flag"] — the most common spelling
+        # must resolve the target just like the Path-expression form.
+        project, contracts = make_project(tmp_path, {
+            "scripts/target.py":
+                'import argparse\n'
+                'def main():\n'
+                '    p = argparse.ArgumentParser()\n'
+                '    p.add_argument("--ok")\n',
+            "scripts/caller.py":
+                'import subprocess\n'
+                'def run():\n'
+                '    subprocess.run(["python", "scripts/target.py",\n'
+                '                    "--ok", "1", "--bogus"])\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/caller.py")
+        assert ("spawn-arg-unknown", "--bogus") in found
+        assert ("spawn-arg-unknown", "--ok") not in found
+
+    def test_serve_args_seam_checked(self, tmp_path):
+        # spawn_replica_fleet(serve_args=...) flags target the serve
+        # entry point even though the list itself names no module.
+        service = SERVICE_SRC + CHILD_SRC
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/serve/service.py": service,
+            "eegnetreplication_tpu/serve/__main__.py":
+                'from eegnetreplication_tpu.serve.service import main\n',
+            "scripts/bench.py":
+                'def run(spawn_replica_fleet):\n'
+                '    serve_args = ["--port", "0", "--buckts", "1,8"]\n'
+                '    spawn_replica_fleet("ck", 3, serve_args=serve_args)\n'})
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/bench.py")
+        assert ("spawn-arg-unknown", "--buckts") in found
+        assert ("spawn-arg-unknown", "--port") not in found
+
+    def test_dict_comprehension_per_replica_args_checked(self, tmp_path):
+        # The real fleet builds per_replica_args as a dict comprehension
+        # assigned to a name; its literal flags must still be checked.
+        service = SERVICE_SRC + CHILD_SRC
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/serve/service.py": service,
+            "eegnetreplication_tpu/serve/__main__.py":
+                'from eegnetreplication_tpu.serve.service import main\n',
+            "scripts/bench.py":
+                'def run(spawn_replica_fleet, n, resume):\n'
+                '    per_replica_args = {\n'
+                '        f"r{i}": ["--port", str(i)]\n'
+                '                 + (["--resume"] if resume else [])\n'
+                '        for i in range(n)}\n'
+                '    spawn_replica_fleet("ck", n,\n'
+                '                        per_replica_args=per_replica_args)\n'
+            })
+        found = rules_for(spawn_args.check(project, contracts),
+                          "scripts/bench.py")
+        assert ("spawn-arg-unknown", "--resume") in found
+        assert ("spawn-arg-unknown", "--port") not in found
+
+
+class TestLockDisciplinePass:
+    BAD = (
+        'import threading\n'
+        'class Box:\n'
+        '    def __init__(self):\n'
+        '        self._lock = threading.Lock()\n'
+        '        self.items = []\n'
+        '    def _count_locked(self):\n'
+        '        return len(self.items)\n'
+        '    def bad(self):\n'
+        '        return self._count_locked()\n'
+        '    def good(self):\n'
+        '        with self._lock:\n'
+        '            return self._count_locked()\n'
+        '    def _sibling_locked(self):\n'
+        '        return self._count_locked()\n'
+    )
+
+    def test_unguarded_call_caught_guarded_ok(self, tmp_path):
+        project, contracts = make_project(
+            tmp_path, {"eegnetreplication_tpu/box.py": self.BAD})
+        findings = lock_discipline.check(project, contracts)
+        lines = [f.line for f in findings
+                 if f.file == "eegnetreplication_tpu/box.py"]
+        assert lines == [9]  # only bad()'s call site
+
+    def test_cross_object_call_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(box):\n    return box._count_locked()\n'})
+        found = rules_for(lock_discipline.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert ("lock-discipline", "_count_locked") in found
+
+    def test_inherited_lock_not_false_positived(self, tmp_path):
+        # A same-file base owns the lock; an imported base may too — in
+        # neither case is correctly guarded subclass code a violation.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import threading\n'
+                'from somewhere import ExternalBase\n'
+                'class Base:\n'
+                '    def __init__(self):\n'
+                '        self._lock = threading.Lock()\n'
+                '    def _n_locked(self):\n'
+                '        return 0\n'
+                'class Child(Base):\n'
+                '    def get(self):\n'
+                '        with self._lock:\n'
+                '            return self._n_locked()\n'
+                'class Orphan(ExternalBase):\n'
+                '    def get(self):\n'
+                '        with self._lock:\n'
+                '            return self._n_locked()\n'
+                '    def bad(self):\n'
+                '        return self._n_locked()\n'})
+        findings = [f for f in lock_discipline.check(project, contracts)
+                    if f.file == "eegnetreplication_tpu/mod.py"]
+        assert [f.line for f in findings] == [17]  # only Orphan.bad()
+
+    def test_annassign_and_dataclass_field_locks_recognized(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import threading\n'
+                'from dataclasses import dataclass, field\n'
+                'class A:\n'
+                '    def __init__(self):\n'
+                '        self._lock: threading.Lock = threading.Lock()\n'
+                '    def _n_locked(self):\n'
+                '        return 0\n'
+                '    def get(self):\n'
+                '        with self._lock:\n'
+                '            return self._n_locked()\n'
+                '@dataclass\n'
+                'class B:\n'
+                '    _lock: threading.Lock = field(\n'
+                '        default_factory=threading.Lock)\n'
+                '    def _n_locked(self):\n'
+                '        return 0\n'
+                '    def get(self):\n'
+                '        with self._lock:\n'
+                '            return self._n_locked()\n'})
+        assert not rules_for(lock_discipline.check(project, contracts),
+                             "eegnetreplication_tpu/mod.py")
+
+    def test_condition_alias_counts_as_lock(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import threading\n'
+                'class Q:\n'
+                '    def __init__(self):\n'
+                '        self._cv = threading.Condition()\n'
+                '    def _pop_locked(self):\n'
+                '        pass\n'
+                '    def get(self):\n'
+                '        with self._cv:\n'
+                '            return self._pop_locked()\n'})
+        assert not rules_for(lock_discipline.check(project, contracts),
+                             "eegnetreplication_tpu/mod.py")
+
+
+class TestJitPurityPass:
+    def test_decorated_jit_with_clock_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import time\nimport jax\n'
+                '@jax.jit\n'
+                'def f(x):\n'
+                '    t = time.time()\n'
+                '    return x + t\n'})
+        found = rules_for(jit_purity.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert any(r == "jit-impure" for r, _ in found)
+
+    def test_scan_body_logging_and_event_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from jax import lax\n'
+                'from eegnetreplication_tpu.utils.logging import logger\n'
+                'def outer(jr, xs):\n'
+                '    def body(carry, x):\n'
+                '        logger.info("step")\n'
+                '        jr.event("epoch", epoch=1)\n'
+                '        return carry, x\n'
+                '    return lax.scan(body, 0, xs)\n'})
+        findings = [f for f in jit_purity.check(project, contracts)
+                    if f.file == "eegnetreplication_tpu/mod.py"]
+        msgs = " ".join(f.message for f in findings)
+        assert "logging call" in msgs and "journal .event" in msgs
+
+    def test_one_level_callee_impurity_caught(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import random\nimport jax\n'
+                'def helper(x):\n'
+                '    return x * random.random()\n'
+                '@jax.jit\n'
+                'def f(x):\n'
+                '    return helper(x)\n'})
+        found = rules_for(jit_purity.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert any(r == "jit-impure" for r, _ in found)
+
+    def test_pure_jit_and_unjitted_side_effects_ok(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import time\nimport jax\nimport jax.numpy as jnp\n'
+                '@jax.jit\n'
+                'def f(x):\n'
+                '    return jnp.tanh(x)\n'
+                'def dispatcher(x):\n'
+                '    t0 = time.perf_counter()\n'
+                '    y = f(x)\n'
+                '    return y, time.perf_counter() - t0\n'})
+        assert not rules_for(jit_purity.check(project, contracts),
+                             "eegnetreplication_tpu/mod.py")
+
+    def test_bare_name_and_module_alias_imports_caught(self, tmp_path):
+        # `from time import perf_counter` / `import time as t` /
+        # `import numpy as np` must not smuggle impurity past the pass.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import jax\nimport time as t\nimport numpy as np\n'
+                'from time import perf_counter\n'
+                'from random import random as rnd\n'
+                '@jax.jit\n'
+                'def f(x):\n'
+                '    return x + perf_counter()\n'
+                '@jax.jit\n'
+                'def g(x):\n'
+                '    return x + t.time()\n'
+                '@jax.jit\n'
+                'def h(x):\n'
+                '    return x + np.random.rand() + rnd()\n'})
+        findings = [f for f in jit_purity.check(project, contracts)
+                    if f.file == "eegnetreplication_tpu/mod.py"]
+        msgs = " ".join(f.message for f in findings)
+        assert "time.perf_counter" in msgs
+        assert "time.time" in msgs
+        assert "RNG" in msgs
+        assert len(findings) >= 4
+
+    def test_jax_random_is_pure(self, tmp_path):
+        # `from jax import random` must canonicalize to jax.random (on-
+        # device RNG, pure), not be mistaken for stdlib random.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import jax\nfrom jax import random\n'
+                '@jax.jit\n'
+                'def f(key, x):\n'
+                '    return x + random.uniform(key, x.shape)\n'})
+        assert not rules_for(jit_purity.check(project, contracts),
+                             "eegnetreplication_tpu/mod.py")
+
+    def test_vmap_var_resolution_one_hop(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'import time\nimport jax\n'
+                'def run_one(x):\n'
+                '    return x + time.time()\n'
+                'def build():\n'
+                '    vmapped = jax.vmap(run_one)\n'
+                '    return jax.jit(vmapped)\n'})
+        found = rules_for(jit_purity.check(project, contracts),
+                          "eegnetreplication_tpu/mod.py")
+        assert any(r == "jit-impure" for r, _ in found)
+
+
+class TestSingleSourcePass:
+    def test_pr10_hand_spelled_header_set_caught(self, tmp_path):
+        # The PR-10 regression: a hand-spelled forwarding set that
+        # silently dropped X-Model.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/serve/fleet/front.py":
+                'def forward(headers):\n'
+                '    keep = ("X-Deadline-Ms", "X-Priority")\n'
+                '    return {h: headers[h] for h in keep if h in headers}\n'})
+        found = rules_for(single_source.check(project, contracts),
+                          "eegnetreplication_tpu/serve/fleet/front.py")
+        assert any(r == "header-set-hand-spelled" for r, _ in found)
+
+    def test_hand_spelled_header_dict_caught(self, tmp_path):
+        # Dict-literal spelling (the natural HTTP-forwarding shape) is
+        # the same drift bug through its keys.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/serve/fleet/front.py":
+                'def forward(d, p):\n'
+                '    return {"X-Deadline-Ms": d, "X-Priority": p}\n'})
+        found = rules_for(single_source.check(project, contracts),
+                          "eegnetreplication_tpu/serve/fleet/front.py")
+        assert any(r == "header-set-hand-spelled" for r, _ in found)
+
+    def test_typod_site_param_default_flagged(self, tmp_path):
+        # A probe wrapper (its body fires the param) whose site= default
+        # is a typo is a dead probe: flagged, not silently dropped.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import fire\n'
+                'def probe_all():\n'
+                '    fire("good.site")\n'
+                '    fire("other.site")\n'
+                'def wrap(fn, site="good.sit"):\n'
+                '    fire(site)\n    return fn\n'})
+        found = rules_for(inject_sites.check(project, contracts))
+        assert ("inject-site-unknown", "good.sit") in found
+
+    def test_single_header_and_imported_set_ok(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/serve/fleet/front.py":
+                'from eegnetreplication_tpu.serve.service import '
+                'PASSTHROUGH_HEADERS\n'
+                'def forward(headers):\n'
+                '    model = headers.get("X-Model")\n'
+                '    return {h: headers[h] for h in PASSTHROUGH_HEADERS\n'
+                '            if h in headers}\n'})
+        assert not rules_for(single_source.check(project, contracts),
+                             "eegnetreplication_tpu/serve/fleet/front.py")
+
+
+class TestBaselineAndCli:
+    def test_baseline_grandfathers_and_stale_fails(self, tmp_path):
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("odd_one")\n'})
+        findings = journal_events.check(project, contracts)
+        baseline = {
+            "journal-event-unknown:eegnetreplication_tpu/mod.py:odd_one":
+                {"rule": "journal-event-unknown",
+                 "file": "eegnetreplication_tpu/mod.py",
+                 "symbol": "odd_one", "why": "fixture"},
+            "journal-event-unknown:eegnetreplication_tpu/mod.py:gone":
+                {"rule": "journal-event-unknown",
+                 "file": "eegnetreplication_tpu/mod.py",
+                 "symbol": "gone", "why": "fixture"},
+        }
+        new, matched, stale = apply_baseline(findings, baseline)
+        assert [f.symbol for f in matched] == ["odd_one"]
+        assert [e["symbol"] for e in stale] == ["gone"]
+        assert all(f.symbol != "odd_one" for f in new)
+
+    # Emits/probes everything the skeleton declares, so a full-CLI run
+    # sees exactly one finding: the bad odd_one emission.
+    CLEAN_MOD = (
+        'from eegnetreplication_tpu.resil.inject import fire\n'
+        'def f(jr):\n'
+        '    fire("good.site")\n'
+        '    fire("other.site")\n'
+        '    jr.event("thing_done", a=1, b=2)\n'
+        '    jr.event("ghost_event", x=1)\n'
+    )
+
+    def test_cli_exit_codes_and_outputs(self, tmp_path, capsys):
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py":
+                self.CLEAN_MOD + 'def g(jr):\n    jr.event("odd_one")\n'})
+        rc = cli.main(["--root", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "journal-event-unknown" in out
+        # Baseline the finding: clean exit; then strip the code, the
+        # baseline entry goes stale and the gate fails again.
+        bl = tmp_path / "lint_baseline.json"
+        bl.write_text(json.dumps({"findings": [
+            {"rule": "journal-event-unknown",
+             "file": "eegnetreplication_tpu/mod.py",
+             "symbol": "odd_one", "why": "fixture"}]}))
+        capsys.readouterr()
+        assert cli.main(["--root", str(tmp_path)]) == 0
+        # Fix the emission (drop odd_one): the baseline entry goes stale
+        # and the gate fails until it is deleted.
+        (tmp_path / "eegnetreplication_tpu/mod.py").write_text(
+            self.CLEAN_MOD)
+        capsys.readouterr()
+        rc = cli.main(["--root", str(tmp_path), "--json"])
+        record = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert record["counts"]["stale_baseline"] == 1
+
+    def test_pass_subset_does_not_stale_other_passes_entries(
+            self, tmp_path, capsys):
+        # A journal-events baseline entry must not read as stale when
+        # only spawn-args runs: skipped passes produce no findings to
+        # match, which is not the same as the issue being fixed.
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py": self.CLEAN_MOD
+            + 'def g(jr):\n    jr.event("odd_one")\n'})
+        (tmp_path / "lint_baseline.json").write_text(json.dumps({
+            "findings": [{"rule": "journal-event-unknown",
+                          "file": "eegnetreplication_tpu/mod.py",
+                          "symbol": "odd_one", "why": "fixture"}]}))
+        rc = cli.main(["--root", str(tmp_path), "--passes", "spawn-args",
+                       "--json"])
+        record = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert record["counts"]["stale_baseline"] == 0
+
+    def test_parse_error_reported_as_finding(self, tmp_path):
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py": "def broken(:\n"})
+        findings = run_all(tmp_path)
+        assert any(f.rule == "parse-error" for f in findings)
+
+    def test_empty_passes_selection_is_a_usage_error(self, tmp_path,
+                                                     capsys):
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py": self.CLEAN_MOD})
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["--root", str(tmp_path), "--passes", " , "])
+        assert exc.value.code == 2
+        assert "selected no passes" in capsys.readouterr().err
+
+    def test_malformed_baseline_is_a_usage_error(self, tmp_path, capsys):
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py": self.CLEAN_MOD})
+        bl = tmp_path / "lint_baseline.json"
+        bl.write_text(json.dumps({"findings": [{"file": "x", "why": "no "
+                                                "rule or symbol"}]}))
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["--root", str(tmp_path)])
+        assert exc.value.code == 2
+        assert "needs 'rule' and 'symbol'" in capsys.readouterr().err
+        bl.write_text("{not json")
+        capsys.readouterr()
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["--root", str(tmp_path)])
+        assert exc.value.code == 2
+        assert "not valid JSON" in capsys.readouterr().err
+        # A bare top-level array is valid JSON but not a baseline.
+        bl.write_text(json.dumps([{"rule": "x", "symbol": "y"}]))
+        capsys.readouterr()
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["--root", str(tmp_path)])
+        assert exc.value.code == 2
+        assert "'findings' list" in capsys.readouterr().err
+
+    def test_baseline_and_no_baseline_conflict(self, tmp_path, capsys):
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py": self.CLEAN_MOD})
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["--root", str(tmp_path), "--no-baseline",
+                      "--baseline", str(tmp_path / "b.json")])
+        assert exc.value.code == 2
+        assert "not allowed with" in capsys.readouterr().err
+
+    def test_missing_explicit_baseline_is_a_usage_error(self, tmp_path,
+                                                        capsys):
+        make_project(tmp_path, {
+            "eegnetreplication_tpu/mod.py": self.CLEAN_MOD})
+        with pytest.raises(SystemExit) as exc:
+            cli.main(["--root", str(tmp_path),
+                      "--baseline", str(tmp_path / "typo.json")])
+        assert exc.value.code == 2
+        assert "does not exist" in capsys.readouterr().err
+
+    def test_non_literal_contract_reported_once(self, tmp_path):
+        # A refactor that makes EVENT_REQUIRED/SITES non-literal must
+        # produce ONE contract-missing finding at the cause, not flood
+        # every call site with bogus unknowns.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/obs/schema.py":
+                'EVENT_REQUIRED = dict(thing_done=("a",))\n'
+                'def event_summary(events):\n    return {}\n',
+            "eegnetreplication_tpu/resil/inject.py":
+                '_CORE = ("good.site",)\nSITES = _CORE + ("other.site",)\n',
+            "eegnetreplication_tpu/mod.py":
+                'def f(jr):\n    jr.event("thing_done", a=1)\n'})
+        je = journal_events.check(project, contracts)
+        assert [(f.rule, f.symbol) for f in je] \
+            == [("contract-missing", "EVENT_REQUIRED")]
+        si = inject_sites.check(project, contracts)
+        assert [(f.rule, f.symbol) for f in si] \
+            == [("contract-missing", "SITES")]
+
+    def test_lost_faultspec_fields_is_loud(self, tmp_path):
+        # Plan-option validation dies silently if FaultSpec's annotated
+        # fields stop being extractable; that must be one loud finding.
+        project, contracts = make_project(tmp_path, {
+            "eegnetreplication_tpu/resil/inject.py":
+                'SITES = ("good.site",)\n'
+                'class FaultSpec:\n'
+                '    def __init__(self, site):\n'
+                '        self.site = site\n'
+                'def fire(site, **ctx):\n    pass\n',
+            "eegnetreplication_tpu/mod.py":
+                'from eegnetreplication_tpu.resil.inject import fire\n'
+                'def f():\n    fire("good.site")\n'})
+        found = rules_for(inject_sites.check(project, contracts))
+        assert ("contract-missing", "FaultSpec") in found
+
+    def test_default_root_refuses_non_checkout(self, tmp_path, monkeypatch,
+                                               capsys):
+        # An installed (site-packages) eegtpu-lint must refuse to guess a
+        # root rather than scan a tree with no scripts/baseline and exit
+        # 1 on spurious findings.
+        monkeypatch.setattr(cli, "_default_root", lambda: tmp_path)
+        (tmp_path / "eegnetreplication_tpu").mkdir()
+        with pytest.raises(SystemExit) as exc:
+            cli.main([])
+        assert exc.value.code == 2
+        assert "pyproject.toml" in capsys.readouterr().err

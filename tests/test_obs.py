@@ -59,6 +59,43 @@ class TestSchema:
                                      "utc": "t", "counters": {},
                                      "gauges": {}})  # histograms missing
 
+    def test_every_declared_event_type_round_trips(self, tmp_path):
+        """One synthetic event of EVERY type in EVENT_REQUIRED survives
+        validate/read_events/event_summary.
+
+        This is the drift guard for the declaration side: a newly added
+        event type whose required-key tuple is malformed (or whose keys
+        the validator cannot satisfy) fails here loudly, the moment it
+        is declared — not when the first real run emits it.
+        """
+        def ev(kind):
+            e = {"event": kind, "t": 1.0, "run_id": "r1"}
+            for key in schema.EVENT_REQUIRED[kind]:
+                assert isinstance(key, str), \
+                    f"{kind!r} declares a non-str required key {key!r}"
+                e[key] = 1
+            return schema.validate_event(e)
+
+        middle = [k for k in schema.EVENT_REQUIRED
+                  if k not in ("run_start", "run_end")]
+        events = [ev("run_start")] + [ev(k) for k in middle] \
+            + [ev("run_end")]
+        path = tmp_path / "events.jsonl"
+        path.write_text("".join(json.dumps(e) + "\n" for e in events))
+        loaded = schema.read_events(path)
+        assert [e["event"] for e in loaded] == [e["event"] for e in events]
+        summary = schema.event_summary(loaded)
+        assert summary["n_events"] == len(events)
+        # A declared type with its required key stripped must fail: the
+        # loud-failure guarantee a new declaration buys.
+        for kind in middle:
+            if not schema.EVENT_REQUIRED[kind]:
+                continue
+            bad = dict(ev(kind))
+            bad.pop(schema.EVENT_REQUIRED[kind][0])
+            with pytest.raises(schema.SchemaError):
+                schema.validate_event(bad)
+
     def test_bench_writer_stamps_and_validates(self, tmp_path):
         path = tmp_path / "BENCH_X.json"
         schema.write_json_artifact(path, {"platform": "cpu", "value": 1.5})
