@@ -94,6 +94,23 @@ def new_run_id() -> str:
             + "-" + os.urandom(3).hex())
 
 
+# Size-triggered journal rotation: a long-lived serve/session run would
+# otherwise grow events.jsonl unboundedly.  Defaults are generous enough
+# that training/bench runs never rotate; long-lived servers roll at 64 MiB
+# and keep the last 8 sealed segments (events.jsonl.1 newest ... .8
+# oldest).  Override via env for tests and space-constrained hosts;
+# rotate bytes <= 0 disables rotation entirely.
+DEFAULT_ROTATE_BYTES = 64 * 1024 * 1024
+DEFAULT_ROTATE_KEEP = 8
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
 class RunJournal:
     """One run's event stream + metrics registry.
 
@@ -102,7 +119,9 @@ class RunJournal:
     """
 
     def __init__(self, metrics_dir: str | Path, run_id: str | None = None,
-                 tb_dir: str | Path | None = None):
+                 tb_dir: str | Path | None = None,
+                 rotate_bytes: int | None = None,
+                 rotate_keep: int | None = None):
         self.run_id = run_id or new_run_id()
         self.dir = Path(metrics_dir) / self.run_id
         self.dir.mkdir(parents=True, exist_ok=True)
@@ -112,6 +131,14 @@ class RunJournal:
         self._t0 = time.perf_counter()
         self._ended = False
         self._tb = TensorBoardMirror(tb_dir) if tb_dir else None
+        self._rotate_bytes = rotate_bytes if rotate_bytes is not None \
+            else _env_int("EEGTPU_JOURNAL_ROTATE_BYTES", DEFAULT_ROTATE_BYTES)
+        self._rotate_keep = max(1, rotate_keep if rotate_keep is not None
+                                else _env_int("EEGTPU_JOURNAL_ROTATE_KEEP",
+                                              DEFAULT_ROTATE_KEEP))
+        # Bytes in the CURRENT live segment, synced from the file at each
+        # handle (re)open so an externally grown file still rotates.
+        self._size = 0
         # Serving journals from HTTP-handler and batcher threads
         # concurrently; one lock keeps every events.jsonl line whole.
         self._write_lock = threading.Lock()
@@ -152,8 +179,15 @@ class RunJournal:
             with self._write_lock:
                 if self._fh is None or self._fh.closed:
                     self._fh = open(self.events_path, "a")
+                    try:
+                        self._size = self.events_path.stat().st_size
+                    except OSError:
+                        self._size = 0
                 self._fh.write(line + "\n")
                 self._fh.flush()
+                self._size += len(line) + 1
+                if 0 < self._rotate_bytes <= self._size:
+                    self._rotate_locked()
         except OSError as exc:
             # Full/read-only filesystem hours into a run: drop the event,
             # never the run (the module contract).  Drop the handle too so
@@ -168,6 +202,36 @@ class RunJournal:
             logger.warning("Telemetry event %r dropped (cannot write %s: "
                            "%s)", event, self.events_path, exc)
         return record
+
+    def _rotate_locked(self) -> None:
+        """Seal the live segment and shift the keep-N chain (caller holds
+        ``_write_lock``).  The live file is closed FIRST, then renamed to
+        ``events.jsonl.1`` (atomic same-directory rename) after
+        ``.1 -> .2 -> ... -> .N`` shift up and the oldest drops — so the
+        persistent handle never keeps appending to a renamed inode, and a
+        crash mid-rotation leaves only complete, line-bounded segments
+        that ``schema.read_events`` stitches back in order."""
+        try:
+            if self._fh is not None:
+                self._fh.close()
+        except OSError:
+            pass
+        self._fh = None
+        self._size = 0
+        try:
+            oldest = Path(f"{self.events_path}.{self._rotate_keep}")
+            if oldest.exists():
+                oldest.unlink()
+            for i in range(self._rotate_keep - 1, 0, -1):
+                src = Path(f"{self.events_path}.{i}")
+                if src.exists():
+                    os.replace(src, f"{self.events_path}.{i + 1}")
+            os.replace(self.events_path, f"{self.events_path}.1")
+        except OSError as exc:
+            # Same contract as event(): a failed rotation must degrade to
+            # "keep appending to the live file", never kill the run.
+            logger.warning("Journal rotation of %s failed: %s",
+                           self.events_path, exc)
 
     def scalar(self, tag: str, value: float, step: int) -> None:
         """Mirror a scalar to TensorBoard when a backend is active."""

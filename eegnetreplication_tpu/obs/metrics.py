@@ -27,8 +27,10 @@ additionally be mirrored as TensorBoard scalars next to the
 from __future__ import annotations
 
 import bisect
+import os
 import re
 import threading
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
@@ -281,22 +283,113 @@ def _prom_number(value: float) -> str:
     return repr(float(value)) if isinstance(value, float) else str(value)
 
 
-def to_prometheus_text(snapshot: dict) -> str:
+# Curated HELP strings for the high-traffic families; everything else
+# gets a generated one (the exposition format wants a HELP line per
+# family, and scrapers render it as the metric's tooltip).
+METRIC_HELP = {
+    "requests_total": "Requests handled, by terminal status.",
+    "request_latency_ms": "End-to-end latency of ok requests (ms).",
+    "probe_requests_total": "Synthetic canary requests handled (X-Probe), "
+                            "by terminal status — kept out of "
+                            "requests_total so probes never move the SLO.",
+    "probes_total": "Black-box canary probes sent, by outcome.",
+    "probe_latency_ms": "Client-observed canary probe latency (ms).",
+    "queue_wait_ms": "Time a request waited in the batching queue (ms).",
+    "batch_trials": "Trials per forwarded micro-batch.",
+    "batch_requests": "Requests coalesced per forwarded micro-batch.",
+    "bucket_fill": "Occupancy fraction of the compiled bucket used.",
+    "compile_seconds": "XLA compile wall time per program (s).",
+    "wall_seconds": "Run wall time (s).",
+    "process_resident_memory_bytes": "Resident set size of this process "
+                                     "(bytes).",
+    "process_open_fds": "Open file descriptors held by this process.",
+    "process_uptime_seconds": "Seconds since this process imported the "
+                              "metrics module.",
+    "eegtpu_build_info": "Build metadata as labels; value is always 1.",
+}
+
+
+def _metric_help(name: str, prom_type: str) -> str:
+    return METRIC_HELP.get(name, f"{name} ({prom_type}).")
+
+
+# Process-level gauges (the prometheus_client process collector's core
+# set, stdlib-only): computed at scrape time, /proc-based where the
+# platform has it and silently absent where it does not.
+_PROCESS_START = time.monotonic()
+
+
+def process_snapshot() -> dict[str, float]:
+    out = {"process_uptime_seconds": round(
+        time.monotonic() - _PROCESS_START, 3)}
+    try:
+        with open("/proc/self/statm") as fh:
+            rss_pages = int(fh.read().split()[1])
+        out["process_resident_memory_bytes"] = float(
+            rss_pages * os.sysconf("SC_PAGE_SIZE"))
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        out["process_open_fds"] = float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        pass
+    return out
+
+
+_BUILD_INFO: dict[str, str] | None = None
+
+
+def build_info() -> dict[str, str]:
+    """Build-info labels (version + git sha), computed once per process —
+    the git subprocess must not run on every scrape."""
+    global _BUILD_INFO
+    if _BUILD_INFO is None:
+        try:
+            from eegnetreplication_tpu import __version__ as version
+        except Exception:  # noqa: BLE001 — partial install
+            version = "unknown"
+        # Runtime import: journal imports this module at import time, so
+        # the reverse edge must stay out of module scope.
+        from eegnetreplication_tpu.obs.journal import _git_sha
+
+        _BUILD_INFO = {"version": str(version), "git_sha": _git_sha()}
+    return _BUILD_INFO
+
+
+def _process_lines() -> list[str]:
+    lines: list[str] = []
+    for name, value in sorted(process_snapshot().items()):
+        lines.append(f"# HELP {name} {_metric_help(name, 'gauge')}")
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {_prom_number(value)}")
+    lines.append("# HELP eegtpu_build_info "
+                 f"{_metric_help('eegtpu_build_info', 'gauge')}")
+    lines.append("# TYPE eegtpu_build_info gauge")
+    lines.append(f"eegtpu_build_info{_prom_labels(build_info())} 1")
+    return lines
+
+
+def to_prometheus_text(snapshot: dict, *, process_metrics: bool = True) -> str:
     """Render a registry snapshot (:meth:`MetricsRegistry.snapshot`) in
     the Prometheus text exposition format: counters and gauges as-is,
     histograms as cumulative ``_bucket{le=...}`` series plus ``_sum`` and
     ``_count`` — what any standard scraper ingests, covering exactly what
-    the JSON snapshot covers."""
+    the JSON snapshot covers, each family under its ``# HELP``/``# TYPE``
+    header.  ``process_metrics=True`` (the default) appends the standard
+    process gauges (rss bytes, open fds, uptime) and an
+    ``eegtpu_build_info`` gauge, read live at render time."""
     lines: list[str] = []
     for section, prom_type in (("counters", "counter"), ("gauges", "gauge")):
         for name, series in sorted(snapshot.get(section, {}).items()):
             pname = _prom_name(name)
+            lines.append(f"# HELP {pname} {_metric_help(name, prom_type)}")
             lines.append(f"# TYPE {pname} {prom_type}")
             for entry in series:
                 lines.append(f"{pname}{_prom_labels(entry['labels'])} "
                              f"{_prom_number(entry['value'])}")
     for name, series in sorted(snapshot.get("histograms", {}).items()):
         pname = _prom_name(name)
+        lines.append(f"# HELP {pname} {_metric_help(name, 'histogram')}")
         lines.append(f"# TYPE {pname} histogram")
         for entry in series:
             labels = entry["labels"]
@@ -316,6 +409,8 @@ def to_prometheus_text(snapshot: dict) -> str:
                          f"{_prom_number(entry['sum'])}")
             lines.append(f"{pname}_count{_prom_labels(labels)} "
                          f"{entry['count']}")
+    if process_metrics:
+        lines.extend(_process_lines())
     return "\n".join(lines) + "\n"
 
 

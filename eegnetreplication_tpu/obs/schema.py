@@ -150,6 +150,22 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     # of one declared objective over the sliding evaluation window.
     "slo_breach": ("objective", "value", "threshold"),
     "slo_recovered": ("objective", "threshold"),
+    # Black-box probing (obs/probe.py): one synthetic canary request
+    # through the real front door.  status is "ok" only when the reply
+    # was 200 AND matched the pinned known answer — "mismatch" is the
+    # gray-failure verdict (fast wrong answers), "http_<code>"/"error"/
+    # "timeout" the reachability ones.  These feed the prober's own
+    # outside-in SLO, journaled as slo_breach with a "probe:" objective.
+    "probe": ("status", "latency_ms", "url"),
+    # On-demand deep profiling (POST /profile): one bounded
+    # ``jax.profiler`` trace window run off the hot path.  status is
+    # "ok" or "error" (+error field); log_dir holds the trace artifacts.
+    "profile_window": ("dur_s", "log_dir", "status"),
+    # Fleet aggregation (obs/agg.py): one rolling FleetState snapshot
+    # folded from every discovered run journal — n_runs journals tailed,
+    # n_members live fleet/cell members seen, window_s the rolling
+    # window the rates/quantiles cover.
+    "agg_snapshot": ("n_runs", "n_members", "window_s"),
     "run_end": ("status", "wall_s"),
 }
 
@@ -217,16 +233,20 @@ def validate_events(events: list[dict], *, complete: bool = True) -> list[dict]:
     return events
 
 
-def read_events(path: str | Path, *, complete: bool = True,
-                lenient_tail: bool = False) -> list[dict]:
-    """Load and validate an ``events.jsonl`` file.
+def rotated_segments(path: str | Path) -> list[Path]:
+    """Rotated siblings of an ``events.jsonl`` (``events.jsonl.N``),
+    oldest first (highest N) — the read order that reassembles the
+    original stream when followed by the live file itself."""
+    path = Path(path)
+    numbered = []
+    for sib in path.parent.glob(path.name + ".*"):
+        suffix = sib.name[len(path.name) + 1:]
+        if suffix.isdigit():
+            numbered.append((int(suffix), sib))
+    return [p for _, p in sorted(numbered, reverse=True)]
 
-    ``lenient_tail=True`` tolerates an unparseable FINAL line: a run
-    killed mid-write (SIGKILL, OOM, preemption without grace) leaves at
-    most one truncated line at the tail, and that crash artifact must not
-    make the whole stream unreadable to post-mortem tooling
-    (``scripts/obs_report.py``).  Garbage anywhere else still raises.
-    """
+
+def _read_jsonl(path: Path, *, lenient_tail: bool) -> list[dict]:
     with open(path) as fh:
         lines = [(n, ln.strip()) for n, ln in enumerate(fh, 1) if ln.strip()]
     events = []
@@ -238,6 +258,31 @@ def read_events(path: str | Path, *, complete: bool = True,
                 break  # truncated tail line: the crash artifact, skip it
             raise SchemaError(
                 f"{path}:{lineno} is not valid JSON: {exc}") from exc
+    return events
+
+
+def read_events(path: str | Path, *, complete: bool = True,
+                lenient_tail: bool = False) -> list[dict]:
+    """Load and validate an ``events.jsonl`` stream, stitching any rotated
+    segments (``events.jsonl.N``, oldest first) before the live file.
+
+    ``lenient_tail=True`` tolerates an unparseable FINAL line of the LIVE
+    file: a run killed mid-write (SIGKILL, OOM, preemption without grace)
+    leaves at most one truncated line at the tail, and that crash artifact
+    must not make the whole stream unreadable to post-mortem tooling
+    (``scripts/obs_report.py``).  Garbage anywhere else still raises —
+    rotated segments were sealed at a line boundary, so they get no
+    leniency.
+    """
+    path = Path(path)
+    segments = rotated_segments(path)
+    events: list[dict] = []
+    for seg in segments:
+        events.extend(_read_jsonl(seg, lenient_tail=False))
+    if path.exists() or not segments:
+        # A missing live file with no segments must still raise the
+        # caller-visible FileNotFoundError the pre-rotation contract had.
+        events.extend(_read_jsonl(path, lenient_tail=lenient_tail))
     return validate_events(events, complete=complete)
 
 
@@ -605,6 +650,36 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
         out.setdefault("shed", 0)
         out["shed_journaled"] = sum(e.get("n_shed", 0)
                                     for e in shed_events)
+    # Black-box probing (obs/probe.py): canary outcomes + the outside-in
+    # tail — only reported for streams a prober journaled into, so
+    # unprobed rows stay compact.  probe_failures counts every non-"ok"
+    # status (mismatch / http_* / timeout / error alike): from the
+    # user's vantage they are all unavailability.
+    probes = [e for e in events if e["event"] == "probe"]
+    if probes:
+        out["probes"] = len(probes)
+        out["probe_failures"] = sum(1 for e in probes
+                                    if e.get("status") != "ok")
+        plat = [e["latency_ms"] for e in probes
+                if e.get("status") == "ok"
+                and isinstance(e.get("latency_ms"), numbers.Real)]
+        if plat:
+            from eegnetreplication_tpu.obs.stats import percentile
+
+            out["probe_p95_ms"] = round(percentile(plat, 0.95), 3)
+    # On-demand profiling (POST /profile): how many bounded trace windows
+    # ran and whether the last one landed its artifacts.
+    profile_windows = [e for e in events if e["event"] == "profile_window"]
+    if profile_windows:
+        out["profile_windows"] = len(profile_windows)
+        out["profile_status"] = profile_windows[-1].get("status")
+    # Fleet aggregation (obs/agg.py): snapshot cadence + the last
+    # snapshot's fleet size, so an aggregator's own run renders usefully.
+    agg_snapshots = [e for e in events if e["event"] == "agg_snapshot"]
+    if agg_snapshots:
+        out["agg_snapshots"] = len(agg_snapshots)
+        out["agg_runs"] = agg_snapshots[-1].get("n_runs")
+        out["agg_members"] = agg_snapshots[-1].get("n_members")
     cache_events = [e for e in events if e["event"] == "compile"
                     and e.get("cache_hit") is not None]
     if cache_events:

@@ -137,6 +137,13 @@ class MicroBatcher:
                   trace.TraceContext | None, int]]] = {}
         self._rr: deque[int] = deque()
         self._pending_trials = 0
+        # Futures of observability-exempt requests (probes): they ride
+        # the real queue and forward but are kept OUT of the adaptive
+        # admission and tuner statistics.  A side set keyed by Future
+        # identity (rather than widening the queue tuples) — entries are
+        # added under ``_cv`` at submit and discarded at every terminal
+        # path (scatter, expiry, forward failure, non-drain close).
+        self._exempt: set[Future] = set()
         self._closed = False
         # Run the worker inside a copy of the constructing thread's
         # context so journal.current() (and inject/retry's journaling)
@@ -178,7 +185,8 @@ class MicroBatcher:
 
     def submit(self, trials: np.ndarray,
                deadline: float | None = None,
-               priority: bool = False, tenant: int = 0) -> Future:
+               priority: bool = False, tenant: int = 0,
+               exempt: bool = False) -> Future:
         """Enqueue ``(n, C, T)`` trials; the future resolves to their
         ``(n,)`` predictions.  Raises :class:`Rejected` when the queue is
         full or the batcher is shut down, :class:`Shed` when the adaptive
@@ -190,7 +198,13 @@ class MicroBatcher:
         adaptive limit (never shed before bulk) and only the hard
         ``max_queue_trials`` cliff applies.  ``tenant`` indexes the
         request's model in a multi-tenant zoo (``tenant_aware``
-        batchers only — the single-model contract pins tenant 0)."""
+        batchers only — the single-model contract pins tenant 0).
+        ``exempt=True`` marks synthetic canary traffic (probes): it
+        bypasses the adaptive limit AND is excluded from the
+        queue-wait/batch-shape observations that feed the AIMD admission
+        loop and the ladder tuner — a prober must measure the service,
+        never steer it.  (It still occupies a real batch slot, so
+        ``bucket_fill`` includes it — that IS the padding it causes.)"""
         x = np.asarray(trials, np.float32)
         if x.ndim == 2:
             x = x[None]
@@ -216,7 +230,7 @@ class MicroBatcher:
                 raise Rejected(
                     f"queue full ({self._pending_trials} trials pending, "
                     f"limit {self.max_queue_trials})")
-            if (self.admission is not None and not priority
+            if (self.admission is not None and not priority and not exempt
                     and not self.admission.admit(self._pending_trials, n)):
                 # Shed verdict noted here, recorded BELOW: record_shed
                 # may write a throttled journal line, and disk I/O under
@@ -230,6 +244,8 @@ class MicroBatcher:
                     self._rr.append(tenant)
                 q.append((x, fut, time.perf_counter(), deadline,
                           trace.current(), tenant))
+                if exempt:
+                    self._exempt.add(fut)
                 self._pending_trials += n
                 self._gauge_depth_locked()
                 self._cv.notify_all()
@@ -274,6 +290,7 @@ class MicroBatcher:
                 for q in self._queues.values():
                     while q:
                         _, fut, _, _, _, _ = q.popleft()
+                        self._exempt.discard(fut)
                         fut.set_exception(
                             Rejected("serving is shutting down"))
                 self._queues.clear()
@@ -319,11 +336,15 @@ class MicroBatcher:
                 trace.emit_span(
                     ctx, "queue.wait", dur_s=wait_s,
                     journal=self._journal, status="expired")
-                if self.admission is not None:
+                if self.admission is not None \
+                        and fut not in self._exempt:
                     # An expired wait is the strongest overload evidence
                     # there is — it must feed the AIMD loop, not just the
-                    # completions that squeaked through.
+                    # completions that squeaked through.  Exempt (probe)
+                    # expiries stay out: a canary's deadline must never
+                    # clamp user admission.
                     self.admission.observe_wait(wait_s * 1000.0)
+                self._exempt.discard(fut)
                 if not fut.cancelled():
                     fut.set_exception(DeadlineExceeded(
                         "request deadline expired while queued; dropped "
@@ -496,6 +517,7 @@ class MicroBatcher:
                     preds = np.asarray(self._dispatch(x, tenants))
             except BaseException as exc:  # noqa: BLE001 — routed to futures
                 for _, fut, _, _, _ in batch:
+                    self._exempt.discard(fut)
                     if not fut.cancelled():
                         fut.set_exception(exc)
                 continue
@@ -503,15 +525,25 @@ class MicroBatcher:
             # preds[off : off + len(request i)].
             t_scatter = time.perf_counter()
             off = 0
+            n_exempt_trials = 0
+            n_exempt_reqs = 0
             for bx, fut, t_enq, ctx, _ in batch:
                 k = len(bx)
                 if not fut.cancelled():
                     fut.set_result(preds[off:off + k])
                 off += k
-                self._journal.metrics.observe(
-                    "queue_wait_ms", (now - t_enq) * 1000.0)
-                if self.admission is not None:
-                    self.admission.observe_wait((now - t_enq) * 1000.0)
+                if fut in self._exempt:
+                    # Probe canaries ride the real queue and forward but
+                    # never feed the tuner/admission inputs — their
+                    # cadence is the operator's, not the workload's.
+                    self._exempt.discard(fut)
+                    n_exempt_trials += k
+                    n_exempt_reqs += 1
+                else:
+                    self._journal.metrics.observe(
+                        "queue_wait_ms", (now - t_enq) * 1000.0)
+                    if self.admission is not None:
+                        self.admission.observe_wait((now - t_enq) * 1000.0)
                 # Per-request scatter span: dequeue -> result delivered,
                 # linked to the shared forward it rode.
                 trace.emit_span(
@@ -520,6 +552,12 @@ class MicroBatcher:
                     journal=self._journal, n_trials=k,
                     link_span=forward_span,
                     forward_ms=round((t_scatter - t_fwd) * 1000.0, 3))
-            self._journal.metrics.observe("batch_trials", len(x))
-            self._journal.metrics.observe("batch_requests", len(batch))
+            # Batch-shape observations count USER work only: an
+            # all-probe batch records nothing (its shape says nothing
+            # about the workload the tuner is sizing for).
+            if len(batch) > n_exempt_reqs:
+                self._journal.metrics.observe(
+                    "batch_trials", len(x) - n_exempt_trials)
+                self._journal.metrics.observe(
+                    "batch_requests", len(batch) - n_exempt_reqs)
             self.heartbeat.beat("serve_idle")

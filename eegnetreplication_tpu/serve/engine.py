@@ -32,6 +32,7 @@ import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
 from eegnetreplication_tpu.obs import trace
+from eegnetreplication_tpu.utils import flops as flops_lib
 from eegnetreplication_tpu.utils.logging import logger
 
 # The padded-batch compilation ladder.  Small enough that warmup stays
@@ -271,14 +272,27 @@ class InferenceEngine:
                 self._journal.event("compile_begin", what=what)
                 probe = compile_cache_probe(cache_dir)
                 t0 = time.perf_counter()
-                jax.block_until_ready(self._fwd(*self._warm_args(b)))
+                warm_args = self._warm_args(b)
+                jax.block_until_ready(self._fwd(*warm_args))
                 wall = time.perf_counter() - t0
                 walls[b] = wall
                 cache_hit = compile_cache_hit(cache_dir, probe)
+                # HLO cost attribution: lowering re-traces (cheap, no
+                # compile) and the cost model prices this bucket's
+                # program — the observability plane ranks compiled
+                # programs by FLOPs/bytes straight from the journal.
+                flops, bytes_accessed = None, None
+                try:
+                    flops, bytes_accessed = flops_lib.cost_flops_bytes(
+                        self._fwd.lower(*warm_args))
+                except Exception:  # noqa: BLE001 — accounting only
+                    pass
                 self._journal.event("compile", what=what,
                                     cache_hit=cache_hit,
                                     cache_dir=cache_dir,
-                                    elapsed_s=round(wall, 3))
+                                    elapsed_s=round(wall, 3),
+                                    flops=flops,
+                                    bytes_accessed=bytes_accessed)
                 self._journal.event("compile_end", what=what,
                                     elapsed_s=round(wall, 3),
                                     includes_execution=True,

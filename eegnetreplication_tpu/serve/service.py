@@ -70,6 +70,7 @@ import io
 import json
 import math
 import os
+import tempfile
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -78,8 +79,10 @@ from pathlib import Path
 import numpy as np
 
 from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import probe as obs_probe
 from eegnetreplication_tpu.obs import slo as obs_slo
 from eegnetreplication_tpu.obs import trace
+from eegnetreplication_tpu.obs.probe import PROBE_HEADER
 from eegnetreplication_tpu.obs.metrics import (
     PROMETHEUS_CONTENT_TYPE,
     to_prometheus_text,
@@ -115,6 +118,12 @@ from eegnetreplication_tpu.utils.logging import logger
 # the same small batch; anything deterministic fails the batch fast.
 SERVE_RETRY = resil_retry.RetryPolicy(max_attempts=3, base_delay_s=0.05,
                                       max_delay_s=1.0)
+
+# POST /profile bounds: the default window when the body names none, and
+# the hard cap — an unbounded jax.profiler window would grow its trace
+# buffers (and the log dir) for as long as the client forgot about it.
+DEFAULT_PROFILE_S = 2.0
+PROFILE_MAX_S = 60.0
 
 # Worker-liveness budgets for /healthz: the batcher worker beats every
 # poll iteration, so even a few seconds of silence while "idle" means the
@@ -316,11 +325,16 @@ class ServeApp:
         self._n_errors = 0
         self._n_expired = 0
         self._n_circuit_open = 0
+        self._n_probes = 0
         self._n_sessions_opened = 0
         self._n_session_windows = 0
         self._n_windows_expired = 0
         self._inflight = 0
         self._idle = threading.Condition(self._stats_lock)
+        # On-demand deep profiling (POST /profile): one bounded window at
+        # a time, run off the hot path on its own thread.
+        self._profile_lock = threading.Lock()
+        self._profiling = False
         self._t_start = time.perf_counter()
 
     @property
@@ -455,6 +469,7 @@ class ServeApp:
                                       if self.zoo is not None else None),
                            zoo_restacks=(self.zoo.restacks
                                          if self.zoo is not None else None),
+                           probes=self._n_probes,
                            precision=self.registry.serving_precision)
         logger.info("Serve drained and stopped: %d requests "
                     "(%d rejected, %d errors, %d expired, %d refused by "
@@ -488,7 +503,24 @@ class ServeApp:
                 self._idle.notify_all()
 
     def record_request(self, n_trials: int, latency_ms: float,
-                       status: str) -> None:
+                       status: str, *, probe: bool = False,
+                       model: str | None = None) -> None:
+        if probe:
+            # Canary accounting is SEGREGATED: an X-Probe request still
+            # journals (probe=True) so the stream stays complete, but it
+            # lands in probe_requests_total, never requests_total or the
+            # request_latency_ms histogram — the SLO monitor, /healthz
+            # tails, and the fleet aggregator must reflect USER traffic,
+            # and a prober aimed at an idle replica would otherwise be
+            # the only signal they see.
+            with self._stats_lock:
+                self._n_probes += 1
+            self.journal.event("request", n_trials=n_trials,
+                               latency_ms=round(latency_ms, 3),
+                               status=status, probe=True)
+            self.journal.metrics.inc("probe_requests_total", status=status)
+            trace.flush_if_anomalous(status, journal=self.journal)
+            return
         with self._stats_lock:
             self._n_requests += 1
             if status == "rejected":
@@ -502,7 +534,8 @@ class ServeApp:
             elif status != "ok":
                 self._n_errors += 1
         self.journal.event("request", n_trials=n_trials,
-                           latency_ms=round(latency_ms, 3), status=status)
+                           latency_ms=round(latency_ms, 3), status=status,
+                           model=model)
         self.journal.metrics.inc("requests_total", status=status)
         if status == "ok":
             self.journal.metrics.observe("request_latency_ms", latency_ms)
@@ -510,6 +543,52 @@ class ServeApp:
         # expired, or was refused by the open circuit flushes its
         # buffered spans — the traces worth debugging always land.
         trace.flush_if_anomalous(status, journal=self.journal)
+
+    # -- on-demand deep profiling (POST /profile) --------------------------
+    def start_profile(self, seconds: float,
+                      log_dir: str | None = None) -> dict | None:
+        """Start one bounded ``jax.profiler`` window on a background
+        thread — the handler replies 202 immediately and serving
+        continues untouched (the profiler observes; it is never in the
+        request path).  Returns the window descriptor, or ``None`` when
+        a window is already running (one at a time: concurrent
+        ``start_trace`` calls are a jax.profiler error, and overlapping
+        windows would blame each other's overhead)."""
+        seconds = min(float(seconds), PROFILE_MAX_S)
+        if seconds <= 0:
+            raise ValueError(f"profile window must be > 0 s, got {seconds}")
+        with self._profile_lock:
+            if self._profiling:
+                return None
+            self._profiling = True
+        base = self.journal.dir if self.journal.dir is not None \
+            else Path(tempfile.gettempdir())
+        target = Path(log_dir) if log_dir else \
+            Path(base) / f"profile_{int(time.time() * 1000.0)}"
+        threading.Thread(target=self._profile_window,
+                         args=(seconds, target),
+                         name="eegtpu-profile", daemon=True).start()
+        return {"seconds": seconds, "log_dir": str(target)}
+
+    def _profile_window(self, seconds: float, log_dir: Path) -> None:
+        from eegnetreplication_tpu.utils import profiling
+
+        t0 = time.perf_counter()
+        status, error = "ok", None
+        try:
+            with profiling.trace(str(log_dir)):
+                time.sleep(seconds)
+        except Exception as exc:  # noqa: BLE001 — profiling is advisory
+            status, error = "error", f"{type(exc).__name__}: {exc}"
+            logger.warning("Profiling window failed: %s", error)
+        finally:
+            with self._profile_lock:
+                self._profiling = False
+        self.journal.event("profile_window",
+                           dur_s=round(time.perf_counter() - t0, 3),
+                           log_dir=str(log_dir), status=status,
+                           requested_s=seconds, error=error)
+        self.journal.metrics.inc("profile_windows", status=status)
 
     # -- streaming sessions (called from handler threads) ------------------
     def decide_windows(self, session, ready) -> list[WindowDecision]:
@@ -799,6 +878,9 @@ class _ServeHandler(JsonRequestHandler):
             if self.path == "/reload":
                 self._reload(app)
                 return
+            if self.path == "/profile":
+                self._profile(app)
+                return
             parts = self.path.strip("/").split("/")
             if parts[0] == "session":
                 if len(parts) == 2 and parts[1] == "open":
@@ -851,6 +933,13 @@ class _ServeHandler(JsonRequestHandler):
 
     def _predict_traced(self, app: ServeApp) -> None:
         t0 = time.perf_counter()
+        # Canary detection up front: an X-Probe request takes the full
+        # real path (breaker, parse, batcher, forward) but its outcome is
+        # accounted separately (record_request probe=) and its queue
+        # residency is exempted from the admission/tuner statistics
+        # (batcher submit exempt=) — the prober measures the service, it
+        # must never steer it.
+        is_probe = self.headers.get(PROBE_HEADER) is not None
         # Circuit gate FIRST: under an open breaker the request must not
         # parse-validate, enqueue, or touch the forward — the whole point
         # is a cheap fast-fail while the failure domain recovers.  allow()
@@ -858,7 +947,7 @@ class _ServeHandler(JsonRequestHandler):
         # the forward never runs.
         if not app.breaker.allow():
             app.record_request(0, (time.perf_counter() - t0) * 1000.0,
-                               "circuit_open")
+                               "circuit_open", probe=is_probe)
             self._reply(503, {
                 "error": "circuit open: serve.forward is failing; "
                          "retry after the cooldown",
@@ -881,7 +970,7 @@ class _ServeHandler(JsonRequestHandler):
                         f"{tuple(x.shape)}")
             except Exception as exc:  # noqa: BLE001 — client error
                 app.record_request(0, (time.perf_counter() - t0) * 1000.0,
-                                   "bad_request")
+                                   "bad_request", probe=is_probe)
                 self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
                 return
             # Model addressing: the X-Model header wins, else the JSON
@@ -899,14 +988,14 @@ class _ServeHandler(JsonRequestHandler):
                 except KeyError as exc:
                     app.record_request(
                         len(x), (time.perf_counter() - t0) * 1000.0,
-                        "bad_model")
+                        "bad_model", probe=is_probe)
                     self._reply(404, {"error": str(exc.args[0]),
                                       "tenants": app.zoo.tenant_ids})
                     return
             elif model_spec not in (None, "", "default"):
                 app.record_request(
                     len(x), (time.perf_counter() - t0) * 1000.0,
-                    "bad_model")
+                    "bad_model", probe=is_probe)
                 self._reply(404, {
                     "error": f"model {model_spec!r} requested but no "
                              "model zoo is configured (single-model "
@@ -921,7 +1010,8 @@ class _ServeHandler(JsonRequestHandler):
                 in ("high", "control", "session")
             try:
                 fut = app.batcher.submit(x, deadline=deadline,
-                                         priority=priority, tenant=tenant)
+                                         priority=priority, tenant=tenant,
+                                         exempt=is_probe)
                 # Once enqueued, probe reconciliation moves to the
                 # future's own resolution (not this handler): if the
                 # request is shed before any forward runs — expired at
@@ -938,7 +1028,7 @@ class _ServeHandler(JsonRequestHandler):
                 # Dropped at dequeue, before any forward ran.
                 app.record_request(len(x),
                                    (time.perf_counter() - t0) * 1000.0,
-                                   "expired")
+                                   "expired", probe=is_probe)
                 self._reply(504, {"error": str(exc),
                                   "deadline_ms": deadline_ms})
                 return
@@ -948,19 +1038,19 @@ class _ServeHandler(JsonRequestHandler):
                 # telemetry status (a policy decision, not a full queue).
                 app.record_request(len(x),
                                    (time.perf_counter() - t0) * 1000.0,
-                                   "shed")
+                                   "shed", probe=is_probe)
                 self._reply(429, {"error": str(exc), "shed": True})
                 return
             except Rejected as exc:
                 app.record_request(len(x),
                                    (time.perf_counter() - t0) * 1000.0,
-                                   "rejected")
+                                   "rejected", probe=is_probe)
                 self._reply(429, {"error": str(exc)})
                 return
             except Exception as exc:  # noqa: BLE001 — inference/timeout
                 app.record_request(len(x),
                                    (time.perf_counter() - t0) * 1000.0,
-                                   "error")
+                                   "error", probe=is_probe)
                 self._reply(500, {"error": f"{type(exc).__name__}: {exc}"})
                 return
         finally:
@@ -971,13 +1061,15 @@ class _ServeHandler(JsonRequestHandler):
             # The forward ran but the answer arrived past the caller's
             # budget: an expired response is a failure from the client's
             # point of view, and saying so keeps the SLO accounting honest.
-            app.record_request(len(x), latency_ms, "expired")
+            app.record_request(len(x), latency_ms, "expired",
+                               probe=is_probe)
             self._reply(504, {"error": "response ready after the request "
                                        "deadline expired",
                               "deadline_ms": deadline_ms,
                               "latency_ms": round(latency_ms, 3)})
             return
-        app.record_request(len(x), latency_ms, "ok")
+        app.record_request(len(x), latency_ms, "ok", probe=is_probe,
+                           model=model_id)
         reply = {
             "predictions": [int(p) for p in preds],
             "class_names": list(CLASS_NAMES), "n": len(x),
@@ -1000,6 +1092,32 @@ class _ServeHandler(JsonRequestHandler):
         exc = fut.exception()
         if isinstance(exc, (DeadlineExceeded, Rejected)):
             self.app.breaker.cancel_probe()
+
+    def _profile(self, app: ServeApp) -> None:
+        """On-demand deep profiling: start one bounded jax.profiler
+        window off the hot path.  202 with the window descriptor, 409
+        when one is already running."""
+        try:
+            payload = json.loads(self._read_body().decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            seconds = float(payload.get("seconds", DEFAULT_PROFILE_S))
+            if not math.isfinite(seconds) or seconds <= 0:
+                raise ValueError(
+                    f"seconds must be a finite number > 0, got {seconds}")
+            log_dir = payload.get("log_dir")
+            if log_dir is not None and not isinstance(log_dir, str):
+                raise ValueError("log_dir must be a string path")
+        except Exception as exc:  # noqa: BLE001 — client error
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        started = app.start_profile(seconds, log_dir=log_dir)
+        if started is None:
+            self._reply(409, {"error": "a profile window is already "
+                                       "running; retry after it closes"})
+            return
+        self._reply(202, {"status": "started",
+                          "max_s": PROFILE_MAX_S, **started})
 
     def _reload(self, app: ServeApp) -> None:
         try:
@@ -1366,6 +1484,20 @@ def main(argv=None) -> int:
                         help="Snapshot session state every N decided "
                              "windows (plus at every close and at the "
                              "SIGTERM drain).")
+    parser.add_argument("--probeIntervalS", type=float, default=0.0,
+                        help="Black-box self-probing interval in seconds "
+                             "(0 = off): POST a known-answer canary to "
+                             "this server's own /predict on a jittered "
+                             "cadence, journal probe events, and evaluate "
+                             "the outside-in --probeSlo.  Probes carry "
+                             "X-Probe and stay out of the admission/"
+                             "tuner statistics and the server-side SLO.")
+    parser.add_argument("--probeSlo", type=str,
+                        default=obs_probe.DEFAULT_PROBE_SLO,
+                        help="SLO spec evaluated over the prober's own "
+                             "sliding window of client-vantage outcomes "
+                             "(availability / error_rate / pNN_latency_"
+                             "ms).")
     parser.add_argument("--resume", action="store_true",
                         help="Restore streaming sessions from the newest "
                              "valid snapshot generation in --sessionsDir "
@@ -1410,6 +1542,12 @@ def main(argv=None) -> int:
         except ValueError as exc:
             parser.error(f"--slo: {exc}")
 
+    if args.probeSlo:
+        try:
+            obs_slo.parse_slo_spec(args.probeSlo)
+        except ValueError as exc:
+            parser.error(f"--probeSlo: {exc}")
+
     chaos_specs = []
     if args.chaos:
         try:
@@ -1448,7 +1586,21 @@ def main(argv=None) -> int:
                        stack=not args.noStack)
         app.start()
         print(f"serving at {app.url}", flush=True)
-        serve_until_preempted(app)
+        # Self-probing: an outside-in canary loop against this server's
+        # own front door, journaling into the same run — gray failures
+        # (slow-but-alive, wrong answers) surface as probe events and
+        # probe: SLO breaches even when every internal signal looks
+        # healthy.
+        prober = None
+        if args.probeIntervalS > 0:
+            prober = obs_probe.Prober(
+                app.url, interval_s=args.probeIntervalS,
+                slo=args.probeSlo or None, journal=journal).start()
+        try:
+            serve_until_preempted(app)
+        finally:
+            if prober is not None:
+                prober.stop()
     # A preempted (SIGTERM-drained) server exits EX_PREEMPTED, the same
     # single-sourced code as a preempted training run: schedulers and the
     # supervisor read it as "relaunch me", while a clean 0 means the

@@ -83,11 +83,24 @@ def _armed_dispatch(jitted, site: str = "train.step"):
         # jit compiles synchronously inside this call (execution stays
         # async), so the wall around it is trace+compile time.
         out = jitted(pool_x, pool_y, specs, carry_or_states, keys)
+        # HLO cost attribution for the observability plane: lowering
+        # re-traces without compiling, and the cost model prices the
+        # whole stacked trainer program.  Best-effort — some wrappers
+        # (shard_map shells, non-jit callables) do not expose lower().
+        flops, bytes_accessed = None, None
+        try:
+            from eegnetreplication_tpu.utils.flops import cost_flops_bytes
+
+            flops, bytes_accessed = cost_flops_bytes(
+                jitted.lower(pool_x, pool_y, specs, carry_or_states, keys))
+        except Exception:  # noqa: BLE001 — accounting only
+            pass
         obs_journal.current().event(
             "compile", what=f"{site}_dispatch",
             cache_hit=compile_cache_hit(cache_dir, probe),
             cache_dir=cache_dir,
-            elapsed_s=round(time.perf_counter() - t0, 3))
+            elapsed_s=round(time.perf_counter() - t0, 3),
+            flops=flops, bytes_accessed=bytes_accessed)
         return out
 
     return dispatch

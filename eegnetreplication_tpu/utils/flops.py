@@ -25,6 +25,7 @@ import math
 import os
 
 __all__ = [
+    "cost_flops_bytes",
     "train_step_flops",
     "eval_step_flops",
     "fold_epoch_flops",
@@ -48,6 +49,34 @@ def _cost_flops(lowered) -> float | None:
         return float(flops)
     except Exception:  # noqa: BLE001 — accounting is best-effort
         return None
+
+
+def cost_flops_bytes(lowered) -> tuple[float | None, float | None]:
+    """``(flops, bytes_accessed)`` from a ``Lowered``'s HLO cost model,
+    each ``None`` when the backend does not report it.
+
+    The compile-event attribution helper: the engine warmup and the
+    training dispatcher attach these to their ``compile`` journal events
+    so the observability plane can rank programs by cost without
+    re-lowering anything.  Best-effort by contract — cost analysis is a
+    backend courtesy, never worth failing a compile over.
+    """
+    try:
+        analysis = lowered.cost_analysis()
+        if isinstance(analysis, (list, tuple)):
+            analysis = analysis[0] if analysis else None
+        if not analysis:
+            return None, None
+
+        def pick(key):
+            value = analysis.get(key)
+            if value is None or not value > 0:  # also rejects NaN
+                return None
+            return float(value)
+
+        return pick("flops"), pick("bytes accessed")
+    except Exception:  # noqa: BLE001 — accounting is best-effort
+        return None, None
 
 
 def _state_avals(model, tx, sample_shape):

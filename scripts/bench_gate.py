@@ -1,0 +1,282 @@
+#!/usr/bin/env python
+"""Bench regression sentinel: diff fresh BENCH_*.json against committed.
+
+The committed BENCH artifacts are the repo's perf trajectory; nothing has
+compared a fresh measurement against them, so a regression can rot the
+numbers silently until someone re-reads them.  This gate diffs a freshly
+measured artifact against its committed counterpart with PER-FIELD
+tolerance specs (throughput fields must not drop too far, latency fields
+must not inflate too far, declared floors must hold absolutely) and
+fails loudly on any violation.
+
+Noise discipline (the BENCH_QUANT precedent): micro-benchmarks on shared
+hosts are noisy, so ONE re-measure is allowed — when ``--remeasure CMD``
+is given and the first diff fails, the command is run once to regenerate
+the fresh artifact(s) and the diff repeats; the verdict comes from the
+second measurement.  Two consecutive out-of-tolerance measurements are a
+regression, not noise.
+
+Platform honesty: committed artifacts record the platform they were
+measured on; a fresh artifact from a DIFFERENT platform (chip vs cpu)
+is not comparable and the pair is skipped with a note instead of
+producing a meaningless verdict.
+
+Usage:
+    python scripts/bench_gate.py --pair BENCH_SERVE.json=/tmp/BENCH_SERVE.json
+    python scripts/bench_gate.py --pair a.json=b.json --remeasure "make bench"
+    python scripts/bench_gate.py --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shlex
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# Field-name heuristics: which numeric leaves are perf-meaningful and
+# which direction is "better".  Matched against the LAST path component.
+_HIGHER = ("rps", "per_s", "throughput", "agreement", "ratio",
+           "completed", "fold_epochs")
+_LOWER = ("p50_ms", "p95_ms", "p99_ms", "latency_ms", "wall_s",
+          "warmup_s", "stall_ms", "blocked_ms")
+
+# Default relative tolerances.  Deliberately loose: the gate exists to
+# catch REGRESSIONS (2x slowdowns, collapsed throughput), not to flake
+# on scheduler jitter — tighten per-artifact below where the measurement
+# is stable.
+DEFAULT_TOL = {"higher": 0.30, "lower": 0.60}
+
+# Per-artifact overrides: basename -> list of (dotted path, kind, value).
+#   kind "higher": fresh >= committed * (1 - value)
+#   kind "lower":  fresh <= committed * (1 + value)
+#   kind "floor":  fresh >= value  (absolute, committed unused)
+SPECS: dict[str, list[tuple[str, str, float]]] = {
+    # The observability bench's own floor: aggregation+probing must keep
+    # >= 0.95x of the unobserved throughput (ISSUE 16 acceptance).
+    "BENCH_OBS.json": [
+        ("overhead.ratio", "floor", 0.95),
+        ("overhead.with_obs.rps", "higher", 0.30),
+    ],
+}
+
+
+def _leaves(obj, prefix: str = "") -> dict[str, float]:
+    """Flatten numeric leaves to {dotted.path: value} (bools excluded)."""
+    out: dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_leaves(v, f"{prefix}{k}." if prefix or True
+                               else k))
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def _direction(path: str) -> str | None:
+    leaf = path.rsplit(".", 1)[-1]
+    for needle in _HIGHER:
+        if needle in leaf:
+            return "higher"
+    for needle in _LOWER:
+        if needle in leaf:
+            return "lower"
+    return None
+
+
+def compare(committed: dict, fresh: dict,
+            specs: list[tuple[str, str, float]] | None = None) -> dict:
+    """Diff one artifact pair; returns {violations, checked, skipped}."""
+    c_platform = committed.get("platform")
+    f_platform = fresh.get("platform")
+    if c_platform and f_platform and c_platform != f_platform:
+        return {"violations": [], "checked": 0,
+                "skipped": f"platform mismatch (committed={c_platform}, "
+                           f"fresh={f_platform})"}
+    c_leaves, f_leaves = _leaves(committed), _leaves(fresh)
+    violations: list[str] = []
+    checked = 0
+    explicit = {path for path, _, _ in (specs or [])}
+    for path, kind, value in specs or []:
+        got = f_leaves.get(path)
+        if got is None:
+            violations.append(f"{path}: missing from fresh artifact "
+                              f"(spec {kind}:{value:g})")
+            continue
+        checked += 1
+        if kind == "floor":
+            if got < value:
+                violations.append(
+                    f"{path}: {got:g} below absolute floor {value:g}")
+            continue
+        ref = c_leaves.get(path)
+        if ref is None:
+            continue  # new field: nothing committed to regress from
+        violations.extend(_rel_check(path, kind, value, ref, got))
+    # Heuristic pass over every shared numeric leaf not already pinned.
+    for path, ref in sorted(c_leaves.items()):
+        if path in explicit:
+            continue
+        direction = _direction(path)
+        got = f_leaves.get(path)
+        if direction is None or got is None:
+            continue
+        checked += 1
+        violations.extend(
+            _rel_check(path, direction, DEFAULT_TOL[direction], ref, got))
+    return {"violations": violations, "checked": checked, "skipped": None}
+
+
+def _rel_check(path: str, kind: str, tol: float,
+               ref: float, got: float) -> list[str]:
+    if ref <= 0:
+        return []  # zero/negative references carry no direction
+    if kind == "higher" and got < ref * (1.0 - tol):
+        return [f"{path}: {got:g} is a {(1 - got / ref) * 100:.0f}% drop "
+                f"from committed {ref:g} (tolerance {tol * 100:.0f}%)"]
+    if kind == "lower" and got > ref * (1.0 + tol):
+        return [f"{path}: {got:g} is a {(got / ref - 1) * 100:.0f}% "
+                f"inflation over committed {ref:g} "
+                f"(tolerance {tol * 100:.0f}%)"]
+    return []
+
+
+def gate(pairs: list[tuple[Path, Path]],
+         remeasure: str | None = None) -> dict:
+    """Diff every pair; on failure re-measure ONCE (if a command was
+    given) and let the second measurement decide."""
+    def run_all() -> dict:
+        results = {}
+        for committed_path, fresh_path in pairs:
+            name = committed_path.name
+            try:
+                committed = json.loads(committed_path.read_text())
+                fresh = json.loads(fresh_path.read_text())
+            except (OSError, ValueError) as exc:
+                results[name] = {"violations":
+                                 [f"unreadable: {exc}"],
+                                 "checked": 0, "skipped": None}
+                continue
+            results[name] = compare(committed, fresh, SPECS.get(name))
+        return results
+
+    results = run_all()
+    failed = any(r["violations"] for r in results.values())
+    remeasured = False
+    if failed and remeasure:
+        print(f"bench_gate: out of tolerance, re-measuring once: "
+              f"{remeasure}", flush=True)
+        subprocess.run(shlex.split(remeasure), check=False, cwd=REPO)
+        results = run_all()
+        failed = any(r["violations"] for r in results.values())
+        remeasured = True
+    return {"ok": not failed, "remeasured": remeasured,
+            "artifacts": results}
+
+
+def selftest() -> int:
+    """The gate must catch an injected regression and pass a clean diff
+    (with the one-re-measure path exercised end to end)."""
+    with tempfile.TemporaryDirectory(prefix="bench_gate_") as td:
+        root = Path(td)
+        committed = {"platform": "cpu",
+                     "sequential": {"rps": 1000.0, "p95_ms": 5.0},
+                     "overhead": {"ratio": 0.99,
+                                  "with_obs": {"rps": 900.0}}}
+        (root / "BENCH_OBS.json").write_text(json.dumps(committed))
+        fresh = root / "fresh" / "BENCH_OBS.json"
+        fresh.parent.mkdir()
+
+        # Leg 1: identical artifact -> clean pass.
+        fresh.write_text(json.dumps(committed))
+        verdict = gate([(root / "BENCH_OBS.json", fresh)])
+        assert verdict["ok"], f"clean diff failed: {verdict}"
+
+        # Leg 2: injected regressions -> every kind must trip.
+        bad = json.loads(json.dumps(committed))
+        bad["sequential"]["rps"] = 500.0       # heuristic "higher" drop
+        bad["sequential"]["p95_ms"] = 50.0     # heuristic "lower" inflation
+        bad["overhead"]["ratio"] = 0.80        # explicit absolute floor
+        fresh.write_text(json.dumps(bad))
+        verdict = gate([(root / "BENCH_OBS.json", fresh)])
+        assert not verdict["ok"], "injected regression passed the gate"
+        flat = "\n".join(
+            v for r in verdict["artifacts"].values()
+            for v in r["violations"])
+        assert "sequential.rps" in flat, flat
+        assert "sequential.p95_ms" in flat, flat
+        assert "overhead.ratio" in flat, flat
+
+        # Leg 3: the one-noise-re-measure — the re-measure command
+        # restores a good artifact, so the second diff passes.
+        good = root / "good.json"
+        good.write_text(json.dumps(committed))
+        cmd = (f'{sys.executable} -c "import shutil; '
+               f"shutil.copy({str(good)!r}, {str(fresh)!r})\"")
+        verdict = gate([(root / "BENCH_OBS.json", fresh)], remeasure=cmd)
+        assert verdict["ok"] and verdict["remeasured"], \
+            f"re-measure path failed: {verdict}"
+
+        # Leg 4: platform mismatch is a skip, never a verdict.
+        other = json.loads(json.dumps(bad))
+        other["platform"] = "tpu"
+        fresh.write_text(json.dumps(other))
+        verdict = gate([(root / "BENCH_OBS.json", fresh)])
+        assert verdict["ok"], f"platform mismatch judged: {verdict}"
+        assert verdict["artifacts"]["BENCH_OBS.json"]["skipped"]
+    print("bench_gate selftest: all legs passed")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff fresh BENCH_*.json artifacts against the "
+                    "committed perf trajectory.")
+    ap.add_argument("--pair", action="append", default=[],
+                    metavar="COMMITTED=FRESH",
+                    help="one committed=fresh artifact pair "
+                         "(repeatable); the committed basename selects "
+                         "the tolerance spec")
+    ap.add_argument("--remeasure", default=None,
+                    help="command run ONCE to regenerate the fresh "
+                         "artifact(s) when the first diff fails — the "
+                         "second measurement decides")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the verdict to this path")
+    ap.add_argument("--selftest", action="store_true",
+                    help="injected regression must fail, clean diff and "
+                         "re-measure recovery must pass")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.pair:
+        ap.error("at least one --pair COMMITTED=FRESH is required")
+    pairs = []
+    for spec in args.pair:
+        committed, sep, fresh = spec.partition("=")
+        if not sep:
+            ap.error(f"--pair must be COMMITTED=FRESH, got {spec!r}")
+        pairs.append((Path(committed), Path(fresh)))
+    verdict = gate(pairs, remeasure=args.remeasure)
+    if args.json_out:
+        Path(args.json_out).write_text(json.dumps(verdict, indent=1))
+    for name, result in verdict["artifacts"].items():
+        if result["skipped"]:
+            print(f"{name}: SKIPPED ({result['skipped']})")
+        elif result["violations"]:
+            print(f"{name}: FAIL ({result['checked']} fields checked)")
+            for v in result["violations"]:
+                print(f"  {v}")
+        else:
+            print(f"{name}: ok ({result['checked']} fields checked)")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -25,27 +25,13 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 from eegnetreplication_tpu.obs import schema  # noqa: E402
+from eegnetreplication_tpu.obs.agg import discover_runs  # noqa: E402,F401
 
-
-def discover_runs(paths: list[str]) -> list[Path]:
-    """Resolve CLI args into run directories (dirs holding events.jsonl).
-
-    An argument that is itself a run dir is taken as-is; otherwise it is
-    treated as a metricsDir root and scanned two levels deep — a fleet
-    run's working root nests each replica's journals one level further
-    (``<root>/replica_obs/<run_id>/``), and those incomplete, possibly
-    SIGKILL-truncated member journals must render as rows too.
-    """
-    runs = []
-    for arg in paths:
-        p = Path(arg)
-        if (p / "events.jsonl").exists():
-            runs.append(p)
-        elif p.is_dir():
-            found = {f.parent for f in p.glob("*/events.jsonl")}
-            found.update(f.parent for f in p.glob("*/*/events.jsonl"))
-            runs.extend(sorted(found))
-    return runs
+# discover_runs is shared with the live aggregator (obs/agg.py): a cells
+# topology nests member journals THREE levels down
+# (<root>/<front_run>/c0_obs/<cell_run>/replica_obs/<replica_run>), which
+# this script's old fixed-depth two-level scan silently missed — the
+# recursive walk renders every member journal as a row, at any depth.
 
 
 def summarize_run(run_dir: Path) -> dict:

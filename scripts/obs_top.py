@@ -1,0 +1,23 @@
+#!/usr/bin/env python
+"""Thin launcher for the eegtpu-top ops console (obs/top.py).
+
+The console lives in the package so the ``eegtpu-top`` entry point can
+import it; this shim keeps the scripts/ invocation working in a checkout
+without an installed package:
+
+    python scripts/obs_top.py reports/obs            # live refresh
+    python scripts/obs_top.py --json reports/obs     # one JSON snapshot
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from eegnetreplication_tpu.obs.top import main  # noqa: E402
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -225,6 +225,20 @@ def main(argv=None) -> int:
          str(fleet_dir), "--require-cross-process",
          "--chrome", str(root / "fleet_trace.chrome.json")],
         root, record, platform="cpu")
+    # Bench regression sentinel: the fresh BENCH artifacts this rehearsal
+    # just measured must sit within tolerance of the committed perf
+    # trajectory (same-platform pairs only — cross-platform pairs skip).
+    # A failing gate means the rehearsal measured a real regression, not
+    # that it failed to run.
+    ok = ok and run_stage(
+        "bench-gate",
+        [py, str(REPO / "scripts" / "bench_gate.py"),
+         "--pair",
+         f"{REPO / 'BENCH_STREAM.json'}={root / 'BENCH_STREAM.json'}",
+         "--pair",
+         f"{REPO / 'BENCH_FLEET.json'}={root / 'BENCH_FLEET.json'}",
+         "--json", str(root / "bench_gate.json")],
+        root, record, platform="cpu", timeout=600.0)
     if ok:
         viz_src = (
             "import sys; sys.path.insert(0, {repo!r})\n"

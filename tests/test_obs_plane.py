@@ -1,0 +1,769 @@
+"""Fleet observability plane (ISSUE 16): journal rotation, the
+incremental multi-journal aggregator, the eegtpu-top ops console, the
+black-box prober, the POST /profile window, and the bench regression
+sentinel.
+
+The acceptance pin lives in :class:`TestOpsConsoleIntegration`: an
+``eegtpu-top --json`` snapshot over a LIVE 3-replica fleet (real
+ServeApps + real membership, each journaling its own run dir) plus a
+cells-shaped three-level journal nest must agree with what ``/healthz``
+and ``/metrics`` report from inside each replica.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from contextlib import ExitStack
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.obs import journal as obs_journal  # noqa: E402
+from eegnetreplication_tpu.obs import schema as obs_schema  # noqa: E402
+from eegnetreplication_tpu.obs.agg import (  # noqa: E402
+    Aggregator,
+    FleetState,
+    JournalTailer,
+    discover_runs,
+)
+from eegnetreplication_tpu.obs.probe import PROBE_HEADER, Prober  # noqa: E402
+from eegnetreplication_tpu.obs import top as obs_top  # noqa: E402
+from eegnetreplication_tpu.training.checkpoint import (  # noqa: E402
+    save_checkpoint,
+)
+from eegnetreplication_tpu.utils.flops import cost_flops_bytes  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+C, T = 4, 64
+
+
+def _checkpoint(tmp_path: Path, seed: int = 0, name: str = "m.npz") -> Path:
+    model = EEGNet(n_channels=C, n_times=T)
+    variables = model.init(jax.random.PRNGKey(seed), jnp.zeros((1, C, T)),
+                           train=False)
+    return save_checkpoint(
+        tmp_path / name, variables["params"], variables["batch_stats"],
+        metadata={"model": "eegnet", "n_channels": C, "n_times": T,
+                  "F1": model.F1, "D": model.D})
+
+
+def _post_json(url: str, payload: dict, headers: dict | None = None,
+               timeout: float = 30.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(), method="POST",
+        headers={"Content-Type": "application/json", **(headers or {})})
+    resp = urllib.request.urlopen(req, timeout=timeout)
+    return resp.status, json.loads(resp.read())
+
+
+def _get_json(url: str, timeout: float = 10.0) -> dict:
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def _probe_journal(tmp_path: Path, n: int, **journal_kw) -> obs_journal.RunJournal:
+    """A journal with ``n`` sequence-stamped probe events (a declared
+    type whose extra ``seq`` field survives round-trips)."""
+    jr = obs_journal.RunJournal(tmp_path, **journal_kw)
+    for i in range(n):
+        jr.event("probe", status="ok", latency_ms=float(i), url="u", seq=i)
+    return jr
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: size-triggered journal rotation.
+# ---------------------------------------------------------------------------
+
+class TestJournalRotation:
+    def test_rollover_seals_segments_and_enforces_keep(self, tmp_path):
+        jr = _probe_journal(tmp_path, 60, rotate_bytes=600, rotate_keep=3)
+        live = jr.events_path
+        # The 60th write may itself have sealed the live file; one more
+        # event always lands in a (possibly fresh) live segment.
+        jr.event("probe", status="ok", latency_ms=0.0, url="u", seq=60)
+        assert live.exists()
+        assert Path(f"{live}.1").exists()
+        assert Path(f"{live}.3").exists()
+        # keep-N: the oldest segment beyond the cap was unlinked.
+        assert not Path(f"{live}.4").exists()
+        # Every sealed segment ends at a line boundary.
+        for seg in obs_schema.rotated_segments(live):
+            assert seg.read_bytes().endswith(b"\n")
+
+    def test_read_events_stitches_oldest_first(self, tmp_path):
+        jr = _probe_journal(tmp_path, 60, rotate_bytes=600, rotate_keep=4)
+        segments = obs_schema.rotated_segments(jr.events_path)
+        # Oldest first means highest suffix first.
+        suffixes = [int(s.name.rsplit(".", 1)[-1]) for s in segments]
+        assert suffixes == sorted(suffixes, reverse=True)
+        events = obs_schema.read_events(jr.events_path, complete=False)
+        seqs = [e["seq"] for e in events if e["event"] == "probe"]
+        # The stitched stream is the original order with only the OLDEST
+        # prefix rotated away — contiguous and ending at the last write.
+        assert seqs == list(range(seqs[0], 60))
+        assert seqs[-1] == 59 and seqs[0] > 0
+
+    def test_nonpositive_rotate_bytes_disables_rotation(self, tmp_path):
+        jr = _probe_journal(tmp_path, 60, rotate_bytes=0)
+        assert obs_schema.rotated_segments(jr.events_path) == []
+        events = obs_schema.read_events(jr.events_path, complete=False)
+        assert sum(1 for e in events if e["event"] == "probe") == 60
+
+    def test_env_override_configures_rotation(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("EEGTPU_JOURNAL_ROTATE_BYTES", "600")
+        monkeypatch.setenv("EEGTPU_JOURNAL_ROTATE_KEEP", "2")
+        jr = _probe_journal(tmp_path, 60)
+        assert Path(f"{jr.events_path}.1").exists()
+        assert Path(f"{jr.events_path}.2").exists()
+        assert not Path(f"{jr.events_path}.3").exists()
+
+    def test_persistent_handle_keeps_writing_after_rollover(self, tmp_path):
+        """The persistent append handle must follow the rename: events
+        after a rollover land in the FRESH live file, not the sealed
+        segment the old file descriptor still points at."""
+        jr = _probe_journal(tmp_path, 40, rotate_bytes=600, rotate_keep=8)
+        jr.event("probe", status="ok", latency_ms=0.0, url="u", seq=999)
+        tail = jr.events_path.read_text().strip().splitlines()[-1]
+        assert json.loads(tail)["seq"] == 999
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 1: the incremental journal tailer + aggregator.
+# ---------------------------------------------------------------------------
+
+class TestJournalTailer:
+    def _run_dir(self, tmp_path, lines):
+        d = tmp_path / "run"
+        d.mkdir(exist_ok=True)
+        (d / "events.jsonl").write_text("".join(lines))
+        return d
+
+    def test_torn_live_tail_held_back_then_completed(self, tmp_path):
+        whole = json.dumps({"event": "probe", "t": 1.0, "seq": 0}) + "\n"
+        torn = json.dumps({"event": "probe", "t": 2.0, "seq": 1})
+        d = self._run_dir(tmp_path, [whole, torn[:10]])
+        tailer = JournalTailer(d)
+        events = tailer.poll()
+        assert [e["seq"] for e in events] == [0]
+        assert tailer.dropped == 0
+        # The cursor held at the line boundary; re-polling the still-torn
+        # tail yields nothing and loses nothing.
+        assert tailer.poll() == []
+        with open(d / "events.jsonl", "a") as fh:
+            fh.write(torn[10:] + "\n")
+        assert [e["seq"] for e in tailer.poll()] == [1]
+
+    def test_rotation_drain_reads_sealed_segment(self, tmp_path):
+        line = [json.dumps({"event": "probe", "t": float(i), "seq": i})
+                + "\n" for i in range(4)]
+        d = self._run_dir(tmp_path, line[:2])
+        tailer = JournalTailer(d)
+        assert [e["seq"] for e in tailer.poll()] == [0, 1]
+        # Rotate under the tailer: unread bytes move to the sealed .1 and
+        # the live file restarts SMALLER than the cursor — the tailer's
+        # rotation signal.
+        (d / "events.jsonl").write_text(line[0] + line[1] + line[2])
+        os.replace(d / "events.jsonl", d / "events.jsonl.1")
+        (d / "events.jsonl").write_text(line[3])
+        assert [e["seq"] for e in tailer.poll()] == [2, 3]
+        assert tailer.dropped == 0
+
+    def test_sealed_torn_tail_is_counted_dropped(self, tmp_path):
+        line = json.dumps({"event": "probe", "t": 0.0, "seq": 0}) + "\n"
+        d = self._run_dir(tmp_path, [line])
+        tailer = JournalTailer(d)
+        tailer.poll()
+        # The sealed segment ends torn (crash mid-rotation): that tail
+        # can never complete — it must be counted, not re-polled forever.
+        (d / "events.jsonl.1").write_text(line + '{"event": "pro')
+        (d / "events.jsonl").write_text("")
+        assert tailer.poll() == []
+        assert tailer.dropped == 1
+
+    def test_unparseable_complete_line_skipped_and_counted(self, tmp_path):
+        good = json.dumps({"event": "probe", "t": 0.0, "seq": 0}) + "\n"
+        d = self._run_dir(tmp_path, [good, "not json\n", good])
+        tailer = JournalTailer(d)
+        assert len(tailer.poll()) == 2
+        assert tailer.dropped == 1
+
+
+class TestAggregator:
+    def _write_run(self, run_dir: Path, events: list[dict]) -> None:
+        run_dir.mkdir(parents=True, exist_ok=True)
+        with open(run_dir / "events.jsonl", "w") as fh:
+            for ev in events:
+                fh.write(json.dumps(ev) + "\n")
+
+    def test_discover_runs_at_any_depth(self, tmp_path):
+        ev = [{"event": "run_start", "t": 1.0}]
+        self._write_run(tmp_path / "a" / "run1", ev)
+        self._write_run(tmp_path / "a" / "run1" / "replica_obs" / "r1", ev)
+        # The cells shape: THREE levels below the root.
+        deep = (tmp_path / "a" / "front" / "c0_obs" / "cell"
+                / "replica_obs" / "rep")
+        self._write_run(deep, ev)
+        # A fully rotated run (live file gone, only sealed segments).
+        rotated = tmp_path / "a" / "old"
+        rotated.mkdir()
+        (rotated / "events.jsonl.1").write_text(json.dumps(ev[0]) + "\n")
+        runs = discover_runs([tmp_path / "a"])
+        assert {r.name for r in runs} == {"run1", "r1", "rep", "old"}
+        # Deterministic: a repeat discovery yields the same order.
+        assert runs == discover_runs([tmp_path / "a"])
+
+    def test_cursor_resume_skips_history(self, tmp_path):
+        run = tmp_path / "root" / "run1"
+        now = time.time()
+        self._write_run(run, [{"event": "request", "t": now, "status": "ok",
+                               "latency_ms": 1.0} for _ in range(5)])
+        first = Aggregator([tmp_path / "root"])
+        snap = first.poll()
+        assert snap["runs"][0]["n_events"] == 5
+        cursors = first.cursors()
+        assert cursors[str(run)] > 0
+        with open(run / "events.jsonl", "a") as fh:
+            fh.write(json.dumps({"event": "request", "t": now,
+                                 "status": "ok", "latency_ms": 2.0}) + "\n")
+        # A RESTARTED aggregator seeded with the old cursors folds only
+        # the new tail — history is not replayed into fresh windows.
+        resumed = Aggregator([tmp_path / "root"])
+        resumed.seed_cursors(cursors)
+        snap = resumed.poll()
+        assert snap["runs"][0]["n_events"] == 1
+        assert snap["runs"][0]["total_requests"] == 1
+
+    def test_poll_journals_agg_snapshot(self, tmp_path):
+        self._write_run(tmp_path / "root" / "run1",
+                        [{"event": "fleet_member", "t": time.time(),
+                          "replica": "r0", "state": "live"}])
+        with obs_journal.run(tmp_path / "own_obs", config={}) as jr:
+            agg = Aggregator([tmp_path / "root"], journal=jr)
+            snap = agg.poll()
+        assert snap["n_runs"] == 1 and snap["n_members"] == 1
+        events = obs_schema.read_events(jr.events_path)
+        snaps = [e for e in events if e["event"] == "agg_snapshot"]
+        assert snaps and snaps[0]["n_runs"] == 1
+        assert snaps[0]["n_members"] == 1
+        assert snaps[0]["window_s"] == agg.window_s
+
+
+class TestFleetStateFold:
+    def test_rolling_fold_rates_quantiles_members(self, tmp_path):
+        state = FleetState(window_s=60.0, clock=lambda: 100.0)
+        reqs = [{"event": "request", "t": 90.0 + i, "status": "ok",
+                 "latency_ms": float(i + 1), "model": "m0"}
+                for i in range(10)]
+        state.fold("runA", [
+            {"event": "run_start", "t": 90.0, "run_id": "ra",
+             "platform": "cpu"},
+            {"event": "serve_start", "t": 90.0},
+            *reqs,
+            {"event": "request", "t": 99.0, "status": "error",
+             "latency_ms": 3.0},
+            {"event": "fleet_member", "t": 99.0, "replica": "r0",
+             "state": "live"},
+            {"event": "probe", "t": 99.0, "status": "ok",
+             "latency_ms": 2.0, "url": "u"},
+            {"event": "probe", "t": 99.5, "status": "timeout",
+             "latency_ms": 500.0, "url": "u"},
+        ])
+        state.fold("runB", [
+            {"event": "slo_breach", "t": 99.0, "objective": "probe:avail"},
+            {"event": "request", "t": 30.0, "status": "ok",
+             "latency_ms": 1.0},  # older than the 60 s window: pruned
+        ])
+        snap = state.snapshot()
+        assert snap["n_runs"] == 2 and snap["n_members"] == 1
+        assert snap["members"]["r0"]["state"] == "live"
+        assert snap["slo_breached"] == ["probe:avail"]
+        run_a = next(r for r in snap["runs"] if r["dir"] == "runA")
+        assert run_a["role"] == "serve" and run_a["run_id"] == "ra"
+        assert run_a["total_requests"] == 11
+        assert run_a["window_requests"] == 11
+        # 11 requests over the 10 s between the first in-window request
+        # and the frozen clock.
+        assert run_a["rps"] == pytest.approx(1.1)
+        assert run_a["p50_ms"] == pytest.approx(5.5, abs=1.0)
+        assert run_a["window_non_ok"] == 1
+        assert run_a["tenants"] == {"m0": 10}
+        assert run_a["probes"] == {"window": 2, "failures": 1,
+                                   "p95_ms": 2.0}
+        run_b = next(r for r in snap["runs"] if r["dir"] == "runB")
+        assert run_b["window_requests"] == 0  # pruned
+        assert run_b["total_requests"] == 1   # lifetime count survives
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 3: the black-box prober (stub front door for determinism).
+# ---------------------------------------------------------------------------
+
+class _StubFront:
+    """A minimal /healthz + /predict front door with scriptable answers."""
+
+    def __init__(self):
+        self.preds = [2]
+        self.fail_code = None
+        self.probe_headers_seen = []
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # noqa: A003 — quiet
+                pass
+
+            def _reply(self, payload, code=200):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):  # noqa: N802
+                stub.probe_headers_seen.append(
+                    self.headers.get(PROBE_HEADER))
+                self._reply({"status": "ok",
+                             "geometry": {"n_channels": C, "n_times": T}})
+
+            def do_POST(self):  # noqa: N802
+                stub.probe_headers_seen.append(
+                    self.headers.get(PROBE_HEADER))
+                self.rfile.read(int(self.headers.get("Content-Length", 0)))
+                if stub.fail_code:
+                    self._reply({"error": "down"}, code=stub.fail_code)
+                else:
+                    self._reply({"predictions": list(stub.preds)})
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever,
+                                       daemon=True)
+        self.thread.start()
+        self.url = "http://127.0.0.1:%d" % self.server.server_address[1]
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def stub_front():
+    stub = _StubFront()
+    try:
+        yield stub
+    finally:
+        stub.stop()
+
+
+class TestProber:
+    def test_known_answer_pins_then_mismatch(self, stub_front, tmp_path):
+        with obs_journal.run(tmp_path, config={}) as jr:
+            prober = Prober(stub_front.url, slo=None, journal=jr)
+            assert prober.probe_once()["status"] == "ok"
+            assert prober.probe_once()["status"] == "ok"
+            # The model starts answering differently: wrong-answer gray
+            # failure, distinct from unreachability.
+            stub_front.preds = [3]
+            assert prober.probe_once()["status"] == "mismatch"
+            # A deliberate swap re-pins on the next success.
+            prober.reset_expected()
+            assert prober.probe_once()["status"] == "ok"
+            assert prober.probe_once()["status"] == "ok"
+        # Every canary was tagged so the server can exempt it.
+        assert all(h == "1" for h in stub_front.probe_headers_seen)
+        events = obs_schema.read_events(jr.events_path)
+        probes = [e for e in events if e["event"] == "probe"]
+        assert [e["status"] for e in probes] \
+            == ["ok", "ok", "mismatch", "ok", "ok"]
+        for e in probes:
+            assert e["url"] == stub_front.url
+            assert e["latency_ms"] >= 0.0
+
+    def test_unavailability_breaches_probe_slo(self, stub_front, tmp_path):
+        with obs_journal.run(tmp_path, config={}) as jr:
+            prober = Prober(stub_front.url, slo="availability>0.99",
+                            min_samples=3, journal=jr)
+            stub_front.fail_code = 500
+            for _ in range(3):
+                assert prober.probe_once()["status"] == "http_500"
+            state = prober.state()
+            assert state["breached"] and prober.breached
+            assert state["probes_sent"] == 3
+            # Recovery: the front door heals, the window refills with
+            # successes until availability clears the threshold again.
+            stub_front.fail_code = None
+            prober.reset_expected()
+            for _ in range(300):
+                prober.probe_once()
+                if not prober.breached:
+                    break
+            assert not prober.breached
+        events = obs_schema.read_events(jr.events_path)
+        breaches = [e for e in events if e["event"] == "slo_breach"]
+        assert len(breaches) == 1
+        # The probe: prefix keeps outside-in breaches distinct from the
+        # server-side monitor's objectives.
+        assert breaches[0]["objective"].startswith("probe:")
+        assert breaches[0]["metric"] == "probe_availability"
+        recoveries = [e for e in events if e["event"] == "slo_recovered"]
+        assert len(recoveries) == 1
+        assert recoveries[0]["objective"] == breaches[0]["objective"]
+
+    def test_unreachable_front_door_is_error_not_crash(self, tmp_path):
+        with obs_journal.run(tmp_path, config={}) as jr:
+            prober = Prober("http://127.0.0.1:9", timeout_s=0.5,
+                            slo=None, journal=jr)
+            assert prober.probe_once()["status"] == "error"
+        events = obs_schema.read_events(jr.events_path)
+        assert [e["status"] for e in events if e["event"] == "probe"] \
+            == ["error"]
+
+
+# ---------------------------------------------------------------------------
+# FLOPs attribution on compile events (tentpole 4, engine/training side).
+# ---------------------------------------------------------------------------
+
+class TestCostAttribution:
+    def test_cost_flops_bytes_reads_cost_analysis_shapes(self):
+        class _Lowered:
+            def __init__(self, analysis):
+                self._analysis = analysis
+
+            def cost_analysis(self):
+                return self._analysis
+
+        assert cost_flops_bytes(
+            _Lowered({"flops": 5.0, "bytes accessed": 3.0})) == (5.0, 3.0)
+        # Older jax returns a one-element list of dicts.
+        assert cost_flops_bytes(
+            _Lowered([{"flops": 7.0}])) == (7.0, None)
+        # NaN / non-positive / missing keys degrade to None, never raise.
+        assert cost_flops_bytes(
+            _Lowered({"flops": float("nan")})) == (None, None)
+        assert cost_flops_bytes(_Lowered(None)) == (None, None)
+        assert cost_flops_bytes(object()) == (None, None)
+
+    def test_cost_flops_bytes_on_real_lowering(self):
+        lowered = jax.jit(lambda x: x @ x).lower(
+            np.zeros((8, 8), np.float32))
+        flops, nbytes = cost_flops_bytes(lowered)
+        # CPU cost analysis reports flops for a matmul; bytes accessed is
+        # backend-dependent — both must at least be well-typed.
+        for v in (flops, nbytes):
+            assert v is None or v > 0
+        assert flops is not None and flops >= 2 * 8 * 8 * 8 * 0.5
+
+    def test_compile_events_carry_flops_fields(self, tmp_path):
+        from eegnetreplication_tpu.serve.engine import InferenceEngine
+        with obs_journal.run(tmp_path, config={}) as jr:
+            InferenceEngine.from_checkpoint(_checkpoint(tmp_path),
+                                            buckets=(1,), journal=jr)
+        events = obs_schema.read_events(jr.events_path)
+        compiles = [e for e in events if e["event"] == "compile"]
+        assert compiles
+        for e in compiles:
+            # Attribution is best-effort (None where the backend withholds
+            # cost analysis) but the fields must ride on every compile.
+            assert "flops" in e and "bytes_accessed" in e
+            assert e["flops"] is None or e["flops"] > 0
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 3+4 against a REAL replica: probe exemption and POST /profile.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="class")
+def live_app(tmp_path_factory):
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    root = tmp_path_factory.mktemp("live_app")
+    ck = _checkpoint(root)
+    with obs_journal.run(root / "obs", config={}) as jr:
+        app = ServeApp(ck, port=0, buckets=(1, 4), max_wait_ms=1.0,
+                       journal=jr).start()
+        try:
+            yield app, jr
+        finally:
+            app.stop()
+
+
+class TestProbeExemption:
+    def test_probe_requests_segregated_from_user_stats(self, live_app):
+        app, jr = live_app
+        x = np.random.RandomState(3).randn(1, C, T).astype(np.float32)
+        before = _get_json(app.url + "/metrics")
+        code, resp = _post_json(app.url + "/predict",
+                                {"trials": x.tolist()},
+                                headers={PROBE_HEADER: "1"})
+        assert code == 200 and len(resp["predictions"]) == 1
+        code, _ = _post_json(app.url + "/predict", {"trials": x.tolist()})
+        assert code == 200
+        after = _get_json(app.url + "/metrics")
+
+        def count(m, name):
+            return sum(c["value"] for c in m["counters"].get(name, []))
+
+        # The canary landed in probe_requests_total; user accounting
+        # (requests_total, the latency histogram the SLO monitor reads)
+        # moved by exactly the ONE user request.
+        assert count(after, "probe_requests_total") \
+            == count(before, "probe_requests_total") + 1
+        assert count(after, "requests_total") \
+            == count(before, "requests_total") + 1
+
+    def test_prober_end_to_end_against_real_replica(self, live_app):
+        app, jr = live_app
+        prober = Prober(app.url, slo=None, journal=jr, timeout_s=30.0)
+        assert prober.probe_once()["status"] == "ok"
+        # Deterministic forward: the pinned answer holds on a re-probe.
+        assert prober.probe_once()["status"] == "ok"
+
+    def test_probe_marked_in_request_events(self, live_app):
+        app, jr = live_app
+        x = np.random.RandomState(5).randn(1, C, T).astype(np.float32)
+        code, _ = _post_json(app.url + "/predict", {"trials": x.tolist()},
+                             headers={PROBE_HEADER: "1"})
+        assert code == 200
+        events = obs_schema.read_events(jr.events_path, complete=False)
+        probe_reqs = [e for e in events
+                      if e["event"] == "request" and e.get("probe")]
+        assert probe_reqs
+        assert all(e["status"] == "ok" for e in probe_reqs)
+
+
+class TestProfileEndpoint:
+    def test_window_lifecycle_202_409_400(self, live_app):
+        app, jr = live_app
+        # Malformed bodies are 400, not windows.
+        for bad in ({"seconds": -1}, {"seconds": "soon"},
+                    {"log_dir": 7}, []):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post_json(app.url + "/profile", bad)
+            assert err.value.code == 400
+        from eegnetreplication_tpu.serve.service import PROFILE_MAX_S
+        code, resp = _post_json(app.url + "/profile", {"seconds": 0.3})
+        assert code == 202 and resp["status"] == "started"
+        assert resp["seconds"] == pytest.approx(0.3)
+        assert resp["max_s"] == PROFILE_MAX_S and resp["log_dir"]
+        # One window at a time: a concurrent request is refused, the
+        # running window is untouched.
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _post_json(app.url + "/profile", {"seconds": 0.3})
+        assert err.value.code == 409
+        deadline = time.time() + 30.0
+        window = None
+        while time.time() < deadline and window is None:
+            time.sleep(0.1)
+            events = obs_schema.read_events(jr.events_path,
+                                            complete=False)
+            done = [e for e in events if e["event"] == "profile_window"]
+            window = done[-1] if done else None
+        assert window is not None, "profile_window never journaled"
+        assert window["status"] == "ok"
+        assert window["dur_s"] >= 0.3
+        assert window["log_dir"] == resp["log_dir"]
+        # The bounded window released the slot: a new one is accepted.
+        code, resp2 = _post_json(app.url + "/profile",
+                                 {"seconds": 0.1,
+                                  "log_dir": resp["log_dir"] + "_b"})
+        assert code == 202
+        assert resp2["log_dir"].endswith("_b")
+
+    def test_requested_seconds_clamped_to_max(self, live_app, monkeypatch):
+        from eegnetreplication_tpu.serve import service as serve_service
+        app, _ = live_app
+        # Clamp a huge request to a SMALL ceiling so the resulting window
+        # cannot outlive this test (the real ceiling is 60 s).
+        monkeypatch.setattr(serve_service, "PROFILE_MAX_S", 0.2)
+        deadline = time.time() + 30.0
+        while time.time() < deadline:
+            try:
+                code, resp = _post_json(app.url + "/profile",
+                                        {"seconds": 10_000.0})
+            except urllib.error.HTTPError as err:
+                assert err.code == 409  # previous test's window draining
+                time.sleep(0.1)
+                continue
+            break
+        assert code == 202
+        assert resp["seconds"] == 0.2
+        time.sleep(0.5)  # let the clamped window close before teardown
+
+
+# ---------------------------------------------------------------------------
+# Tentpole 5 / satellite 6: the bench regression sentinel.
+# ---------------------------------------------------------------------------
+
+sys.path.insert(0, str(REPO / "scripts"))
+import bench_gate  # noqa: E402
+
+
+class TestBenchGate:
+    def test_selftest_is_the_tier1_contract(self):
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "bench_gate.py"),
+             "--selftest"],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "all legs passed" in proc.stdout
+
+    def test_compare_directions_and_floor(self):
+        committed = {"platform": "cpu", "rps": 100.0, "p95_ms": 10.0,
+                     "overhead": {"ratio": 0.99,
+                                  "with_obs": {"rps": 900.0}}}
+        clean = bench_gate.compare(committed, json.loads(
+            json.dumps(committed)), bench_gate.SPECS["BENCH_OBS.json"])
+        assert not clean["violations"]
+        bad = {"platform": "cpu", "rps": 50.0, "p95_ms": 30.0,
+               "overhead": {"ratio": 0.80, "with_obs": {"rps": 900.0}}}
+        verdict = bench_gate.compare(committed, bad,
+                                     bench_gate.SPECS["BENCH_OBS.json"])
+        flat = "\n".join(verdict["violations"])
+        assert "rps" in flat and "p95_ms" in flat
+        assert "overhead.ratio" in flat and "floor" in flat
+
+    def test_committed_bench_obs_passes_its_own_specs(self):
+        committed = json.loads((REPO / "BENCH_OBS.json").read_text())
+        verdict = bench_gate.compare(committed, committed,
+                                     bench_gate.SPECS["BENCH_OBS.json"])
+        assert not verdict["violations"]
+        assert verdict["checked"] > 2
+
+
+# ---------------------------------------------------------------------------
+# Acceptance pin: eegtpu-top --json over a LIVE 3-replica fleet plus a
+# cells-shaped journal nest, cross-checked against /healthz + /metrics.
+# ---------------------------------------------------------------------------
+
+class TestOpsConsoleIntegration:
+    def _write_cells_nest(self, root: Path) -> Path:
+        """A synthetic cells-topology journal tree: front -> c0_obs ->
+        cell run -> replica_obs -> replica run (THREE levels below the
+        root — the nesting the old fixed-depth scan missed)."""
+        now = time.time()
+        front = root / "cells-front-run"
+        deep = front / "c0_obs" / "cell-run" / "replica_obs" / "cell-rep"
+        front.mkdir(parents=True)
+        deep.mkdir(parents=True)
+        with open(front / "events.jsonl", "w") as fh:
+            fh.write(json.dumps({"event": "run_start", "t": now,
+                                 "run_id": "cells-front"}) + "\n")
+            fh.write(json.dumps({"event": "cell_front_start",
+                                 "t": now}) + "\n")
+            fh.write(json.dumps({"event": "cell_member", "t": now,
+                                 "cell": "c0", "state": "live"}) + "\n")
+        with open(deep / "events.jsonl", "w") as fh:
+            fh.write(json.dumps({"event": "run_start", "t": now,
+                                 "run_id": "cell-rep"}) + "\n")
+            for _ in range(4):
+                fh.write(json.dumps({"event": "request", "t": now,
+                                     "status": "ok",
+                                     "latency_ms": 2.0}) + "\n")
+        return deep
+
+    def test_top_json_matches_healthz_over_live_fleet(self, tmp_path,
+                                                      capsys):
+        from eegnetreplication_tpu.serve import service as serve_service
+        from eegnetreplication_tpu.serve.fleet import (
+            membership as fleet_ms,
+        )
+
+        root = tmp_path / "obsroot"
+        root.mkdir()
+        ck = _checkpoint(tmp_path)
+        deep = self._write_cells_nest(root)
+        sent = {}
+        with ExitStack() as stack:
+            front_jr = stack.enter_context(
+                obs_journal.run(root, config={}, run_id="fleet-front"))
+            front_jr.event("fleet_start", replicas=3, checkpoint=str(ck))
+            apps, journal_dirs = [], []
+            for i in range(3):
+                jr = stack.enter_context(obs_journal.run(
+                    front_jr.dir / "replica_obs", config={},
+                    run_id=f"replica-{i}"))
+                app = serve_service.ServeApp(
+                    ck, port=0, buckets=(1, 4), max_wait_ms=1.0,
+                    journal=jr).start()
+                stack.callback(app.stop)
+                apps.append(app)
+                journal_dirs.append(jr.dir)
+            replicas = [fleet_ms.Replica(f"r{i}", app.url,
+                                         journal=front_jr)
+                        for i, app in enumerate(apps)]
+            membership = fleet_ms.FleetMembership(replicas, poll_s=0.1,
+                                                  journal=front_jr)
+            membership.start()
+            stack.callback(membership.close)
+            membership.wait_live(3, timeout_s=60.0)
+
+            rng = np.random.RandomState(0)
+            for i, app in enumerate(apps):
+                sent[i] = i + 2
+                for _ in range(sent[i]):
+                    x = rng.randn(1, C, T).astype(np.float32)
+                    code, _ = _post_json(app.url + "/predict",
+                                         {"trials": x.tolist()})
+                    assert code == 200
+
+            # The console reads the SAME tree while everything is live.
+            assert obs_top.main(["--json", str(root),
+                                 "--window", "300"]) == 0
+            snap = json.loads(capsys.readouterr().out.strip()
+                              .splitlines()[-1])
+
+            # Fleet membership (front journal) vs each replica's own
+            # /healthz: both must call the same replicas live.
+            for i, app in enumerate(apps):
+                health = _get_json(app.url + "/healthz")
+                assert health["status"] == "ok"
+                assert snap["members"][f"r{i}"] == {"kind": "replica",
+                                                    "state": "live"}
+            # Plus the synthetic cells member: 4 runs' membership merged.
+            assert snap["members"]["c0"] == {"kind": "cell",
+                                             "state": "live"}
+            assert snap["n_members"] == 4
+            assert not snap["slo_breached"]
+            assert snap["dropped_lines"] == 0
+
+            by_dir = {r["dir"]: r for r in snap["runs"]}
+            # The fleet rps header is the sum over EVERY run's window
+            # rate (replicas + the synthetic cells replica).
+            assert snap["rps"] == pytest.approx(
+                sum(r["rps"] for r in snap["runs"]), abs=0.01)
+            for i, app in enumerate(apps):
+                view = by_dir[str(journal_dirs[i])]
+                assert view["role"] == "serve"
+                assert view["status"] == "live"
+                assert view["run_id"] == f"replica-{i}"
+                # Request accounting: the aggregator's fold must equal
+                # the replica's own /metrics counters exactly.
+                metrics = _get_json(app.url + "/metrics")
+                served = sum(c["value"] for c in
+                             metrics["counters"]["requests_total"])
+                assert view["total_requests"] == sent[i] == served
+                assert view["window_non_ok"] == 0
+                assert view["rps"] > 0
+                assert view["p95_ms"] >= view["p50_ms"] > 0
+
+            # The three-level cells replica was discovered and folded.
+            cell_view = by_dir[str(deep)]
+            assert cell_view["total_requests"] == 4
+            front_view = by_dir[str(front_jr.dir)]
+            assert front_view["role"] == "fleet"
+            assert front_view["members"].keys() == {"r0", "r1", "r2"}
+
+            # The rendered frame (the --once path) carries the same rows.
+            frame = obs_top.render(snap)
+            assert "replica-0" in frame and "fleet-front" in frame
+            assert "replica r0: live" in frame
