@@ -201,6 +201,13 @@ class _RunView:
         self.ckpt_writes = 0
         self.ckpt_ms = 0.0
         self.ckpt_blocked_ms = 0.0
+        # Elastic-fleet autoscaler (fleet_scale events): latest declared
+        # target vs the n_live the decision saw, plus decision counters.
+        self.scale_target: int | None = None
+        self.scale_actual: int | None = None
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.scale_forced = 0
 
     # -- folding ----------------------------------------------------------
     def fold(self, events: list[dict]) -> None:
@@ -262,6 +269,20 @@ class _RunView:
         if cell is not None:
             self.members[str(cell)] = {"kind": "cell",
                                        "state": ev.get("state")}
+
+    def _on_fleet_scale(self, ev, t):
+        target, actual = _num(ev.get("target")), _num(ev.get("n_live"))
+        if target is not None:
+            self.scale_target = int(target)
+        if actual is not None:
+            self.scale_actual = int(actual)
+        action = ev.get("action")
+        if action == "up":
+            self.scale_ups += 1
+        elif action == "down":
+            self.scale_downs += 1
+        elif action == "forced":
+            self.scale_forced += 1
 
     def _on_circuit_state(self, ev, t):
         self.circuit = ev.get("state")
@@ -349,6 +370,12 @@ class _RunView:
             out["ckpt"] = {"writes": self.ckpt_writes,
                            "ms": round(self.ckpt_ms, 3),
                            "blocked_ms": round(self.ckpt_blocked_ms, 3)}
+        if self.scale_target is not None:
+            out["scale"] = {"target": self.scale_target,
+                            "actual": self.scale_actual,
+                            "ups": self.scale_ups,
+                            "downs": self.scale_downs,
+                            "forced": self.scale_forced}
         if self._probes:
             probe_ok = [lat for _, status, lat in self._probes
                         if status == "ok" and lat is not None]

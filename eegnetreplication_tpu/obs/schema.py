@@ -120,6 +120,13 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "fleet_canary": ("phase",),
     "fleet_shadow": ("replica", "reference", "n_trials", "agree"),
     "fleet_reload": ("status", "checkpoint"),
+    # Elastic fleet (serve/fleet/autoscaler.py): every autoscaler
+    # decision with its full input snapshot.  action is one of resync /
+    # up / up_failed / down / down_aborted / drained / forced; the
+    # down→drained (or down→forced) pairing in journal order is the
+    # drain-safety proof — a retirement with no "drained" between the
+    # "down" and the member's OUT transition was forced, and says so.
+    "fleet_scale": ("action", "target", "n_live", "reason"),
     "fleet_end": ("n_requests", "wall_s"),
     # Multi-cell serving (serve/cells/): the front tier's lifecycle, every
     # cell membership transition (the cells analog of fleet_member — a
@@ -606,6 +613,18 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
             if agree:
                 out["fleet_shadow_agree"] = round(
                     sum(agree) / len(agree), 4)
+    # Elastic fleet: autoscaler decision counts — up/down are decisions
+    # (a failed spawn still counted as an "up" decision journals its own
+    # up_failed row), forced_retires is the drain-safety escape hatch
+    # firing (0 on a healthy run).
+    scales = [e for e in events if e["event"] == "fleet_scale"]
+    if scales:
+        out["scale_ups"] = sum(1 for e in scales
+                               if e.get("action") == "up")
+        out["scale_downs"] = sum(1 for e in scales
+                                 if e.get("action") == "down")
+        out["forced_retires"] = sum(1 for e in scales
+                                    if e.get("action") == "forced")
     # Multi-cell serving: cell count, membership churn, and session
     # portability activity (planned migrations vs unplanned failovers) —
     # only reported for cell-front streams so other rows stay compact.

@@ -45,6 +45,7 @@ def _cell(value) -> str:
 def _run_row(r: dict) -> list[str]:
     members = r.get("members") or {}
     probes = r.get("probes") or {}
+    scale = r.get("scale") or {}
     return [
         _short(r.get("run_id")), r.get("role", "run"),
         r.get("status", "?"),
@@ -52,6 +53,8 @@ def _run_row(r: dict) -> list[str]:
         _cell(r.get("p50_ms")), _cell(r.get("p95_ms")),
         _cell(r.get("window_non_ok")),
         _cell(len(members) or None),
+        (f"{scale.get('target')}/{scale.get('actual')}"
+         if scale else "-"),
         _cell(r.get("circuit")),
         _cell(",".join(r.get("ejected") or []) or None),
         _cell(",".join(r.get("slo_breached") or []) or None),
@@ -62,8 +65,8 @@ def _run_row(r: dict) -> list[str]:
 
 
 _HEADERS = ["run", "role", "status", "rps", "p50_ms", "p95_ms", "non_ok",
-            "members", "circuit", "ejected", "slo_breach", "fold-ep/s",
-            "probes"]
+            "members", "scale", "circuit", "ejected", "slo_breach",
+            "fold-ep/s", "probes"]
 
 
 def render(snap: dict) -> str:

@@ -80,6 +80,16 @@ site                  action     effect
                                  confines it to one cell id so a
                                  multi-cell process drill kills exactly
                                  one member
+``fleet.scale``       raise      ``RuntimeError`` inside the autoscaler's
+                                 scaling action — fired with
+                                 ``tag="spawn"`` right before a scale-up
+                                 launches a replica (spawn failure /
+                                 stillborn-replica drills) and with
+                                 ``tag="drain"`` inside the scale-down
+                                 quiesce wait (``action=sleep`` there
+                                 models a hang-during-drain, which must
+                                 time out into a forced-but-journaled
+                                 retirement)
 ====================  =========  ==========================================
 
 Unlike ``sleep=`` (an unbounded silent stall — the watchdog/supervisor
@@ -115,7 +125,7 @@ SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
          "checkpoint.write_async", "host.preempt", "train.chunk",
          "serve.forward", "train.hang", "serve.hang", "session.snapshot",
          "session.restore", "serve.degrade", "replica.network",
-         "cell.partition")
+         "cell.partition", "fleet.scale")
 
 ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate",
            "refuse")
@@ -179,6 +189,8 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                         "injected truncation: replica.network (hit {hit})"),
     "cell.partition": ("refuse", None,
                        "injected partition: cell.partition (hit {hit})"),
+    "fleet.scale": ("raise", "RuntimeError",
+                    "injected fault: fleet.scale (hit {hit})"),
 }
 
 

@@ -320,6 +320,7 @@ class _Child:
         self.hang_killed = False
         self.term_deadline: float | None = None  # SIGTERM->SIGKILL window
         self.last_exit: int | None = None
+        self.retiring = False           # retire_child asked for teardown
 
     @property
     def pid(self) -> int | None:
@@ -347,6 +348,15 @@ class MultiSupervisor:
     :meth:`stop` for in-process embedders like the fleet bench) forwards
     SIGTERM to every running child, escalates stragglers after
     ``grace_s``, and ends supervision with no relaunches.
+
+    Membership is dynamic: :meth:`add_child` joins a new child to a
+    running supervisor (launched by the loop's next poll) and
+    :meth:`retire_child` tears down exactly the named child — SIGTERM,
+    grace, SIGKILL — without disturbing siblings, then forgets its
+    crash-loop breaker state entirely, so the autoscaler can grow and
+    shrink the fleet through the same per-child machinery a static fleet
+    already trusts.  Once either has been called, ``run()`` keeps
+    supervising through all-terminal instants and exits only on a stop.
 
     ``run()`` returns 0 when every child completed (a drain exit —
     ``EX_PREEMPTED`` after our own stop — counts as completed),
@@ -383,6 +393,14 @@ class MultiSupervisor:
         self._popen = popen
         self._stop = False
         self._stop_lock = threading.Lock()
+        # Guards mutation of the children dict (add_child/retire_child run
+        # on other threads — e.g. the autoscaler — while run() polls).
+        self._children_lock = threading.Lock()
+        # Set by the first add_child/retire_child: an elastic fleet keeps
+        # supervising through transient all-terminal instants (a retire
+        # can empty the dict just before the next scale-up) and only exits
+        # on an explicit stop.
+        self._dynamic = False
         self.children: dict[str, _Child] = {
             s.name: _Child(s) for s in specs}
 
@@ -398,6 +416,77 @@ class MultiSupervisor:
             if self._stop:
                 return True
         return preempt.requested()
+
+    # -- dynamic membership (the autoscaler's seam) ------------------------
+    def add_child(self, spec: ChildSpec) -> None:
+        """Add one child to a RUNNING supervisor (thread-safe).
+
+        The child starts in backoff with an immediate relaunch deadline,
+        so the supervision loop launches it on its next poll — all
+        process operations stay on the supervising thread.  A re-added
+        name gets a brand-new :class:`_Child`: the previous incarnation's
+        crash-loop breaker window, attempt count, and resume flag are
+        deliberately forgotten (retirement is not a crash).
+        """
+        with self._children_lock:
+            if spec.name in self.children:
+                raise ValueError(f"duplicate child name: {spec.name!r}")
+            self._dynamic = True
+            self.children[spec.name] = _Child(spec)
+
+    def retire_child(self, name: str, *, wait_s: float | None = 10.0
+                     ) -> bool:
+        """Retire ONE named child: SIGTERM, ``grace_s``, SIGKILL, then
+        forget it — siblings are never touched (thread-safe).
+
+        The teardown itself happens on the supervision thread (the only
+        thread that owns child processes); this call marks the child and,
+        with ``wait_s``, blocks until the loop has reaped it.  Returns
+        True once the child is gone (an unknown name counts — retiring
+        twice must be idempotent), False on a wait timeout.
+        """
+        with self._children_lock:
+            self._dynamic = True
+            child = self.children.get(name)
+            if child is None:
+                return True
+            child.retiring = True
+        if wait_s is None:
+            return False
+        deadline = time.monotonic() + wait_s
+        while time.monotonic() < deadline:
+            with self._children_lock:
+                if name not in self.children:
+                    return True
+            time.sleep(min(self.policy.poll_s, 0.05))
+        with self._children_lock:
+            return name not in self.children
+
+    def _reap_retiring(self, child: _Child) -> None:
+        """Tear down one retiring child without blocking the loop:
+        SIGTERM now, SIGKILL at the grace deadline, and once the process
+        is gone drop the child from the dict entirely (its breaker
+        history dies with it)."""
+        proc = child.proc
+        if proc is not None and proc.poll() is None:
+            if child.term_deadline is None:
+                logger.info("MultiSupervisor: retiring %s (pid %d) — "
+                            "SIGTERM", child.spec.name, proc.pid)
+                proc.terminate()
+                child.term_deadline = time.monotonic() + self.policy.grace_s
+            self._escalate_if_due(child)
+            if proc.poll() is None:
+                return  # still draining; reap on a later poll
+        code = proc.wait() if proc is not None else None
+        child.last_exit = code
+        child.state = _DONE
+        self.journal.event("supervisor_exit", child=child.spec.name,
+                           attempt=child.attempt, exit_code=code,
+                           classification="retired")
+        logger.info("MultiSupervisor: child %s retired (exit %s)",
+                    child.spec.name, code)
+        with self._children_lock:
+            self.children.pop(child.spec.name, None)
 
     # -- per-child lifecycle ----------------------------------------------
     def _launch(self, child: _Child) -> None:
@@ -526,6 +615,12 @@ class MultiSupervisor:
     def _poll_child(self, child: _Child, stopping: bool) -> None:
         if child.terminal:
             return
+        if child.retiring:
+            # Checked before _BACKOFF so a retiring child is never
+            # (re)launched — retire_child only sets the flag; every
+            # process operation happens here, on this thread.
+            self._reap_retiring(child)
+            return
         if child.state == _BACKOFF:
             if stopping:
                 child.state = _DONE  # never launched again under a stop
@@ -569,9 +664,18 @@ class MultiSupervisor:
         while True:
             if not stopping and self._stop_requested():
                 stopping = True
-            for child in self.children.values():
+            with self._children_lock:
+                kids = list(self.children.values())
+            for child in kids:
                 self._poll_child(child, stopping)
-            if all(c.terminal for c in self.children.values()):
+            with self._children_lock:
+                # A dynamic fleet only exits on an explicit stop: between
+                # a retire and the next scale-up, "everyone is terminal"
+                # (or the dict is momentarily empty) is a normal instant,
+                # not the end of supervision.
+                done = all(c.terminal for c in self.children.values()) \
+                    and (stopping or not self._dynamic)
+            if done:
                 break
             self._sleep(self.policy.poll_s)
         states = {name: c.state for name, c in self.children.items()}

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 
 from eegnetreplication_tpu.obs import journal as obs_journal
 from eegnetreplication_tpu.obs.stats import percentile
@@ -46,6 +47,55 @@ from eegnetreplication_tpu.utils.logging import logger
 # the journal must record that (and how much) shedding happened, not one
 # line per refused request.
 SHED_JOURNAL_INTERVAL_S = 0.25
+
+
+class ArrivalWindow:
+    """Rolling-window arrival-rate meter (thread-safe).
+
+    The one load signal an autoscaler cannot derive from completions is
+    *offered* load — how much work arrived, including work that was shed
+    or bounced.  This measures it: :meth:`record` stamps each arrival,
+    :meth:`rate` reports events/second over the trailing ``window_s``.
+    The admission controller records every bulk :meth:`~AdmissionController.admit`
+    consult into one (exported on its snapshot), and the fleet tier
+    records router-edge dispatches into another — the window the
+    autoscaler's control loop reads.
+    """
+
+    def __init__(self, window_s: float = 5.0, clock=time.monotonic):
+        if window_s <= 0:
+            raise ValueError(f"window_s must be > 0, got {window_s}")
+        self.window_s = float(window_s)
+        self._clock = clock
+        self._events: deque[tuple[float, int]] = deque()
+        self._lock = threading.Lock()
+
+    def record(self, n: int = 1) -> None:
+        now = self._clock()
+        with self._lock:
+            self._events.append((now, int(n)))
+            self._prune(now)
+
+    def _prune(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def rate(self) -> float:
+        """Arrivals per second over the trailing window.  Measured over
+        the FULL window (not the observed span), so a burst that just
+        started reads as a low-but-rising rate instead of a spike."""
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            total = sum(n for _, n in self._events)
+        return total / self.window_s
+
+    def count(self) -> int:
+        now = self._clock()
+        with self._lock:
+            self._prune(now)
+            return sum(n for _, n in self._events)
 
 
 class AdmissionController:
@@ -95,6 +145,10 @@ class AdmissionController:
         self.n_changes = 0
         self._last_shed_journal = 0.0
         self._shed_since_journal = 0
+        # Offered bulk load in trials/s — measured at the admit() consult,
+        # BEFORE the verdict, so shed traffic still counts.  Exported on
+        # snapshot() (and thus /healthz) for the fleet autoscaler.
+        self.arrivals = ArrivalWindow(clock=clock)
 
     @property
     def limit(self) -> int:
@@ -107,6 +161,7 @@ class AdmissionController:
         ``pending_trials`` under the current adaptive limit (the hard
         ``max_limit`` cliff is the batcher's own check, applied to every
         class)."""
+        self.arrivals.record(n_new)
         with self._lock:
             return pending_trials + n_new <= int(self._limit)
 
@@ -168,11 +223,17 @@ class AdmissionController:
             "%.1fms vs target %.1fms)", reason, old, new, p95,
             self.target_wait_ms)
 
+    def arrival_rate(self) -> float:
+        """Measured offered bulk load, trials/s over the rolling window."""
+        return self.arrivals.rate()
+
     def snapshot(self) -> dict:
         """The /healthz view of the controller."""
+        rate = self.arrivals.rate()
         with self._lock:
             return {"limit_trials": int(self._limit),
                     "target_wait_ms": self.target_wait_ms,
                     "min_limit": self.min_limit,
                     "max_limit": self.max_limit,
-                    "shed": self.n_shed, "changes": self.n_changes}
+                    "shed": self.n_shed, "changes": self.n_changes,
+                    "arrival_trials_per_s": round(rate, 3)}

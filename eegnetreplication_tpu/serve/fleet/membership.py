@@ -140,6 +140,10 @@ class Replica:
         self.breaker = breaker if breaker is not None else CircuitBreaker(
             site=f"fleet.{replica_id}", journal=journal)
         self.state = JOINING
+        # Pinned by an administrative drain (the autoscaler's scale-down):
+        # the health poller must NOT re-LIVE a pinned replica however
+        # healthy it looks — mirrors the cells tier's CellMember.pinned.
+        self.pinned = False
         self.digest: str | None = None
         self.precision: str | None = None   # from the last health poll
         self.buckets: tuple[int, ...] | None = None  # active ladder
@@ -175,7 +179,8 @@ class Replica:
 
     def snapshot(self) -> dict:
         return {"replica": self.replica_id, "url": self.url,
-                "state": self.state, "digest": self.digest,
+                "state": self.state, "pinned": self.pinned,
+                "digest": self.digest,
                 "precision": self.precision,
                 "buckets": list(self.buckets) if self.buckets else None,
                 "n_tenants": self.n_tenants, "stacked": self.stacked,
@@ -223,9 +228,11 @@ class FleetMembership:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # One slot per replica so poll_once's wall is bounded by the
-        # slowest member, not their sum (see poll_once).
+        # slowest member, not their sum (see poll_once); add_replica
+        # swaps in a bigger pool when the fleet outgrows this one.
+        self._pool_workers = max(2, len(self.replicas))
         self._poll_pool = ThreadPoolExecutor(
-            max_workers=max(2, len(self.replicas)),
+            max_workers=self._pool_workers,
             thread_name_prefix="fleet-health")
 
     # -- queries -----------------------------------------------------------
@@ -244,6 +251,42 @@ class FleetMembership:
 
     def snapshot(self) -> list[dict]:
         return [r.snapshot() for r in self.replicas]
+
+    # -- dynamic membership (the autoscaler's seam) ------------------------
+    def add_replica(self, replica: Replica) -> None:
+        """Join one replica to a live membership (thread-safe).  It starts
+        JOINING and goes LIVE through the same health gate as a boot-time
+        member — the autoscaler never shortcuts the join path.
+
+        Readers (``dispatchable``/``poll_once``/``snapshot``) iterate
+        ``self.replicas`` without the state lock, so membership changes
+        REPLACE the list atomically instead of mutating it in place.
+        """
+        with self._state_lock:
+            if any(r.replica_id == replica.replica_id
+                   for r in self.replicas):
+                raise ValueError(
+                    f"duplicate replica id: {replica.replica_id!r}")
+            self.replicas = self.replicas + [replica]
+            if len(self.replicas) > self._pool_workers:
+                old = self._poll_pool
+                self._pool_workers = max(2, len(self.replicas))
+                self._poll_pool = ThreadPoolExecutor(
+                    max_workers=self._pool_workers,
+                    thread_name_prefix="fleet-health")
+                old.shutdown(wait=False)
+        logger.info("Fleet membership: added %s (%s)", replica.replica_id,
+                    replica.url)
+
+    def remove_replica(self, replica: Replica) -> None:
+        """Remove one retired replica (thread-safe; idempotent).  Journals
+        a final OUT transition so the membership stream records why the
+        member disappeared, then closes its connection pool."""
+        self.set_state(replica, OUT, "retired")
+        with self._state_lock:
+            self.replicas = [r for r in self.replicas
+                             if r.replica_id != replica.replica_id]
+        replica.client.close()
 
     # -- transitions -------------------------------------------------------
     def set_state(self, replica: Replica, state: str, reason: str, *,
@@ -298,10 +341,16 @@ class FleetMembership:
         """Poll every replica CONCURRENTLY: a single wedged member
         (accepts TCP, never answers) must cost the fleet's health view
         one ``health_timeout_s``, not one per sibling behind it."""
-        if len(self.replicas) == 1:
-            self._poll_replica(self.replicas[0])
+        replicas = self.replicas  # atomic ref: the list is swapped, never
+        if len(replicas) == 1:    # mutated, by add/remove_replica
+            self._poll_replica(replicas[0])
             return
-        list(self._poll_pool.map(self._poll_replica, self.replicas))
+        try:
+            list(self._poll_pool.map(self._poll_replica, replicas))
+        except RuntimeError:
+            # add_replica swapped in a bigger pool mid-poll and retired
+            # this one; the next cadence tick polls everyone again.
+            pass
 
     def _poll_replica(self, replica: Replica) -> None:
         replica.last_poll_t = time.time()
@@ -378,6 +427,12 @@ class FleetMembership:
         # canary flipped back to LIVE mid-shadow would put unverified
         # weights in rotation.  The guard re-validates under the lock.
         if status == 200 and stale is None:
+            if replica.pinned:
+                # An administrative drain (autoscale scale-down) holds:
+                # the replica is healthy ON PURPOSE while its in-flight
+                # work quiesces, and re-LIVE-ing it here would hand it
+                # new dispatches mid-retirement.
+                return
             reason = {JOINING: "joined", OUT: "rejoined",
                       DRAINING: "recovered"}.get(replica.state, "healthy")
             self.set_state(replica, LIVE, reason,

@@ -39,12 +39,18 @@ from pathlib import Path
 
 from eegnetreplication_tpu.obs import journal as obs_journal
 from eegnetreplication_tpu.obs import trace
+from eegnetreplication_tpu.obs.stats import percentile
 from eegnetreplication_tpu.resil import preempt, supervise
+from eegnetreplication_tpu.serve.admission import ArrivalWindow
 from eegnetreplication_tpu.serve.service import (
     PASSTHROUGH_HEADERS,
     JsonRequestHandler,
 )
 from eegnetreplication_tpu.serve.fleet import membership as ms
+from eegnetreplication_tpu.serve.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+)
 from eegnetreplication_tpu.serve.fleet.canary import RollingReload
 from eegnetreplication_tpu.serve.fleet.outlier import OutlierEjector
 from eegnetreplication_tpu.serve.sessions import store as session_store
@@ -139,6 +145,14 @@ class FleetApp:
         self._inflight = 0
         self._idle = threading.Condition(self._stats_lock)
         self._t_start = time.perf_counter()
+        # Rolling load windows for the autoscaler: offered load (every
+        # recorded request, shed/bounced included) and completed
+        # throughput + latency over the same trailing window.
+        self._window_s = 5.0
+        self.arrivals = ArrivalWindow(window_s=self._window_s)
+        self._ok_window: list[tuple[float, float]] = []  # (t, latency_ms)
+        # Bound by the --autoscale wiring; surfaces on /healthz when set.
+        self.autoscaler = None
 
     # -- lifecycle --------------------------------------------------------
     @property
@@ -220,8 +234,15 @@ class FleetApp:
 
     def record(self, status: str, n_trials: int, latency_ms: float,
                replica: str | None) -> None:
+        self.arrivals.record(1)
+        now = time.monotonic()
         with self._stats_lock:
             self._counts[status] = self._counts.get(status, 0) + 1
+            if status == "ok":
+                self._ok_window.append((now, latency_ms))
+                horizon = now - self._window_s
+                while self._ok_window and self._ok_window[0][0] < horizon:
+                    self._ok_window.pop(0)
         self.journal.event("request", n_trials=n_trials,
                            latency_ms=round(latency_ms, 3), status=status,
                            replica=replica)
@@ -234,6 +255,21 @@ class FleetApp:
             trace.flush(journal=self.journal)
         else:
             trace.flush_if_anomalous(status, journal=self.journal)
+
+    def window_stats(self) -> dict:
+        """The autoscaler's measured-load view: offered arrivals/s,
+        completed ok/s, and rolling ok-latency p95 over the trailing
+        window (``p95_ms`` is None while the window is empty)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            horizon = now - self._window_s
+            while self._ok_window and self._ok_window[0][0] < horizon:
+                self._ok_window.pop(0)
+            latencies = [lat for _, lat in self._ok_window]
+        return {"arrival_rps": self.arrivals.rate(),
+                "ok_rps": len(latencies) / self._window_s,
+                "p95_ms": (percentile(latencies, 0.95)
+                           if latencies else None)}
 
     # -- session stickiness ------------------------------------------------
     def session_replica(self, sid: str) -> ms.Replica | None:
@@ -348,6 +384,8 @@ class _FleetHandler(JsonRequestHandler):
                             if app.outlier is not None else None),
                 "hedges": {"fired": app.router.n_hedges,
                            "won": app.router.n_hedge_wins},
+                "scale": (app.autoscaler.snapshot()
+                          if app.autoscaler is not None else None),
                 "replicas": snapshot})
             return
         if self.path == "/metrics":
@@ -629,6 +667,28 @@ def update_child_checkpoints(sup: supervise.MultiSupervisor,
             cmd[cmd.index("--checkpoint") + 1] = str(checkpoint)
 
 
+def build_replica_spec(i: int, checkpoint: str, *, run_dir: Path,
+                       host: str = "127.0.0.1", port: int | None = None,
+                       serve_args: list[str] | None = None,
+                       extra_args: list[str] | None = None
+                       ) -> tuple[supervise.ChildSpec, str, Path]:
+    """One replica's (child spec, url, heartbeat file) — the single
+    command template both boot-time spawning and elastic scale-up use."""
+    run_dir = Path(run_dir)
+    if port is None:
+        port = free_port(host)
+    hb_file = run_dir / f"replica{i}.heartbeat.json"
+    cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
+           "--checkpoint", str(checkpoint), "--host", host,
+           "--port", str(port),
+           "--metricsDir", str(run_dir / "replica_obs")]
+    cmd += list(serve_args or [])
+    cmd += list(extra_args or [])
+    spec = supervise.ChildSpec(name=f"r{i}", cmd=cmd,
+                               heartbeat_file=hb_file)
+    return spec, f"http://{host}:{port}", hb_file
+
+
 def spawn_replica_fleet(checkpoint: str, n: int, *, run_dir: Path,
                         host: str = "127.0.0.1",
                         serve_args: list[str] | None = None,
@@ -646,21 +706,16 @@ def spawn_replica_fleet(checkpoint: str, n: int, *, run_dir: Path,
     """
     run_dir = Path(run_dir)
     run_dir.mkdir(parents=True, exist_ok=True)
-    ports = [free_port(host) for _ in range(n)]
     specs, urls, hbs = [], [], []
-    for i, port in enumerate(ports):
-        hb_file = run_dir / f"replica{i}.heartbeat.json"
-        cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
-               "--checkpoint", str(checkpoint), "--host", host,
-               "--port", str(port),
-               "--metricsDir", str(run_dir / "replica_obs")]
-        cmd += list(serve_args or [])
+    for i in range(n):
         # Per-replica extras (keyed by child name): how a gray drill arms
         # --chaos on exactly one member while its siblings stay clean.
-        cmd += list((per_replica_args or {}).get(f"r{i}", []))
-        specs.append(supervise.ChildSpec(name=f"r{i}", cmd=cmd,
-                                         heartbeat_file=hb_file))
-        urls.append(f"http://{host}:{port}")
+        spec, url, hb_file = build_replica_spec(
+            i, checkpoint, run_dir=run_dir, host=host,
+            serve_args=serve_args,
+            extra_args=(per_replica_args or {}).get(f"r{i}"))
+        specs.append(spec)
+        urls.append(url)
         hbs.append(hb_file)
     policy = policy or supervise.SupervisorPolicy(
         grace_s=10.0, poll_s=0.25,
@@ -671,6 +726,76 @@ def spawn_replica_fleet(checkpoint: str, n: int, *, run_dir: Path,
     sup = supervise.MultiSupervisor(specs, policy=policy, journal=journal)
     replicas = replica_specs(urls, heartbeat_files=hbs, journal=journal)
     return sup, replicas
+
+
+class ReplicaScaler:
+    """The autoscaler's action seam over a spawned fleet: ``spawn()``
+    builds a fresh child from the same command template, registers it
+    with the running :class:`~eegnetreplication_tpu.resil.supervise.MultiSupervisor`
+    (launched by the supervision loop's next poll) and joins it to
+    membership as JOINING; ``retire(replica)`` tears down exactly that
+    child and removes the member.  Indices are never reused within one
+    scaler: a retired ``r3`` stays retired, the next spawn is ``r4`` —
+    journal streams must never conflate two incarnations of a name."""
+
+    def __init__(self, sup: supervise.MultiSupervisor,
+                 membership: ms.FleetMembership, *, checkpoint: str,
+                 run_dir: Path, host: str = "127.0.0.1",
+                 serve_args: list[str] | None = None, journal=None):
+        self.sup = sup
+        self.membership = membership
+        self.checkpoint = str(checkpoint)
+        self.run_dir = Path(run_dir)
+        self.host = host
+        self.serve_args = list(serve_args or [])
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+        self._lock = threading.Lock()
+        indices = [int(r.replica_id[1:]) for r in membership.replicas
+                   if r.replica_id.startswith("r")
+                   and r.replica_id[1:].isdigit()]
+        self._next_index = (max(indices) + 1) if indices else 0
+
+    def set_checkpoint(self, checkpoint: str) -> None:
+        """Post-reload hook: future spawns come up on the weights the
+        fleet actually serves (existing children are repointed by
+        :func:`update_child_checkpoints`)."""
+        self.checkpoint = str(checkpoint)
+
+    def _claim_index(self) -> int:
+        with self._lock:
+            while True:
+                i = self._next_index
+                self._next_index += 1
+                name = f"r{i}"
+                if name not in self.sup.children and not any(
+                        r.replica_id == name
+                        for r in self.membership.replicas):
+                    return i
+
+    def spawn(self) -> ms.Replica:
+        i = self._claim_index()
+        spec, url, hb_file = build_replica_spec(
+            i, self.checkpoint, run_dir=self.run_dir, host=self.host,
+            serve_args=self.serve_args)
+        replica = ms.Replica(spec.name, url, heartbeat_file=hb_file,
+                             journal=self._journal)
+        # Supervisor first, then membership: a member without a child
+        # would poll OUT forever, a child without a member just serves
+        # unrouted until the next line lands.
+        self.sup.add_child(spec)
+        self.membership.add_replica(replica)
+        return replica
+
+    def retire(self, replica: ms.Replica) -> bool:
+        # Membership first, then supervisor — the mirror of spawn's
+        # ordering: the member must journal its out/"retired" transition
+        # while the process is still up, or the health poller wins the
+        # race and records the kill as an anonymous "unreachable" death,
+        # breaking the journal's down -> drained -> retired drain proof.
+        self.membership.remove_replica(replica)
+        return self.sup.retire_child(replica.replica_id,
+                                     wait_s=self.sup.policy.grace_s + 15.0)
 
 
 def main(argv=None) -> int:
@@ -752,11 +877,55 @@ def main(argv=None) -> int:
                              "fleet runs as one cell under eegtpu-cells — "
                              "so the flag must parse even without "
                              "--sessionsDir (a no-op then).")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="SLO-driven elastic fleet: a control loop "
+                             "grows the fleet (supervised spawn, health-"
+                             "gated join) when measured utilization "
+                             "climbs and drain-safely retires replicas "
+                             "when it falls.  --replicas becomes the "
+                             "STARTING size.")
+    parser.add_argument("--autoscaleMin", type=int, default=1,
+                        help="Floor on the elastic fleet size.")
+    parser.add_argument("--autoscaleMax", type=int, default=4,
+                        help="Ceiling on the elastic fleet size.")
+    parser.add_argument("--autoscaleIntervalS", type=float, default=0.5,
+                        help="Autoscaler control-loop cadence.")
+    parser.add_argument("--autoscaleUpAt", type=float, default=0.85,
+                        help="Utilization above this scales up (the "
+                             "hysteresis band's top edge).")
+    parser.add_argument("--autoscaleDownAt", type=float, default=0.40,
+                        help="Utilization below this may scale down (the "
+                             "band's bottom edge).")
+    parser.add_argument("--autoscaleUpCooldownS", type=float, default=2.0,
+                        help="Minimum spacing between scale-up decisions.")
+    parser.add_argument("--autoscaleDownCooldownS", type=float,
+                        default=6.0,
+                        help="Minimum spacing between scale-down "
+                             "decisions.")
+    parser.add_argument("--autoscaleDrainTimeoutS", type=float,
+                        default=20.0,
+                        help="Quiesce budget for a draining replica "
+                             "before a forced (journaled) retirement.")
+    parser.add_argument("--autoscaleTargetP95Ms", type=float, default=0.0,
+                        help="Optional latency up-signal: rolling ok-p95 "
+                             "above this (while busy) scales up (0 = "
+                             "utilization/backlog signals only).")
     parser.add_argument("--metricsDir", type=str, default=None)
     parser.add_argument("--startupTimeoutS", type=float, default=300.0)
     args = parser.parse_args(argv)
     if args.replicas < 1:
         parser.error("--replicas must be >= 1")
+    if args.autoscale:
+        if not 1 <= args.autoscaleMin <= args.autoscaleMax:
+            parser.error("need 1 <= --autoscaleMin <= --autoscaleMax")
+        if not args.autoscaleMin <= args.replicas <= args.autoscaleMax:
+            parser.error("--replicas must start inside "
+                         "[--autoscaleMin, --autoscaleMax]")
+        if args.sessionsDir:
+            # Sticky session state lives in ONE replica's store; retiring
+            # it would strand its sessions.  Elastic session fleets need
+            # migration-on-drain (the cells tier has it) — not wired yet.
+            parser.error("--autoscale does not support --sessionsDir yet")
     if args.slo:
         # Validate HERE, not in each replica: a malformed spec forwarded
         # blind would argparse-exit every child and spin the supervisor's
@@ -830,6 +999,35 @@ def main(argv=None) -> int:
                            "serving with what we have", live, args.replicas,
                            args.startupTimeoutS)
         app.start()
+        autoscaler = None
+        if args.autoscale:
+            scaler = ReplicaScaler(sup, app.membership,
+                                   checkpoint=args.checkpoint,
+                                   run_dir=journal.dir, host=args.host,
+                                   serve_args=serve_args, journal=journal)
+
+            # A rolling reload must also retarget FUTURE spawns, or the
+            # next scale-up resurrects the superseded checkpoint.
+            def _on_ck(ck, _scaler=scaler, _sup=sup):
+                _scaler.set_checkpoint(ck)
+                update_child_checkpoints(_sup, ck)
+
+            app._on_checkpoint_change = _on_ck
+            autoscaler = Autoscaler(
+                app.membership, scaler, app.window_stats,
+                policy=AutoscalerPolicy(
+                    min_replicas=args.autoscaleMin,
+                    max_replicas=args.autoscaleMax,
+                    interval_s=args.autoscaleIntervalS,
+                    up_threshold=args.autoscaleUpAt,
+                    down_threshold=args.autoscaleDownAt,
+                    up_cooldown_s=args.autoscaleUpCooldownS,
+                    down_cooldown_s=args.autoscaleDownCooldownS,
+                    drain_timeout_s=args.autoscaleDrainTimeoutS,
+                    target_p95_ms=args.autoscaleTargetP95Ms),
+                journal=journal)
+            app.autoscaler = autoscaler
+            autoscaler.start()
         print(f"fleet serving at {app.url} "
               f"({len(app.membership.dispatchable())} live)", flush=True)
         try:
@@ -837,6 +1035,8 @@ def main(argv=None) -> int:
                 time.sleep(0.2)
         finally:
             logger.info("Fleet stop requested — draining")
+            if autoscaler is not None:
+                autoscaler.close()
             app.stop()
             sup.stop()
             sup_thread.join(timeout=60.0)

@@ -1328,6 +1328,11 @@ class _ServeHandler(JsonRequestHandler):
                               expired=session.n_expired,
                               acked=session.acked, reason="migrated")
         app.sessions.snapshot()
+        # Scrub the migrated stream from the .gen* fallback chain too:
+        # the newest snapshot no longer holds it, but a corrupt-newest
+        # restore — or a cell-spool failover read — would find it in an
+        # older generation and fork the stream its new owner now serves.
+        app.sessions.compact_departed(sid)
         self._reply(200, reply)
 
     def _session_close(self, app: ServeApp, sid: str) -> None:
@@ -1354,8 +1359,11 @@ class _ServeHandler(JsonRequestHandler):
                               acked=session.acked)
             app.journal.metrics.inc("sessions_closed")
         # Persist the now-smaller table so a restart cannot resurrect the
-        # closed stream.
+        # closed stream — and scrub it from the generation fallback
+        # chain, which would otherwise resurrect it under a corrupt
+        # newest snapshot.
         app.sessions.snapshot()
+        app.sessions.compact_departed(sid)
         self._reply(200, reply)
 
 

@@ -319,7 +319,72 @@ class SessionStore:
         restart does not resurrect the closed stream."""
         session = self.take(session_id)
         self.snapshot()
+        self.compact_departed(session_id)
         return session
+
+    def compact_departed(self, session_id: str) -> int:
+        """Scrub a departed session from every retained ``.gen*``
+        snapshot generation; returns the number of generations rewritten
+        or removed.
+
+        close()/discard/migrate shrink the NEWEST snapshot, but the
+        generation fallback chain still holds the departed stream — so a
+        corrupt newest generation would resurrect a closed session on
+        restore, and a cell-spool read (:func:`read_spooled_session`)
+        could fail a MIGRATED session over to a second cell, forking the
+        stream the migration just moved.  Each generation is rewritten
+        in place (re-stamped digest, same atomic tmp+replace discipline
+        as the snapshot itself); a generation left holding no sessions
+        is unlinked.  Keep-guard: a session still open in this store is
+        never scrubbed — its generations ARE its crash fallback.
+        """
+        if self.path is None:
+            return 0
+        with self._lock:
+            if session_id in self._sessions:
+                return 0  # keep-guard: still open here
+        prefix = f"s/{session_id}/"
+        gen_re = re.compile(re.escape(self.path.name) + r"\.gen\d+$")
+        compacted = 0
+        with self._snap_lock:
+            for gen in sorted(self.path.parent.glob(
+                    self.path.name + ".gen*")):
+                if not gen_re.fullmatch(gen.name):
+                    continue  # quarantined corpses, tmp files
+                try:
+                    with np.load(gen, allow_pickle=False) as npz:
+                        flat = {k: npz[k] for k in npz.files}
+                    meta = json.loads(bytes(flat["__meta__"]).decode())
+                    sessions = list(meta["sessions"])
+                except Exception:  # noqa: BLE001 — corrupt gens are
+                    continue       # resolve_snapshot's to quarantine
+                if session_id not in sessions:
+                    continue
+                # Keep-guard at the generation level too: scrub ONLY the
+                # departed id; co-resident open sessions keep their
+                # fallback state byte-for-byte.
+                flat = {k: v for k, v in flat.items()
+                        if not k.startswith(prefix)}
+                sessions.remove(session_id)
+                if not sessions:
+                    gen.unlink(missing_ok=True)
+                    compacted += 1
+                    continue
+                flat.pop(integrity.DIGEST_KEY, None)
+                flat["__meta__"] = np.frombuffer(json.dumps(
+                    {"sessions": sessions}).encode(), dtype=np.uint8)
+                integrity.stamp(flat)
+                tmp = gen.with_suffix(gen.suffix + ".tmp")
+                with open(tmp, "wb") as fh:
+                    np.savez(fh, **flat)
+                tmp.replace(gen)
+                compacted += 1
+        if compacted:
+            self._journal.metrics.inc("session_generations_compacted",
+                                      compacted)
+            logger.debug("Compacted departed session %s out of %d "
+                         "snapshot generation(s)", session_id, compacted)
+        return compacted
 
     # -- durability -------------------------------------------------------
     def _flatten(self) -> tuple[dict[str, np.ndarray], int, int]:

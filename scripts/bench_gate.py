@@ -53,15 +53,28 @@ _LOWER = ("p50_ms", "p95_ms", "p99_ms", "latency_ms", "wall_s",
 DEFAULT_TOL = {"higher": 0.30, "lower": 0.60}
 
 # Per-artifact overrides: basename -> list of (dotted path, kind, value).
-#   kind "higher": fresh >= committed * (1 - value)
-#   kind "lower":  fresh <= committed * (1 + value)
-#   kind "floor":  fresh >= value  (absolute, committed unused)
+#   kind "higher":  fresh >= committed * (1 - value)
+#   kind "lower":   fresh <= committed * (1 + value)
+#   kind "floor":   fresh >= value  (absolute, committed unused)
+#   kind "ceiling": fresh <= value  (absolute, committed unused)
 SPECS: dict[str, list[tuple[str, str, float]]] = {
     # The observability bench's own floor: aggregation+probing must keep
     # >= 0.95x of the unobserved throughput (ISSUE 16 acceptance).
     "BENCH_OBS.json": [
         ("overhead.ratio", "floor", 0.95),
         ("overhead.with_obs.rps", "higher", 0.30),
+    ],
+    # The elastic-fleet ramp's correctness invariants are absolute: no
+    # request may fail while the fleet resizes, every drain must quiesce
+    # (forced retirement is the chaos drill's territory, not the ramp's),
+    # the ramp must actually provoke a scale-up, and the fleet must be
+    # back at the floor when the artifact is cut.
+    "BENCH_SCALE.json": [
+        ("ramp.failures", "ceiling", 0.0),
+        ("scale.forced", "ceiling", 0.0),
+        ("scale.actual", "ceiling", 1.0),
+        ("journal.max_replicas_reached", "floor", 2.0),
+        ("ramp.completed", "higher", 0.30),
     ],
 }
 
@@ -113,6 +126,11 @@ def compare(committed: dict, fresh: dict,
             if got < value:
                 violations.append(
                     f"{path}: {got:g} below absolute floor {value:g}")
+            continue
+        if kind == "ceiling":
+            if got > value:
+                violations.append(
+                    f"{path}: {got:g} above absolute ceiling {value:g}")
             continue
         ref = c_leaves.get(path)
         if ref is None:
@@ -211,6 +229,16 @@ def selftest() -> int:
         assert "sequential.rps" in flat, flat
         assert "sequential.p95_ms" in flat, flat
         assert "overhead.ratio" in flat, flat
+
+        # Leg 2b: the absolute ceiling kind trips on its own (zero
+        # committed references carry no relative direction, so "a count
+        # that must stay zero" needs the absolute form).
+        verdict = compare({}, {"ramp": {"failures": 3.0}},
+                          [("ramp.failures", "ceiling", 0.0)])
+        assert verdict["violations"], "ceiling violation passed the gate"
+        verdict = compare({}, {"ramp": {"failures": 0.0}},
+                          [("ramp.failures", "ceiling", 0.0)])
+        assert not verdict["violations"], verdict
 
         # Leg 3: the one-noise-re-measure — the re-measure command
         # restores a good artifact, so the second diff passes.

@@ -496,6 +496,404 @@ def leg_cell_failover(root: Path) -> None:
             and e.get("restored")], "failover did not restore from spool"
 
 
+def _build_scale_fleet(root: Path, leg: str, jr, n: int = 1,
+                       poll_s: float = 0.05):
+    """An in-process elastic fleet for the autoscaler drills: real
+    ServeApp replicas + membership + router, with an in-process scaler
+    seam (spawn = fresh ServeApp + add_replica, retire = remove_replica
+    + stop).  In-process keeps the drills deterministic and cheap; the
+    supervised-process spawn path gets its own drill in
+    ``leg_fleet_scale_kill`` (the one leg where process death is the
+    point)."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import serve_bench
+
+    from eegnetreplication_tpu.serve.fleet import membership as fleet_ms
+    from eegnetreplication_tpu.serve.fleet.router import FleetRouter
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    leg_root = root / leg.replace(".", "_")
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    ckpt = serve_bench.make_synthetic_checkpoint(leg_root, 4, 64)
+
+    def _make_app():
+        return ServeApp(ckpt, port=0, buckets=(1, 8), max_wait_ms=1.0,
+                        journal=jr, trace_sample=0.0).start()
+
+    class InProcScaler:
+        def __init__(self, membership, apps):
+            self.membership = membership
+            self.apps: dict[str, ServeApp] = dict(apps)
+            self.next_i = n
+
+        def spawn(self):
+            i = self.next_i
+            self.next_i += 1
+            app = _make_app()
+            replica = fleet_ms.Replica(f"r{i}", app.url, journal=jr)
+            self.apps[replica.replica_id] = app
+            self.membership.add_replica(replica)
+            return replica
+
+        def retire(self, replica):
+            self.membership.remove_replica(replica)
+            app = self.apps.pop(replica.replica_id, None)
+            if app is not None:
+                app.stop()
+            return True
+
+        def stop_all(self):
+            for app in self.apps.values():
+                app.stop()
+
+    boot_apps = [_make_app() for _ in range(n)]
+    replicas = [fleet_ms.Replica(f"r{i}", app.url, journal=jr)
+                for i, app in enumerate(boot_apps)]
+    membership = fleet_ms.FleetMembership(replicas, poll_s=poll_s,
+                                          journal=jr)
+    scaler = InProcScaler(membership,
+                          {r.replica_id: app for r, app
+                           in zip(replicas, boot_apps)})
+    membership.start()
+    assert membership.wait_live(n, timeout_s=60.0)
+    router = FleetRouter(membership, journal=jr)
+    trials = np.random.RandomState(0).randn(16, 4, 64).astype(np.float32)
+    bodies = serve_bench._npz_bodies(trials, 2)
+    return membership, scaler, router, bodies
+
+
+def _overload_stats():
+    """A stats_fn pinning sustained overload (backlog-independent)."""
+    return {"arrival_rps": 100.0, "ok_rps": 10.0, "p95_ms": 50.0}
+
+
+def _idle_stats():
+    return {"arrival_rps": 0.0, "ok_rps": 0.0, "p95_ms": None}
+
+
+def leg_fleet_scale(root: Path) -> None:
+    """Armed spawn failure at the ``fleet.scale`` site: the scale-up
+    decision journals ``up`` then ``up_failed``, the fleet HOLDS (no
+    half-registered member), and the next decision — at the cooldown
+    cadence, never a hot loop — spawns successfully and joins live."""
+    import time
+
+    from eegnetreplication_tpu.serve.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+    )
+
+    with obs.run(root / "obs" / "fleet_scale") as jr:
+        membership, scaler, router, _ = _build_scale_fleet(
+            root, "fleet.scale", jr, n=1)
+        autoscaler = Autoscaler(
+            membership, scaler, _overload_stats,
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=2,
+                                    interval_s=0.05, up_cooldown_s=0.2,
+                                    down_cooldown_s=0.2), journal=jr)
+        try:
+            # The first tick both learns capacity (ok_rps 10 with 1 live
+            # -> 10) and decides: utilization 10 > 0.85 -> up -> the
+            # armed spawn fault fires.
+            with inject.scoped(inject.FaultSpec(site="fleet.scale",
+                                                times=1, if_tag="spawn")):
+                autoscaler.tick()           # decision -> injected failure
+                assert autoscaler.n_spawn_failures == 1
+                assert len(membership.replicas) == 1, \
+                    "failed spawn left a half-registered member"
+                autoscaler.tick()           # inside cooldown: must hold
+                assert autoscaler.n_ups == 1, "spawn retried in a hot loop"
+            time.sleep(0.25)
+            autoscaler.tick()               # cooldown over, site disarmed
+            assert len(membership.replicas) == 2
+            assert membership.wait_live(2, timeout_s=60.0), \
+                "second replica never joined live"
+        finally:
+            autoscaler.close()
+            membership.close()
+            router.close()
+            scaler.stop_all()
+    events = _events(jr)
+    scale = [(e["action"], e.get("reason")) for e in events
+             if e["event"] == "fleet_scale"]
+    actions = [a for a, _ in scale]
+    assert actions.count("up") == 2 and "up_failed" in actions, scale
+    assert actions.index("up_failed") < len(actions) - 1 - \
+        actions[::-1].index("up"), scale
+    fired = [e for e in events if e["event"] == "fault_injected"
+             and e.get("site") == "fleet.scale"]
+    assert len(fired) == 1, fired
+    joined = [e for e in events if e["event"] == "fleet_member"
+              and e.get("replica") == "r1" and e.get("state") == "live"]
+    assert joined, "r1 live transition not journaled"
+
+
+def leg_fleet_scale_kill(root: Path) -> None:
+    """SIGKILL mid-scale-up is REPLACED, never double-counted: a real
+    supervised replica spawned by the autoscaler is killed; the roster
+    math keeps counting the dead-but-committed member (the supervisor is
+    bringing it back), so overload ticks during the outage never spawn a
+    third replica on top of it."""
+    import os
+    import time
+
+    sys.path.insert(0, str(REPO / "scripts"))
+    import serve_bench
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.serve.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+    )
+    from eegnetreplication_tpu.serve.fleet.membership import FleetMembership
+    from eegnetreplication_tpu.serve.fleet.service import (
+        ReplicaScaler,
+        spawn_replica_fleet,
+    )
+
+    leg_root = root / "fleet_scale_kill"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    os.environ.setdefault("EEGTPU_COMPILE_CACHE",
+                          str(leg_root / "xla_cache"))
+    ckpt = serve_bench.make_synthetic_checkpoint(leg_root, 4, 64)
+    with obs.run(root / "obs" / "fleet_scale_kill") as jr:
+        sup, replicas = spawn_replica_fleet(
+            str(ckpt), 1, run_dir=leg_root / "fleet",
+            serve_args=["--maxWaitMs", "1"], journal=jr)
+        import threading
+
+        sup_thread = threading.Thread(target=sup.run, daemon=True)
+        sup_thread.start()
+        membership = FleetMembership(replicas, poll_s=0.1, journal=jr)
+        membership.start()
+        scaler = ReplicaScaler(sup, membership, checkpoint=str(ckpt),
+                               run_dir=leg_root / "fleet", journal=jr)
+        autoscaler = Autoscaler(
+            membership, scaler, _overload_stats,
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=2,
+                                    interval_s=0.05, up_cooldown_s=0.1,
+                                    down_cooldown_s=0.1), journal=jr)
+        try:
+            assert membership.wait_live(1, timeout_s=120.0)
+            # The first tick both learns capacity and decides: spawns r1.
+            autoscaler.tick()
+            assert len(membership.replicas) == 2
+            # Kill it the moment the supervisor has a pid — still
+            # JOINING, the middle of the scale-up join path.
+            deadline = time.monotonic() + 60.0
+            pid = None
+            while time.monotonic() < deadline:
+                child = sup.children.get("r1")
+                if child is not None and child.proc is not None:
+                    pid = child.proc.pid
+                    break
+                time.sleep(0.02)
+            assert pid is not None, "supervisor never launched r1"
+            os.kill(pid, 9)
+            # Overload continues through the outage: every tick is a
+            # chance to double-count.  The dead-but-committed member
+            # still counts toward the roster, so none of these may
+            # spawn r2 on top of it.
+            for _ in range(10):
+                time.sleep(0.15)
+                autoscaler.tick()
+            assert len(membership.replicas) == 2, (
+                f"SIGKILLed scale-up was double-counted: "
+                f"{[r.replica_id for r in membership.replicas]}")
+            assert "r2" not in sup.children, "spawned on top of the dead"
+            # The supervisor replaces it: same name, back to live.
+            assert serve_bench._wait_state(membership, "r1",
+                                           ("live",), 120.0) is not None, \
+                "killed replica was not replaced"
+        finally:
+            autoscaler.close()
+            membership.close()
+            sup.stop()
+            sup_thread.join(timeout=30.0)
+    events = _events(jr)
+    ups = [e for e in events if e["event"] == "fleet_scale"
+           and e["action"] == "up"]
+    assert len(ups) == 1, [(e["action"], e.get("reason")) for e in events
+                           if e["event"] == "fleet_scale"]
+    relaunches = [e for e in events if e["event"] == "supervisor_launch"
+                  and e.get("child") == "r1" and e.get("attempt", 1) >= 2]
+    assert relaunches, "supervisor never relaunched the killed replica"
+
+
+def leg_fleet_scale_resync(root: Path) -> None:
+    """Autoscaler restarted mid-decision resumes from MEMBERSHIP truth —
+    the journal is advisory, never authoritative.  A fresh Autoscaler
+    (given a journal with no prior fleet_scale history at all) finds a
+    pinned half-drained member and adopts the drain to completion, and
+    counts an in-flight JOINING member toward the roster instead of
+    spawning over it."""
+    import time
+
+    from eegnetreplication_tpu.serve.fleet import membership as fleet_ms
+    from eegnetreplication_tpu.serve.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+    )
+
+    with obs.run(root / "obs" / "fleet_scale_resync") as jr:
+        # A slow poll keeps the manufactured JOINING state standing until
+        # the new autoscaler's constructor resync reads it.
+        membership, scaler, router, _ = _build_scale_fleet(
+            root, "fleet_scale_resync", jr, n=3, poll_s=2.0)
+        try:
+            # Manufacture the mid-decision crash state a dead autoscaler
+            # leaves behind: r2 pinned + DRAINING (drain half done), r1
+            # knocked back to JOINING (a scale-up not yet live).
+            half_drained = membership.by_id("r2")
+            half_drained.pinned = True
+            membership.set_state(half_drained, fleet_ms.DRAINING,
+                                 "autoscale_drain")
+            joining = membership.by_id("r1")
+            membership.set_state(joining, fleet_ms.JOINING, "spawned")
+            # min_replicas=2 so the idle verdict cannot stack a fresh
+            # scale-down on top of the adopted one.
+            autoscaler = Autoscaler(
+                membership, scaler, _idle_stats,
+                policy=AutoscalerPolicy(min_replicas=2, max_replicas=3,
+                                        interval_s=0.05,
+                                        down_cooldown_s=10.0),
+                journal=jr)
+            try:
+                # First tick: the adopted drain completes and retires r2.
+                autoscaler.tick()
+                assert len(membership.replicas) == 2, \
+                    [r.replica_id for r in membership.replicas]
+                # r1 was adopted as a pending join, not spawned over:
+                # the roster math counted it throughout.
+                assert {r.replica_id for r in membership.replicas} \
+                    == {"r0", "r1"}
+                assert membership.wait_live(2, timeout_s=60.0)
+            finally:
+                autoscaler.close()
+        finally:
+            membership.close()
+            router.close()
+            scaler.stop_all()
+    events = _events(jr)
+    resyncs = [e for e in events if e["event"] == "fleet_scale"
+               and e["action"] == "resync"]
+    assert len(resyncs) == 1, resyncs
+    assert resyncs[0].get("adopted_drains") == ["r2"], resyncs
+    assert resyncs[0].get("pending_joins") == ["r1"], resyncs
+    kinds = [(e["action"], e.get("replica")) for e in events
+             if e["event"] == "fleet_scale"]
+    assert ("drained", "r2") in kinds or ("forced", "r2") in kinds, kinds
+    # No up decision: membership truth said the capacity was already
+    # committed.
+    assert not [k for k in kinds if k[0] == "up"], kinds
+
+
+def leg_fleet_drain(root: Path) -> None:
+    """Drain-under-load quiesces (journal: down -> drained with the
+    inflight=0 proof -> retired), and a drain that CANNOT quiesce —
+    in-flight work wedged past the timeout, with the armed ``fleet.scale``
+    ``tag="drain"`` sleep modeling the hang — times out into a FORCED
+    but fully journaled retirement, never a replica pinned DRAINING
+    forever."""
+    import threading
+    import time
+
+    from eegnetreplication_tpu.serve.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+    )
+
+    with obs.run(root / "obs" / "fleet_drain") as jr:
+        membership, scaler, router, bodies = _build_scale_fleet(
+            root, "fleet_drain", jr, n=3)
+        stats = {"arrival_rps": 100.0, "ok_rps": 100.0, "p95_ms": 20.0}
+        autoscaler = Autoscaler(
+            membership, scaler, lambda: dict(stats),
+            policy=AutoscalerPolicy(min_replicas=1, max_replicas=3,
+                                    interval_s=0.05, up_cooldown_s=0.1,
+                                    down_cooldown_s=0.1,
+                                    drain_timeout_s=2.0), journal=jr)
+        try:
+            # Seed the capacity estimate (ok 100/s over 3 live ~ 33/s
+            # each); at the ceiling, the overload verdict just holds.
+            autoscaler.tick()
+            stats["arrival_rps"] = 10.0     # utilization 0.1: shrink
+
+            # Clean drain under LIVE load: traffic keeps flowing while
+            # the victim quiesces.
+            stop_load = threading.Event()
+
+            def load():
+                while not stop_load.is_set():
+                    try:
+                        router.dispatch(bodies[0],
+                                        "application/octet-stream")
+                    except Exception:  # noqa: BLE001 — pacing only
+                        time.sleep(0.005)
+
+            loader = threading.Thread(target=load, daemon=True)
+            loader.start()
+            try:
+                autoscaler.tick()   # low utilization -> down -> drain
+            finally:
+                stop_load.set()
+                loader.join(timeout=10.0)
+            assert autoscaler.n_downs == 1 and autoscaler.n_forced == 0
+            assert len(membership.replicas) == 2
+
+            # Wedged drain: the next victim (deterministic — loads are
+            # zero again, ties prefer the highest index) takes an
+            # in-flight that never completes DURING its quiesce wait.
+            # The armed drain-tag slowdown holds the first poll open so
+            # the wedge lands mid-drain — exactly the window the drain
+            # timeout exists for.
+            live = [r for r in membership.dispatchable() if not r.pinned]
+            wedged = max(live, key=lambda r: int(r.replica_id[1:]))
+            wedge_timer = threading.Timer(0.1, wedged.begin)
+            time.sleep(0.15)                # past down_cooldown_s
+            with inject.scoped(inject.FaultSpec(
+                    site="fleet.scale", action="slow", slow=0.3,
+                    times=1, if_tag="drain")):
+                wedge_timer.start()
+                autoscaler.tick()   # down -> timeout -> forced
+            assert autoscaler.n_forced == 1
+            assert len(membership.replicas) == 1
+            assert not any(r.pinned for r in membership.replicas), \
+                "a replica stayed pinned after the drill"
+        finally:
+            autoscaler.close()
+            membership.close()
+            router.close()
+            scaler.stop_all()
+    events = _events(jr)
+    scale = [(e["action"], e.get("replica")) for e in events
+             if e["event"] == "fleet_scale"]
+    downs = [i for i, (a, _) in enumerate(scale) if a == "down"]
+    assert len(downs) == 2, scale
+    # First down drained with the quiesce proof; second was forced.
+    drained = [e for e in events if e["event"] == "fleet_scale"
+               and e["action"] == "drained"]
+    assert len(drained) == 1 and drained[0]["inflight"] == 0 \
+        and drained[0]["queue_depth"] == 0, drained
+    forced = [e for e in events if e["event"] == "fleet_scale"
+              and e["action"] == "forced"]
+    assert len(forced) == 1 and forced[0]["reason"] == "drain_timeout" \
+        and forced[0]["inflight"] >= 1, forced
+    # Journal-order proof for BOTH: verdict before the member's
+    # out/"retired" transition.
+    for verdict in (drained[0], forced[0]):
+        rid = verdict["replica"]
+        vi = events.index(verdict)
+        retired = [i for i, e in enumerate(events)
+                   if e["event"] == "fleet_member"
+                   and e.get("replica") == rid
+                   and e.get("state") == "out"
+                   and e.get("reason") == "retired"]
+        assert retired and vi < min(retired), (rid, vi, retired)
+
+
 def leg_combined(root: Path) -> None:
     """The acceptance drill: checkpoint.write corruption + train.step
     device fault + host.preempt on a 2-subject protocol; preempted mid-run,
@@ -557,6 +955,10 @@ LEGS = {
     "session.resume": leg_session_resume,
     "gray": leg_gray,
     "cell.failover": leg_cell_failover,
+    "fleet.scale": leg_fleet_scale,
+    "fleet.scale_kill": leg_fleet_scale_kill,
+    "fleet.scale_resync": leg_fleet_scale_resync,
+    "fleet.drain": leg_fleet_drain,
     "combined": leg_combined,
 }
 
@@ -565,7 +967,8 @@ LEGS = {
 # single-sourced here so a site rename (or a typo'd new leg) breaks the
 # drill at import, not by silently never matching a site.
 _SCENARIO_LEGS = ("supervisor.hang", "session.resume", "gray",
-                  "cell.failover", "combined")
+                  "cell.failover", "fleet.scale_kill",
+                  "fleet.scale_resync", "fleet.drain", "combined")
 _bad_legs = [name for name in LEGS
              if name not in _SCENARIO_LEGS and name not in inject.SITES]
 if _bad_legs:  # a plain raise survives python -O, an assert would not

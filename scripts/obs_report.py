@@ -114,6 +114,8 @@ _COLUMNS = (
     # dead/failing replicas, and the last rolling reload's outcome.
     ("fleet_replicas", "fleet"), ("fleet_failovers", "failovers"),
     ("fleet_reload_status", "fleet_reload"),
+    ("scale_ups", "scale_ups"), ("scale_downs", "scale_downs"),
+    ("forced_retires", "forced_retires"),
     # Multi-cell serving (cell_front_*/cell_member/session_migrate/
     # session_failover events): cell count, planned migrations, and
     # unplanned cross-cell session failovers.

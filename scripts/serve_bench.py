@@ -2259,6 +2259,418 @@ def run_fleet_bench(args) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Elastic-fleet bench (--scale): autoscaled ramp, BENCH_SCALE.json.
+# ---------------------------------------------------------------------------
+
+
+class _RampStats:
+    """The autoscaler's measured-load windows for the bench's ramp: the
+    pacer records every OFFERED request (arrival), workers record every
+    completion with its latency — the same two windows FleetApp keeps,
+    fed from the bench's own load generator."""
+
+    def __init__(self, window_s: float = 3.0):
+        from eegnetreplication_tpu.serve.admission import ArrivalWindow
+
+        self.window_s = float(window_s)
+        self.arrivals = ArrivalWindow(window_s=window_s)
+        self._lock = threading.Lock()
+        self._ok: list[tuple[float, float]] = []  # (t_mono, latency_ms)
+
+    def record_ok(self, latency_ms: float) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._ok.append((now, latency_ms))
+            horizon = now - self.window_s
+            while self._ok and self._ok[0][0] < horizon:
+                self._ok.pop(0)
+
+    def stats(self) -> dict:
+        from eegnetreplication_tpu.obs.stats import percentile
+
+        now = time.monotonic()
+        with self._lock:
+            horizon = now - self.window_s
+            while self._ok and self._ok[0][0] < horizon:
+                self._ok.pop(0)
+            latencies = [lat for _, lat in self._ok]
+        return {"arrival_rps": self.arrivals.rate(),
+                "ok_rps": len(latencies) / self.window_s,
+                "p95_ms": (percentile(latencies, 0.95)
+                           if latencies else None)}
+
+
+def run_paced_ramp(router, bodies: list[bytes], stats: _RampStats,
+                   profile: list[tuple[float, float, float]],
+                   submitters: int = 32) -> dict:
+    """Paced open-loop load: ``profile`` is linear-rate segments
+    ``(duration_s, start_rps, end_rps)``.  The pacer mints one request
+    per 1/rate(t) seconds (each minted request IS offered load, recorded
+    into the arrival window whether or not the fleet can absorb it);
+    workers drain the mint queue through ``router.dispatch`` with the
+    open-loop pacing semantics (429/AllReplicasBusy = brief sleep +
+    resubmit, anything else non-200 = failure).  Returns after every
+    minted request resolves — a saturated middle phase drains through
+    the tail segment."""
+    import queue as queue_mod
+
+    from eegnetreplication_tpu.serve.fleet.router import (
+        AllReplicasBusy,
+        NoLiveReplicas,
+    )
+
+    work: queue_mod.Queue = queue_mod.Queue()
+    lock = threading.Lock()
+    offered = [0]
+    completed = [0]
+    backpressure = [0]
+    failures: list[str] = []
+    latencies: list[tuple[float, float]] = []  # (wall_t_done, latency_ms)
+
+    def worker():
+        while True:
+            body = work.get()
+            if body is None:
+                return
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    status, _, _ = router.dispatch(
+                        body, "application/octet-stream")
+                except AllReplicasBusy:
+                    with lock:
+                        backpressure[0] += 1
+                    time.sleep(0.002)
+                    continue
+                except NoLiveReplicas as exc:
+                    with lock:
+                        failures.append(f"NoLiveReplicas: {exc}")
+                    break
+                except Exception as exc:  # noqa: BLE001 — tallied
+                    with lock:
+                        failures.append(f"{type(exc).__name__}: {exc}")
+                    break
+                if status == 200:
+                    ms = (time.perf_counter() - t0) * 1000.0
+                    stats.record_ok(ms)
+                    with lock:
+                        completed[0] += 1
+                        latencies.append((time.time(), ms))
+                    break
+                if status == 429:
+                    with lock:
+                        backpressure[0] += 1
+                    time.sleep(0.002)
+                    continue
+                with lock:
+                    failures.append(f"http {status}")
+                break
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(submitters)]
+    for th in threads:
+        th.start()
+    t0 = time.perf_counter()
+    tick = 0.02
+    tokens = 0.0
+    i = 0
+    for dur, r0, r1 in profile:
+        seg_start = time.monotonic()
+        while True:
+            elapsed = time.monotonic() - seg_start
+            if elapsed >= dur:
+                break
+            rate = r0 + (r1 - r0) * (elapsed / dur)
+            tokens += rate * tick
+            while tokens >= 1.0:
+                tokens -= 1.0
+                stats.arrivals.record(1)
+                with lock:
+                    offered[0] += 1
+                work.put(bodies[i % len(bodies)])
+                i += 1
+            time.sleep(tick)
+    # Sentinels queue BEHIND all minted work: join() returns only once
+    # every offered request has resolved (ok or failure).
+    for _ in threads:
+        work.put(None)
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    return {"offered": offered[0], "completed": completed[0],
+            "failures": len(failures), "failure_samples": failures[:3],
+            "backpressure_retries": backpressure[0],
+            "wall_s": round(wall, 2),
+            "latencies": latencies}
+
+
+def _scale_lag_windows(events: list[dict], cap_s: float = 60.0
+                       ) -> list[tuple[float, float]]:
+    """Journal-derived scale-up lag windows: each ``fleet_scale`` "up"
+    opens a window that closes when the NEXT replica joins live (the new
+    capacity actually arriving), capped at ``cap_s``.  The p95-vs-SLO
+    verdict excludes completions inside these windows — the bounded lag
+    the SLO contract concedes to elasticity."""
+    windows = []
+    for i, ev in enumerate(events):
+        if ev["event"] != "fleet_scale" or ev.get("action") != "up":
+            continue
+        t_up = ev.get("t")
+        if t_up is None:
+            continue
+        t_close = t_up + cap_s
+        for later in events[i + 1:]:
+            if later["event"] == "fleet_member" \
+                    and later.get("state") == "live" \
+                    and later.get("reason") == "joined" \
+                    and later.get("t") is not None:
+                t_close = min(t_close, later["t"] + 1.0)
+                break
+        windows.append((t_up, t_close))
+    return windows
+
+
+def _drain_proofs(events: list[dict]) -> list[dict]:
+    """Journal-order proof that every scale-down drained before its
+    retirement: for each ``down`` the stream must show ``drained`` (or
+    the explicit ``forced`` verdict) for that replica BEFORE its
+    ``fleet_member`` out/retired transition."""
+    proofs = []
+    for i, ev in enumerate(events):
+        if ev["event"] != "fleet_scale" or ev.get("action") != "down":
+            continue
+        rid = ev.get("replica")
+        verdict, verdict_at, retired_at = None, None, None
+        for j in range(i + 1, len(events)):
+            later = events[j]
+            if later["event"] == "fleet_scale" \
+                    and later.get("replica") == rid \
+                    and later.get("action") in ("drained", "forced") \
+                    and verdict is None:
+                verdict, verdict_at = later["action"], j
+            if later["event"] == "fleet_member" \
+                    and later.get("replica") == rid \
+                    and later.get("state") == "out" \
+                    and later.get("reason") == "retired":
+                retired_at = j
+                break
+        proofs.append({
+            "replica": rid, "verdict": verdict,
+            "proven": (verdict is not None and retired_at is not None
+                       and verdict_at < retired_at)})
+    return proofs
+
+
+def run_scale_bench(args) -> int:
+    """The --scale mode: one replica, measure saturation, then a paced
+    0 -> 2x-saturation -> 0 ramp under the live autoscaler; write
+    BENCH_SCALE.json with the journal-derived drain proof."""
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    platform = select_platform()
+    os.environ.setdefault("EEGTPU_PLATFORM", platform)
+
+    import jax
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.obs import schema as obs_schema
+    from eegnetreplication_tpu.obs.schema import write_json_artifact
+    from eegnetreplication_tpu.obs.stats import percentile
+    from eegnetreplication_tpu.serve.engine import load_model_from_checkpoint
+    from eegnetreplication_tpu.serve.fleet.autoscaler import (
+        Autoscaler,
+        AutoscalerPolicy,
+    )
+    from eegnetreplication_tpu.serve.fleet.membership import FleetMembership
+    from eegnetreplication_tpu.serve.fleet.router import FleetRouter
+    from eegnetreplication_tpu.serve.fleet.service import (
+        ReplicaScaler,
+        spawn_replica_fleet,
+    )
+
+    tmp = Path(args.workDir) if args.workDir \
+        else Path(tempfile.mkdtemp(prefix="scale_bench_"))
+    tmp.mkdir(parents=True, exist_ok=True)
+    # The compile cache is what makes elastic spawn cheap: replica 1's
+    # boot populates it, every scale-up replays the executables.
+    os.environ.setdefault("EEGTPU_COMPILE_CACHE", str(tmp / "xla_cache"))
+    checkpoint = (Path(args.checkpoint) if args.checkpoint
+                  else make_synthetic_checkpoint(tmp, args.channels,
+                                                 args.times))
+    batch = max(1, args.fleetBatch)
+    model, _, _ = load_model_from_checkpoint(checkpoint)
+    c, t = model.n_channels, model.n_times
+    rng = np.random.RandomState(0)
+    trials = rng.randn(max(64, 4 * batch), c, t).astype(np.float32)
+    bodies = _npz_bodies(trials, batch)
+    serve_args = ["--maxWaitMs", str(args.maxWaitMs),
+                  "--maxQueue", str(max(512, 8 * batch)),
+                  "--buckets", f"1,8,{max(16, 2 * batch)}",
+                  "--traceSample", "0"]
+
+    with obs_journal.run(tmp / "obs", config={"mode": "scale"},
+                         role="scale_bench") as journal:
+        sup, replicas = spawn_replica_fleet(
+            checkpoint, 1, run_dir=tmp / "fleet", serve_args=serve_args,
+            journal=journal)
+        sup_thread = threading.Thread(target=sup.run, daemon=True,
+                                      name="scale-bench-supervisor")
+        sup_thread.start()
+        membership = FleetMembership(replicas, poll_s=0.1, journal=journal)
+        membership.start()
+        record: dict = {
+            "platform": jax.default_backend(),
+            "checkpoint": str(checkpoint),
+            "geometry": {"n_channels": c, "n_times": t},
+            "request_batch": batch,
+            "selftest": bool(args.selftest),
+        }
+        problems: list[str] = []
+        autoscaler = None
+        try:
+            if not membership.wait_live(1, timeout_s=300.0):
+                raise RuntimeError("seed replica never came live")
+            router = FleetRouter(membership, journal=journal)
+
+            # Saturation denominator: closed-throughput of ONE replica.
+            warm = run_fleet_open_loop(router, bodies, 80,
+                                       submitters=args.fleetSubmitters)
+            sat = run_fleet_open_loop(router, bodies,
+                                      max(160, args.fleetRequests // 2),
+                                      submitters=args.fleetSubmitters)
+            sat_rps = max(sat["rps"], 1.0)
+            record["saturation"] = {"rps": sat_rps,
+                                    "warm_rps": warm["rps"]}
+            print(f"--- saturation (1 replica): {sat_rps} req/s",
+                  flush=True)
+
+            stats = _RampStats()
+            scaler = ReplicaScaler(sup, membership,
+                                   checkpoint=str(checkpoint),
+                                   run_dir=tmp / "fleet",
+                                   serve_args=serve_args, journal=journal)
+            policy = AutoscalerPolicy(
+                min_replicas=1, max_replicas=args.scaleMax,
+                interval_s=0.2, up_cooldown_s=1.5, down_cooldown_s=2.5,
+                drain_timeout_s=10.0, capacity_decay=0.05)
+            autoscaler = Autoscaler(membership, scaler, stats.stats,
+                                    policy=policy, journal=journal)
+            autoscaler.start()
+
+            peak = 2.0 * sat_rps
+            profile = [(args.scaleRampS, 0.0, peak),
+                       (args.scaleHoldS, peak, peak),
+                       (args.scaleRampS, peak, 0.0),
+                       (args.scaleTailS, 0.0, 0.0)]
+            record["ramp_profile"] = {
+                "peak_rps": round(peak, 1),
+                "up_s": args.scaleRampS, "hold_s": args.scaleHoldS,
+                "down_s": args.scaleRampS, "tail_s": args.scaleTailS}
+            print(f"--- ramp: 0 -> {peak:.0f} -> 0 req/s over "
+                  f"{2 * args.scaleRampS + args.scaleHoldS:.0f}s "
+                  f"(+{args.scaleTailS:.0f}s tail)", flush=True)
+            ramp = run_paced_ramp(router, bodies, stats, profile,
+                                  submitters=max(
+                                      16, args.fleetSubmitters * 2))
+            latencies = ramp.pop("latencies")
+
+            # Give the (now idle) fleet time to shrink back to the floor.
+            deadline = time.monotonic() + 45.0
+            while time.monotonic() < deadline:
+                if autoscaler.snapshot()["actual"] <= policy.min_replicas:
+                    break
+                time.sleep(0.2)
+            scale_snap = autoscaler.snapshot()
+            record["ramp"] = ramp
+            record["scale"] = scale_snap
+            print(f"--- ramp done: {ramp['completed']}/{ramp['offered']} "
+                  f"ok, {ramp['failures']} failures; scale "
+                  f"ups={scale_snap['ups']} downs={scale_snap['downs']} "
+                  f"forced={scale_snap['forced']} "
+                  f"final={scale_snap['actual']}", flush=True)
+        finally:
+            if autoscaler is not None:
+                autoscaler.close()
+            membership.close()
+            sup.stop()
+            sup_thread.join(timeout=60.0)
+
+        journal.flush_metrics()
+        events = obs_schema.read_events(journal.events_path,
+                                        complete=False, lenient_tail=True)
+
+    scale_evs = [e for e in events if e["event"] == "fleet_scale"]
+    targets = [e["target"] for e in scale_evs
+               if e.get("action") in ("resync", "up", "down")]
+    proofs = _drain_proofs(events)
+    lag_windows = _scale_lag_windows(events)
+    in_lag = [ms for t_done, ms in latencies
+              if any(lo <= t_done <= hi for lo, hi in lag_windows)]
+    outside = [ms for t_done, ms in latencies
+               if not any(lo <= t_done <= hi for lo, hi in lag_windows)]
+    record["journal"] = {
+        "fleet_scale_events": len(scale_evs),
+        "replica_trajectory": targets,
+        "max_replicas_reached": max(targets, default=1),
+        "drain_proofs": proofs,
+        "all_drains_proven": all(p["proven"] for p in proofs),
+        "scale_up_lag_windows": [[round(a, 2), round(b, 2)]
+                                 for a, b in lag_windows]}
+    record["latency"] = {
+        "slo_ms": args.scaleSloMs,
+        "n_outside_lag": len(outside), "n_in_lag": len(in_lag),
+        "p95_outside_lag_ms": (round(percentile(outside, 0.95), 2)
+                               if outside else None),
+        "p95_in_lag_ms": (round(percentile(in_lag, 0.95), 2)
+                          if in_lag else None)}
+
+    out = Path(args.scaleOut) if args.scaleOut else (
+        Path(tempfile.mkstemp(suffix=".json", prefix="BENCH_SCALE_")[1])
+        if args.selftest else REPO / "BENCH_SCALE.json")
+    write_json_artifact(out, record, indent=1)
+    print(f"wrote {out}")
+    print(json.dumps({
+        "max_replicas": record["journal"]["max_replicas_reached"],
+        "final_replicas": record["scale"]["actual"],
+        "failures": record["ramp"]["failures"],
+        "all_drains_proven": record["journal"]["all_drains_proven"],
+        "p95_outside_lag_ms": record["latency"]["p95_outside_lag_ms"]}))
+
+    if args.selftest:
+        ramp = record["ramp"]
+        if ramp["failures"]:
+            problems.append(f"{ramp['failures']} failed requests during "
+                            f"the ramp ({ramp['failure_samples']})")
+        if ramp["completed"] != ramp["offered"]:
+            problems.append(
+                f"request accounting mismatch: {ramp['completed']} "
+                f"completed != {ramp['offered']} offered")
+        if record["journal"]["max_replicas_reached"] < 2:
+            problems.append("fleet never scaled above 1 replica")
+        if record["scale"]["actual"] != 1:
+            problems.append(f"fleet did not shrink back to 1 "
+                            f"(final {record['scale']['actual']})")
+        if record["scale"]["downs"] < 1:
+            problems.append("no scale-down decision journaled")
+        if not record["journal"]["all_drains_proven"]:
+            problems.append(f"unproven drains: "
+                            f"{record['journal']['drain_proofs']}")
+        if record["scale"]["forced"]:
+            problems.append(f"{record['scale']['forced']} forced "
+                            f"retirement(s) — drains must quiesce")
+        p95_out = record["latency"]["p95_outside_lag_ms"]
+        if len(outside) >= 30 and p95_out is not None \
+                and p95_out > args.scaleSloMs:
+            problems.append(f"p95 outside scale-up lag "
+                            f"{p95_out}ms > SLO {args.scaleSloMs}ms")
+        if problems:
+            print("SELFTEST FAIL: " + "; ".join(problems))
+            return 1
+        print("SELFTEST PASS")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # Multi-cell bench (--cells): CellFront + migration/failover, BENCH_CELLS.json.
 # ---------------------------------------------------------------------------
 
@@ -2738,7 +3150,38 @@ def main(argv=None) -> int:
     parser.add_argument("--fleetShadowN", type=int, default=8,
                         help="Shadow-compare sample size for the rolling "
                              "reload leg.")
+    parser.add_argument("--scale", action="store_true",
+                        help="Elastic-fleet bench: one replica + live "
+                             "autoscaler under a paced 0 -> 2x-saturation "
+                             "-> 0 ramp; writes BENCH_SCALE.json with the "
+                             "journal-derived drain-safety proof.")
+    parser.add_argument("--scaleOut", default=None,
+                        help="BENCH_SCALE.json path (default: repo root; "
+                             "a tempfile under --selftest).")
+    parser.add_argument("--scaleMax", type=int, default=3,
+                        help="Autoscaler ceiling during the ramp.")
+    parser.add_argument("--scaleRampS", type=float, default=10.0,
+                        help="Up- and down-ramp duration, each.")
+    parser.add_argument("--scaleHoldS", type=float, default=8.0,
+                        help="Hold duration at the 2x-saturation peak.")
+    parser.add_argument("--scaleTailS", type=float, default=12.0,
+                        help="Idle tail after the ramp (scale-down room).")
+    parser.add_argument("--scaleSloMs", type=float, default=2000.0,
+                        help="p95 SLO asserted OUTSIDE the journal-derived "
+                             "scale-up lag windows.")
     args = parser.parse_args(argv)
+
+    if args.scale:
+        if args.scaleMax < 2:
+            parser.error("--scale needs --scaleMax >= 2 (a ceiling of 1 "
+                         "cannot autoscale)")
+        if args.selftest:
+            args.channels, args.times = 4, 64
+            args.scaleRampS = min(args.scaleRampS, 6.0)
+            args.scaleHoldS = min(args.scaleHoldS, 5.0)
+            args.scaleTailS = min(args.scaleTailS, 10.0)
+            args.fleetRequests = min(args.fleetRequests, 320)
+        return run_scale_bench(args)
 
     if args.zoo:
         if args.zooTenants < 2:

@@ -1,0 +1,384 @@
+"""Elastic autoscaling control plane (``serve/fleet/autoscaler.py``).
+
+Covers the ISSUE-17 unit surface: the SLO-driven control law (capacity
+estimation, hysteresis bands, cooldowns, max-step), the journaled
+``fleet_scale`` decision stream and its drain-safety ordering proof,
+membership-truth resync (journal advisory, never authoritative),
+stillborn-join reaping, dynamic fleet membership (atomic add/remove,
+pinned-drain poll exemption), the router-edge ``ArrivalWindow``, and the
+observability fold (``event_summary`` counters, ``FleetState`` scale
+column, ``eegtpu-top`` rendering).
+
+Everything here is deterministic: real ``FleetMembership``/``Replica``
+state machines with the health poller never started, a fake scaler seam,
+and an injectable clock — the autoscaler's ``tick()`` is public exactly
+so the loop can be driven without threads.  The end-to-end truth (real
+processes, SIGKILL, paced ramp) lives in ``serve_bench.py --scale`` and
+the ``fleet.*`` chaos-drill legs.
+"""
+
+import pytest
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.obs.agg import FleetState
+from eegnetreplication_tpu.obs.top import _HEADERS, _run_row
+from eegnetreplication_tpu.serve.admission import ArrivalWindow
+from eegnetreplication_tpu.serve.fleet import membership as ms
+from eegnetreplication_tpu.serve.fleet.autoscaler import (
+    Autoscaler,
+    AutoscalerPolicy,
+)
+
+# A port nothing listens on: connection-refused, instantly.
+DEAD_URL = "http://127.0.0.1:9/"
+
+
+def _fake_clock():
+    t = {"v": 0.0}
+    return t, (lambda: t["v"]), (lambda s: t.__setitem__("v", t["v"] + s))
+
+
+def _replica(rid, jr, state=ms.LIVE):
+    r = ms.Replica(rid, DEAD_URL, journal=jr)
+    r.state = state
+    return r
+
+
+class FakeScaler:
+    """The autoscaler's action seam, minus processes: spawn registers a
+    JOINING member, retire removes it — both against the REAL membership
+    state machine."""
+
+    def __init__(self, membership, jr, fail_spawns=0):
+        self.membership = membership
+        self.jr = jr
+        self.fail_spawns = fail_spawns
+        self.next_i = len(membership.replicas)
+        self.retired = []
+
+    def spawn(self):
+        if self.fail_spawns > 0:
+            self.fail_spawns -= 1
+            raise RuntimeError("spawn boom")
+        replica = ms.Replica(f"r{self.next_i}", DEAD_URL, journal=self.jr)
+        self.next_i += 1
+        self.membership.add_replica(replica)
+        return replica
+
+    def retire(self, replica):
+        self.membership.remove_replica(replica)
+        self.retired.append(replica.replica_id)
+        return True
+
+
+def _fleet(jr, n=1, state=ms.LIVE, poll_s=60.0):
+    replicas = [_replica(f"r{i}", jr, state=state) for i in range(n)]
+    membership = ms.FleetMembership(replicas, poll_s=poll_s, journal=jr)
+    return membership, FakeScaler(membership, jr)
+
+
+def _scale_events(jr):
+    events = schema.read_events(jr.events_path, complete=False)
+    assert not any("_schema_error" in e for e in events), events
+    return events, [e for e in events if e["event"] == "fleet_scale"]
+
+
+class TestControlLaw:
+    def _autoscaler(self, mem, scaler, stats, jr, clock, sleep, **policy):
+        policy.setdefault("min_replicas", 1)
+        policy.setdefault("max_replicas", 3)
+        policy.setdefault("interval_s", 0.05)
+        policy.setdefault("up_cooldown_s", 2.0)
+        policy.setdefault("down_cooldown_s", 2.0)
+        return Autoscaler(mem, scaler, lambda: dict(stats),
+                          policy=AutoscalerPolicy(**policy), journal=jr,
+                          clock=clock, sleep=sleep)
+
+    def test_up_on_utilization_with_cooldown_and_ceiling(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=1)
+            t, clock, sleep = _fake_clock()
+            stats = {"arrival_rps": 100.0, "ok_rps": 10.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep)
+            a.tick()  # capacity 10/replica -> utilization 10 -> up
+            assert [r.replica_id for r in mem.replicas] == ["r0", "r1"]
+            assert a.n_ups == 1
+            a.tick()  # same instant: inside the up cooldown, hold
+            assert a.n_ups == 1 and len(mem.replicas) == 2
+            t["v"] = 2.5
+            a.tick()  # cooldown over, still saturated -> up again
+            assert [r.replica_id for r in mem.replicas] \
+                == ["r0", "r1", "r2"]
+            t["v"] = 5.0
+            a.tick()  # at max_replicas: hold forever
+            assert len(mem.replicas) == 3 and a.n_ups == 2
+            mem.close()
+        events, scales = _scale_events(jr)
+        ups = [e for e in scales if e["action"] == "up"]
+        assert len(ups) == 2
+        # The decision carries its full input snapshot.
+        assert ups[0]["capacity_rps"] == 10.0
+        assert ups[0]["utilization"] == 10.0
+        assert ups[0]["members"] == {"r0": "live"}
+        assert scales[0]["action"] == "resync"
+
+    def test_spawn_failure_journals_holds_and_retries(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=1)
+            scaler.fail_spawns = 1
+            t, clock, sleep = _fake_clock()
+            stats = {"arrival_rps": 100.0, "ok_rps": 10.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep,
+                                 max_replicas=2)
+            a.tick()  # decision -> spawn raises
+            assert a.n_spawn_failures == 1
+            assert len(mem.replicas) == 1, "failed spawn left a member"
+            a.tick()  # cooldown: the retry is paced, never a hot loop
+            assert a.n_ups == 1
+            t["v"] = 2.5
+            a.tick()  # cooldown over -> clean spawn
+            assert [r.replica_id for r in mem.replicas] == ["r0", "r1"]
+            mem.close()
+        _, scales = _scale_events(jr)
+        assert [e["action"] for e in scales] \
+            == ["resync", "up", "up_failed", "up"]
+
+    def test_down_drains_and_journal_proves_ordering(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=2)
+            t, clock, sleep = _fake_clock()
+            # capacity 20/replica, arrival 2 -> utilization 0.05.
+            stats = {"arrival_rps": 2.0, "ok_rps": 40.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep)
+            a.tick()
+            assert a.n_downs == 1 and a.n_forced == 0
+            assert [r.replica_id for r in mem.replicas] == ["r0"]
+            assert scaler.retired == ["r1"]  # ties retire the high index
+            a.tick()  # at min_replicas (and n_live == 1): hold
+            assert a.n_downs == 1
+            mem.close()
+        events, scales = _scale_events(jr)
+        assert [e["action"] for e in scales] \
+            == ["resync", "down", "drained"]
+        assert scales[1]["replica"] == scales[2]["replica"] == "r1"
+        assert scales[2]["inflight"] == 0 and scales[2]["queue_depth"] == 0
+        # The drain-safety ordering invariant: decision -> quiesce proof
+        # -> the member's out/"retired" transition, in the journal.
+        i_down = events.index(scales[1])
+        i_drained = events.index(scales[2])
+        i_retired = next(i for i, e in enumerate(events)
+                         if e["event"] == "fleet_member"
+                         and e.get("replica") == "r1"
+                         and e.get("state") == "out"
+                         and e.get("reason") == "retired")
+        assert i_down < i_drained < i_retired
+
+    def test_adopted_drain_times_out_into_forced_retirement(self,
+                                                            tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=2)
+            wedged = mem.by_id("r1")
+            wedged.pinned = True
+            wedged.state = ms.DRAINING
+            wedged.begin()  # an in-flight that never completes
+            t, clock, sleep = _fake_clock()
+            stats = {"arrival_rps": 0.0, "ok_rps": 0.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep,
+                                 min_replicas=1, drain_timeout_s=1.0)
+            a.tick()  # resumes the adopted drain; the fake clock walks
+            assert a.n_forced == 1  # it past the timeout
+            assert scaler.retired == ["r1"]
+            assert t["v"] >= 1.0
+            assert not any(r.pinned for r in mem.replicas)
+            mem.close()
+        _, scales = _scale_events(jr)
+        resync = scales[0]
+        assert resync["action"] == "resync"
+        assert resync["adopted_drains"] == ["r1"]
+        forced = [e for e in scales if e["action"] == "forced"]
+        assert len(forced) == 1
+        assert forced[0]["reason"] == "drain_timeout"
+        assert forced[0]["inflight"] == 1
+        assert not any(e["action"] == "drained" for e in scales)
+
+    def test_stillborn_join_is_reaped(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=1)
+            t, clock, sleep = _fake_clock()
+            stats = {"arrival_rps": 100.0, "ok_rps": 10.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep,
+                                 join_timeout_s=5.0)
+            a.tick()  # spawns r1; it stays JOINING (nothing polls)
+            assert len(mem.replicas) == 2
+            stats.update(arrival_rps=0.0, ok_rps=0.0)
+            t["v"] = 10.0
+            a.tick()  # past join_timeout_s: reap the stillborn
+            assert [r.replica_id for r in mem.replicas] == ["r0"]
+            assert scaler.retired == ["r1"]
+            mem.close()
+        _, scales = _scale_events(jr)
+        stillborn = [e for e in scales if e["action"] == "up_failed"]
+        assert len(stillborn) == 1
+        assert stillborn[0]["reason"] == "stillborn"
+        assert stillborn[0]["replica"] == "r1"
+
+    def test_anti_flap_guard_blocks_marginal_down(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=2)
+            t, clock, sleep = _fake_clock()
+            # capacity 10/replica.  utilization 0.44 is below the 0.45
+            # band, but post-removal it would be 0.88 > 0.5: removing
+            # the replica would immediately re-trigger a scale-up.
+            stats = {"arrival_rps": 8.8, "ok_rps": 20.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep,
+                                 up_threshold=0.5, down_threshold=0.45)
+            a.tick()
+            assert a.n_downs == 0 and len(mem.replicas) == 2
+            stats["arrival_rps"] = 4.0  # 0.2 / projected 0.4: clear
+            a.tick()
+            assert a.n_downs == 1 and len(mem.replicas) == 1
+            mem.close()
+
+    def test_idle_fleet_never_shrinks_below_min(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, scaler = _fleet(jr, n=1)
+            t, clock, sleep = _fake_clock()
+            stats = {"arrival_rps": 0.0, "ok_rps": 0.0, "p95_ms": None}
+            a = self._autoscaler(mem, scaler, stats, jr, clock, sleep)
+            for _ in range(5):
+                a.tick()
+                t["v"] += 5.0
+            assert a.n_downs == 0 and len(mem.replicas) == 1
+            mem.close()
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(up_threshold=0.3, down_threshold=0.4)
+        with pytest.raises(ValueError):
+            AutoscalerPolicy(interval_s=0.0)
+
+
+class TestDynamicMembership:
+    def test_add_replica_joins_gated_and_duplicate_raises(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, _ = _fleet(jr, n=1)
+            fresh = ms.Replica("r1", DEAD_URL, journal=jr)
+            mem.add_replica(fresh)
+            # New members enter through the JOINING health gate, never
+            # straight into rotation.
+            assert fresh.state == ms.JOINING
+            assert fresh not in mem.dispatchable()
+            assert [r.replica_id for r in mem.replicas] == ["r0", "r1"]
+            with pytest.raises(ValueError):
+                mem.add_replica(ms.Replica("r1", DEAD_URL, journal=jr))
+            mem.close()
+
+    def test_remove_replica_journals_retired_once(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, _ = _fleet(jr, n=2)
+            r1 = mem.by_id("r1")
+            mem.remove_replica(r1)
+            assert [r.replica_id for r in mem.replicas] == ["r0"]
+            mem.remove_replica(r1)  # idempotent, no second transition
+            mem.close()
+        events = schema.read_events(jr.events_path, complete=False)
+        retired = [e for e in events if e["event"] == "fleet_member"
+                   and e.get("replica") == "r1"
+                   and e.get("state") == "out"
+                   and e.get("reason") == "retired"]
+        assert len(retired) == 1
+
+    def test_pinned_drain_is_exempt_from_health_verdicts(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, _ = _fleet(jr, n=1)
+            victim = mem.by_id("r0")
+            victim.pinned = True
+            victim.state = ms.DRAINING
+            # The replica is healthy ON PURPOSE while its in-flight work
+            # quiesces; re-LIVE-ing it would hand it new dispatches
+            # mid-retirement.  Pinned blocks exactly that verdict.
+            victim.client.request = lambda *a, **k: (200, b"{}")
+            mem.poll_once()
+            assert victim.state == ms.DRAINING
+            victim.pinned = False
+            mem.poll_once()
+            assert victim.state == ms.LIVE
+            mem.close()
+
+    def test_pinning_does_not_mask_death(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            mem, _ = _fleet(jr, n=1)
+            victim = mem.by_id("r0")
+            victim.pinned = True
+            victim.state = ms.DRAINING
+            # The URL is dead: the process behind the drain crashed.
+            # Pinning holds the replica OUT of rotation, not ON life
+            # support — the poller still pulls a corpse.
+            mem.fail_threshold = 1
+            mem.poll_once()
+            assert victim.state == ms.OUT
+            mem.close()
+
+
+class TestArrivalWindow:
+    def test_rate_over_full_window_and_pruning(self):
+        t = {"v": 0.0}
+        w = ArrivalWindow(window_s=2.0, clock=lambda: t["v"])
+        w.record()
+        w.record(3)
+        # 4 arrivals over the FULL 2 s window — a just-started burst
+        # reads low-but-rising, not as an instant spike.
+        assert w.rate() == pytest.approx(2.0)
+        t["v"] = 1.9
+        assert w.rate() == pytest.approx(2.0)
+        t["v"] = 2.5  # the burst ages out of the window
+        assert w.rate() == 0.0
+
+    def test_window_validation(self):
+        with pytest.raises(ValueError):
+            ArrivalWindow(window_s=0.0)
+
+
+class TestScaleObservability:
+    _SCALES = [
+        {"event": "fleet_scale", "t": 95.0, "run_id": "ra", "action": "up",
+         "target": 2, "n_live": 1, "reason": "utilization 1.2 > 0.85"},
+        {"event": "fleet_scale", "t": 96.0, "run_id": "ra",
+         "action": "down", "target": 1, "n_live": 2,
+         "reason": "utilization 0.1 < 0.40", "replica": "r1"},
+        {"event": "fleet_scale", "t": 96.5, "run_id": "ra",
+         "action": "forced", "target": 1, "n_live": 1,
+         "reason": "drain_timeout", "replica": "r1"},
+    ]
+
+    def test_schema_requires_the_decision_keys(self):
+        ok = schema.validate_event(dict(self._SCALES[0]))
+        assert ok["action"] == "up"
+        missing = {k: v for k, v in self._SCALES[0].items()
+                   if k != "reason"}
+        with pytest.raises(schema.SchemaError):
+            schema.validate_event(missing)
+
+    def test_event_summary_counts_scale_actions(self):
+        out = schema.event_summary(list(self._SCALES))
+        assert out["scale_ups"] == 1
+        assert out["scale_downs"] == 1
+        assert out["forced_retires"] == 1
+
+    def test_fleet_state_folds_scale_and_top_renders_it(self):
+        state = FleetState(window_s=60.0, clock=lambda: 100.0)
+        state.fold("runA", [
+            {"event": "run_start", "t": 90.0, "run_id": "ra",
+             "platform": "cpu"},
+            *self._SCALES,
+        ])
+        run = state.snapshot()["runs"][0]
+        assert run["scale"] == {"target": 1, "actual": 1, "ups": 1,
+                                "downs": 1, "forced": 1}
+        row = _run_row(run)
+        assert row[_HEADERS.index("scale")] == "1/1"
+        # A run with no scale events renders a placeholder, not a crash.
+        assert _run_row({})[_HEADERS.index("scale")] == "-"

@@ -1156,6 +1156,110 @@ class TestMultiSupervisor:
         assert exits[0]["classification"] == "hang"
 
 
+class TestDynamicMultiSupervisor:
+    """ISSUE-17 satellite: the dynamic membership seam the autoscaler
+    drives — ``add_child`` mid-run joins the supervision loop without
+    disturbing siblings, ``retire_child`` removes exactly the named child
+    with a clean ``supervisor_exit``, and a retired name re-added gets a
+    brand-new child whose crash-loop breaker state is forgotten."""
+
+    BEATING_CHILD = TestMultiSupervisor.BEATING_CHILD
+    _policy = TestMultiSupervisor._policy
+    _specs = TestMultiSupervisor._specs
+    _wait = staticmethod(TestMultiSupervisor._wait)
+
+    def _spec(self, tmp_path, name, body):
+        return self._specs(tmp_path, {name: body})[0]
+
+    def test_add_child_under_load_and_only_it_restarts(self, tmp_path):
+        specs = self._specs(tmp_path, {"c0": self.BEATING_CHILD})
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.MultiSupervisor(
+                specs, policy=self._policy(
+                    thresholds={"step": 2.0, "startup": 30.0}),
+                journal=jr)
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            self._wait(lambda: sup.children["c0"].state == "running",
+                       what="anchor child running")
+            sup.add_child(self._spec(tmp_path, "c1", self.BEATING_CHILD))
+            with pytest.raises(ValueError):
+                sup.add_child(self._spec(tmp_path, "c1",
+                                         self.BEATING_CHILD))
+            self._wait(lambda: "c1" in sup.children
+                       and sup.children["c1"].state == "running",
+                       what="added child running")
+            os.kill(sup.children["c1"].pid, 9)
+            self._wait(lambda: sup.children["c1"].attempt == 2
+                       and sup.children["c1"].state == "running",
+                       what="added child relaunch")
+            assert sup.children["c0"].attempt == 1
+            sup.stop()
+            th.join(timeout=15.0)
+        events = schema.read_events(jr.events_path, complete=False)
+        restarts = [e for e in events if e["event"] == "supervisor_restart"]
+        assert [e["child"] for e in restarts] == ["c1"]
+        assert not any("_schema_error" in e for e in events)
+
+    def test_retire_child_removes_only_the_named_child(self, tmp_path):
+        specs = self._specs(tmp_path, {f"c{i}": self.BEATING_CHILD
+                                       for i in range(2)})
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.MultiSupervisor(
+                specs, policy=self._policy(
+                    thresholds={"step": 2.0, "startup": 30.0}),
+                journal=jr)
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            self._wait(lambda: all(
+                c.state == "running" for c in sup.children.values()),
+                what="both children running")
+            assert sup.retire_child("c1", wait_s=15.0)
+            assert "c1" not in sup.children
+            assert sup.retire_child("c1")  # idempotent: already gone
+            # The sibling never bounced — retirement is surgical.
+            assert sup.children["c0"].state == "running"
+            assert sup.children["c0"].attempt == 1
+            sup.stop()
+            th.join(timeout=15.0)
+        events = schema.read_events(jr.events_path, complete=False)
+        retired = [e for e in events if e["event"] == "supervisor_exit"
+                   and e.get("classification") == "retired"]
+        assert [e["child"] for e in retired] == ["c1"]
+        assert not any(e["event"] == "supervisor_restart" for e in events)
+
+    def test_breaker_state_is_forgotten_on_re_add(self, tmp_path):
+        specs = self._specs(tmp_path, {"anchor": self.BEATING_CHILD,
+                                       "flaky": "import sys; sys.exit(1)\n"})
+        with obs.run(tmp_path / "obs") as jr:
+            sup = supervise.MultiSupervisor(
+                specs, policy=self._policy(
+                    max_restarts=50, restart_window_s=60.0,
+                    backoff=retry.RetryPolicy(
+                        max_attempts=1_000_000, base_delay_s=0.25,
+                        jitter=0.0),
+                    thresholds={"step": 30.0, "startup": 30.0}),
+                journal=jr)
+            th = threading.Thread(target=sup.run, daemon=True)
+            th.start()
+            # Let the flaky child bank crashes in the breaker window.
+            self._wait(lambda: "flaky" in sup.children
+                       and sup.children["flaky"].attempt >= 2,
+                       what="flaky child crashing")
+            assert sup.retire_child("flaky", wait_s=15.0)
+            sup.add_child(self._spec(tmp_path, "flaky",
+                                     self.BEATING_CHILD))
+            # The re-added name is a NEW child: attempt restarts at 1 and
+            # the banked crash history cannot push it into the breaker.
+            self._wait(lambda: sup.children["flaky"].state == "running",
+                       what="re-added child running")
+            assert sup.children["flaky"].attempt == 1
+            sup.stop()
+            th.join(timeout=15.0)
+        events = schema.read_events(jr.events_path, complete=False)
+        assert not any(e["event"] == "supervisor_giveup" for e in events)
+
+
 class TestSupervisedResumeRegression:
     """ISSUE 5 satellite: a supervisor-driven kill + ``--resume`` relaunch
     reproduces the same final fold metrics as an uninterrupted run —

@@ -14,6 +14,7 @@ SIGKILL-mid-stream resume).
 
 import json
 import os
+import re
 import subprocess
 import sys
 import urllib.error
@@ -295,6 +296,100 @@ class TestSessionStore:
         self._fill(store, recording)
         assert store.snapshot() is None
         assert store.restore() == []
+        store.detach()
+
+
+class TestSpoolCompaction:
+    """ISSUE-17 satellite: closed/migrated sessions are scrubbed from the
+    retained snapshot generations, not just the newest one — otherwise a
+    corrupt newest generation resurrects a departed stream on restore,
+    and a cell-spool read fails a migrated session over to a second cell,
+    forking the stream the migration just moved."""
+
+    _fill = TestSessionStore._fill
+
+    def _gens(self, path):
+        gen_re = re.compile(re.escape(path.name) + r"\.gen\d+$")
+        return [p for p in sorted(path.parent.glob(path.name + ".gen*"))
+                if gen_re.fullmatch(p.name)]
+
+    def test_close_scrubs_departed_from_every_generation(self, tmp_path,
+                                                         recording):
+        path = tmp_path / "sessions.npz"
+        store = SessionStore(path, keep=4)
+        self._fill(store, recording, sid="a")
+        self._fill(store, recording, sid="b")
+        store.snapshot()
+        store.snapshot()  # rotate: retained gens now hold {a, b} too
+        assert self._gens(path)
+        store.close("a")
+        for gen in self._gens(path):
+            with np.load(gen, allow_pickle=False) as npz:
+                assert not any(k.startswith("s/a/") for k in npz.files)
+                meta = json.loads(bytes(npz["__meta__"]).decode())
+            assert meta["sessions"] == ["b"]
+        store.detach()
+        # The co-resident open session's fallback state survived the
+        # rewrite byte-for-byte usable: a restore still resumes it.
+        store2 = SessionStore(path)
+        assert store2.restore() == ["b"]
+        assert store2.get("b").acked == 800
+        store2.detach()
+
+    def test_keep_guard_never_scrubs_an_open_session(self, tmp_path,
+                                                     recording):
+        path = tmp_path / "sessions.npz"
+        store = SessionStore(path, keep=4)
+        self._fill(store, recording, sid="a")
+        store.snapshot()
+        store.snapshot()
+        assert store.compact_departed("a") == 0  # still open here
+        for gen in self._gens(path):
+            with np.load(gen, allow_pickle=False) as npz:
+                assert any(k.startswith("s/a/") for k in npz.files)
+        store.detach()
+
+    def test_corrupt_newest_cannot_resurrect_closed_session(self, tmp_path,
+                                                            recording):
+        path = tmp_path / "sessions.npz"
+        store = SessionStore(path, keep=4)
+        self._fill(store, recording, sid="a")
+        self._fill(store, recording, sid="b")
+        store.snapshot()
+        store.snapshot()
+        store.close("a")
+        store.detach()
+        # Garble the newest snapshot: restore falls back to a retained
+        # generation — which, compacted, no longer knows session "a".
+        path.write_bytes(b"not a snapshot")
+        store2 = SessionStore(path)
+        assert store2.restore() == ["b"]
+        store2.detach()
+
+    def test_spool_read_misses_departed_session(self, tmp_path, recording):
+        from eegnetreplication_tpu.serve.sessions.store import (
+            read_spooled_session,
+        )
+
+        path = tmp_path / "spool" / "r0" / "sessions.npz"
+        store = SessionStore(path, keep=4)
+        self._fill(store, recording, sid="a")
+        store.snapshot()
+        store.snapshot()
+        store.close("a")
+        store.detach()
+        assert read_spooled_session(tmp_path / "spool", "a") is None
+
+    def test_generations_left_empty_are_unlinked(self, tmp_path,
+                                                 recording):
+        path = tmp_path / "sessions.npz"
+        store = SessionStore(path, keep=4)
+        self._fill(store, recording, sid="a")
+        store.snapshot()
+        store.snapshot()
+        assert self._gens(path)
+        store.close("a")
+        assert self._gens(path) == []
         store.detach()
 
 
