@@ -1,0 +1,30 @@
+"""Closed-loop online adaptation: label → fine-tune → shadow → promote.
+
+The paper's deployment target is a live BCI session whose signal drifts
+within the session; this package closes the loop the rest of the repo
+already has every piece for.  Labeled replay pairs arrive through the
+serving API (:class:`~eegnetreplication_tpu.adapt.buffer.ReplayBuffer`),
+a background :class:`~eegnetreplication_tpu.adapt.worker.AdaptationWorker`
+fine-tunes the tenant's weights with the exact offline step machinery,
+a :class:`~eegnetreplication_tpu.adapt.shadow.ShadowEvaluator` scores
+the candidate on sampled live traffic without serving it, and a
+:class:`~eegnetreplication_tpu.adapt.gate.PromotionGate` decides whether
+the :class:`~eegnetreplication_tpu.adapt.controller.AdaptationController`
+promotes it through the zoo's zero-drop reload (rollback is one POST).
+"""
+
+from eegnetreplication_tpu.adapt.buffer import ReplayBuffer
+from eegnetreplication_tpu.adapt.controller import AdaptationController
+from eegnetreplication_tpu.adapt.gate import GateDecision, PromotionGate
+from eegnetreplication_tpu.adapt.shadow import ShadowEvaluator
+from eegnetreplication_tpu.adapt.worker import AdaptationWorker, Candidate
+
+__all__ = [
+    "AdaptationController",
+    "AdaptationWorker",
+    "Candidate",
+    "GateDecision",
+    "PromotionGate",
+    "ReplayBuffer",
+    "ShadowEvaluator",
+]

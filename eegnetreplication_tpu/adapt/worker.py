@@ -1,0 +1,154 @@
+"""AdaptationWorker: fine-tune one tenant's model off the hot path.
+
+Reuses the training stack end to end — :func:`make_optimizer` /
+:class:`TrainState` / :func:`train_step` are the exact reference-parity
+step machinery the offline trainer runs, so an online candidate is not a
+second training implementation that can drift from the replicated one.
+The candidate lands as a normal integrity-stamped checkpoint
+(:func:`save_checkpoint`), rotated through the same ``.genN`` chain as
+every other framework artifact, which is what lets the shadow loader and
+the promotion reload treat it exactly like an offline checkpoint —
+including *refusing* it when the ``adapt.train`` chaos site garbled it.
+
+The worker is synchronous; the controller owns the background thread.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from pathlib import Path
+
+import jax
+import numpy as np
+
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.resil import inject
+from eegnetreplication_tpu.training.checkpoint import (
+    load_checkpoint,
+    rotate_generations,
+    save_checkpoint,
+)
+from eegnetreplication_tpu.training.steps import (
+    TrainState,
+    eval_forward,
+    make_optimizer,
+    train_step,
+)
+from eegnetreplication_tpu.utils.logging import logger
+
+# Candidate generations kept per tenant (including the newest): enough
+# that a refused candidate's corpse survives for post-mortem while the
+# next fine-tune writes over the slot.
+CANDIDATE_KEEP = 3
+
+
+@dataclass
+class Candidate:
+    """A fine-tuned checkpoint awaiting shadow evaluation."""
+
+    model_id: str
+    path: Path
+    digest: str          # intended digest (in-memory tree, pre-any-corruption)
+    steps: int
+    n_labeled: int
+    loss: float
+    fit_accuracy: float  # accuracy on the replay set it was trained on
+
+
+class AdaptationWorker:
+    """Fine-tunes a tenant's served weights on its labeled replay set."""
+
+    def __init__(self, buffer, adapt_dir: str | Path, *,
+                 learning_rate: float = 1e-3, steps: int = 60,
+                 batch_size: int = 32, seed: int = 0, journal=None):
+        self.buffer = buffer
+        self.adapt_dir = Path(adapt_dir)
+        self.learning_rate = float(learning_rate)
+        self.steps = int(steps)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self._journal = journal if journal is not None \
+            else obs_journal.current()
+
+    def candidate_path(self, model_id: str) -> Path:
+        return self.adapt_dir / f"{model_id}.candidate.npz"
+
+    def fine_tune(self, model_id: str, base_checkpoint: str | Path
+                  ) -> Candidate:
+        """Run the fine-tune and write the stamped candidate checkpoint.
+
+        Raises whatever the step machinery (or an armed ``adapt.train``
+        fault with ``action=raise``) raises — the controller journals the
+        outcome; a raise here means NO candidate was produced.
+        """
+        t0 = time.perf_counter()
+        x, y = self.buffer.dataset(model_id)
+        n = int(len(y))
+        self._journal.event("adaptation_start", model=model_id, n_labeled=n,
+                            base_checkpoint=str(base_checkpoint),
+                            steps=self.steps, lr=self.learning_rate)
+        self._journal.metrics.inc("adapt_runs")
+        if n == 0:
+            raise ValueError(f"no labeled replay data for {model_id!r}")
+
+        # Imported here, not at module top: serve.service imports this
+        # package at module level, so a top-level serve.engine import
+        # makes `import eegnetreplication_tpu.adapt` order-dependent
+        # (circular when adapt loads first).
+        from eegnetreplication_tpu.serve.engine import (
+            load_model_from_checkpoint,
+            variables_digest,
+        )
+
+        model, params, batch_stats = \
+            load_model_from_checkpoint(base_checkpoint)
+        _, _, base_meta = load_checkpoint(base_checkpoint)
+        tx = make_optimizer(self.learning_rate)
+        state = TrainState.create(
+            {"params": params, "batch_stats": batch_stats}, tx)
+
+        rng = np.random.default_rng(self.seed)
+        dropout_key = jax.random.PRNGKey(self.seed)
+        loss = 0.0
+        for step in range(self.steps):
+            idx = rng.integers(0, n, size=min(self.batch_size, n))
+            bx = x[idx]
+            by = y[idx].astype(np.int32)
+            w = np.ones(len(idx), np.float32)
+            dropout_key, sub = jax.random.split(dropout_key)
+            state, loss = train_step(model, tx, state, bx, by, w, sub)
+
+        logits = eval_forward(model, state.params, state.batch_stats, x)
+        fit_acc = float(np.mean(np.argmax(np.asarray(logits), -1) == y))
+
+        path = self.candidate_path(model_id)
+        rotate_generations(path, CANDIDATE_KEEP)
+        meta = dict(base_meta)
+        meta.update({
+            "adapted_from": str(base_checkpoint),
+            "adapt_steps": self.steps,
+            "adapt_n_labeled": n,
+        })
+        save_checkpoint(path, state.params, state.batch_stats, meta)
+        # Fired AFTER the stamped write lands: the default corrupt action
+        # garbles the finished candidate — the bad-candidate shape the
+        # shadow gate must refuse (it fails integrity at load) — while
+        # action=raise aborts the fine-tune before the candidate is ever
+        # handed to the shadow evaluator.
+        inject.fire("adapt.train", model=model_id, path=path)
+
+        digest = variables_digest(state.params, state.batch_stats)
+        loss_f = float(np.asarray(loss))
+        self._journal.event(
+            "adaptation_candidate", model=model_id, digest=digest,
+            steps=self.steps, n_labeled=n, loss=round(loss_f, 6),
+            fit_accuracy=round(fit_acc, 6), checkpoint=str(path),
+            elapsed_s=round(time.perf_counter() - t0, 3))
+        self._journal.metrics.inc("adapt_candidates")
+        logger.info("Adaptation candidate for %s: %d steps on %d labeled "
+                    "windows (fit acc %.3f, digest %s)", model_id,
+                    self.steps, n, fit_acc, digest[:12])
+        return Candidate(model_id=model_id, path=path, digest=digest,
+                         steps=self.steps, n_labeled=n, loss=loss_f,
+                         fit_accuracy=fit_acc)

@@ -208,6 +208,13 @@ class _RunView:
         self.scale_ups = 0
         self.scale_downs = 0
         self.scale_forced = 0
+        # Closed-loop adaptation (adaptation_*/shadow_eval/promotion):
+        # rolling shadow agreement plus lifetime decision counters.
+        self.adapt_candidates = 0
+        self._shadow: deque = deque()     # (t, agree)
+        self.promotions = 0
+        self.promotion_refusals = 0
+        self.adapt_rollbacks = 0
 
     # -- folding ----------------------------------------------------------
     def fold(self, events: list[dict]) -> None:
@@ -310,6 +317,23 @@ class _RunView:
         if not ev.get("drain"):
             self.ckpt_blocked_ms += _num(ev.get("blocked_ms")) or 0.0
 
+    def _on_adaptation_candidate(self, ev, t):
+        self.adapt_candidates += 1
+
+    def _on_shadow_eval(self, ev, t):
+        agree = _num(ev.get("agree"))
+        if t is not None and agree is not None:
+            self._shadow.append((t, agree))
+
+    def _on_promotion(self, ev, t):
+        action = ev.get("action")
+        if action == "promote":
+            self.promotions += 1
+        elif action == "refused":
+            self.promotion_refusals += 1
+        elif action == "rollback":
+            self.adapt_rollbacks += 1
+
     def _on_probe(self, ev, t):
         if t is not None:
             self._probes.append((t, ev.get("status"),
@@ -318,7 +342,7 @@ class _RunView:
     def _prune(self) -> None:
         horizon = self._clock() - self._window_s
         for dq in (self._requests, self._epochs, self._probes,
-                   *self._spans.values()):
+                   self._shadow, *self._spans.values()):
             while dq and dq[0][0] < horizon:
                 dq.popleft()
 
@@ -376,6 +400,19 @@ class _RunView:
                             "ups": self.scale_ups,
                             "downs": self.scale_downs,
                             "forced": self.scale_forced}
+        if (self.adapt_candidates or self.promotions
+                or self.promotion_refusals or self.adapt_rollbacks
+                or self._shadow):
+            adapt = {"candidates": self.adapt_candidates,
+                     "promotions": self.promotions,
+                     "refusals": self.promotion_refusals,
+                     "rollbacks": self.adapt_rollbacks}
+            if self._shadow:
+                agrees = [a for _, a in self._shadow]
+                adapt["shadow_window"] = len(agrees)
+                adapt["shadow_agreement"] = round(
+                    sum(agrees) / len(agrees), 4)
+            out["adapt"] = adapt
         if self._probes:
             probe_ok = [lat for _, status, lat in self._probes
                         if status == "ok" and lat is not None]

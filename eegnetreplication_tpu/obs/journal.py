@@ -342,6 +342,28 @@ def current() -> RunJournal | NullJournal:
 
 
 @contextlib.contextmanager
+def bound(journal: RunJournal | NullJournal | None) -> Iterator[None]:
+    """Bind ``journal`` as the context-active journal for this thread.
+
+    Worker threads (HTTP handler threads, pool workers) do not inherit
+    the creating thread's contextvars, so instrumentation that reaches
+    the journal through :func:`current` — notably ``inject.fire``'s
+    ``fault_injected`` events — silently hits the NullJournal there.  A
+    thread that holds an explicit journal reference wraps its work in
+    ``bound(journal)`` to close that gap; ``None`` is a no-op so callers
+    need no "is telemetry on?" branch.
+    """
+    if journal is None:
+        yield
+        return
+    token = _ACTIVE.set(journal)
+    try:
+        yield
+    finally:
+        _ACTIVE.reset(token)
+
+
+@contextlib.contextmanager
 def run(metrics_dir: str | Path, config: Any = None,
         mesh_shape: dict | None = None, tb_dir: str | Path | None = None,
         run_id: str | None = None, **run_start_extra: Any

@@ -98,6 +98,17 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "session_snapshot": ("path", "n_sessions"),
     "session_resume": ("session", "acked"),
     "session_end": ("session", "windows", "expired"),
+    # Closed-loop online adaptation (adapt/): a client-supplied
+    # cue-schedule label paired with one decided window; the
+    # AdaptationWorker's fine-tune start and its integrity-stamped
+    # candidate checkpoint; one teed shadow comparison of live vs
+    # candidate predictions; and every promotion-gate decision (action is
+    # promote / refused / rollback / error) with its full input snapshot.
+    "session_label": ("session", "window", "label"),
+    "adaptation_start": ("model", "n_labeled"),
+    "adaptation_candidate": ("model", "digest", "steps"),
+    "shadow_eval": ("model", "digest", "n_trials", "agree"),
+    "promotion": ("model", "action", "digest"),
     # Liveness (resil/heartbeat.py): throttled beats from long-lived
     # loops, and the circuit breaker's state machine (resil/breaker.py).
     "heartbeat": ("phase", "beat"),
@@ -499,6 +510,41 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
 
             out["window_p50_ms"] = round(percentile(wlat, 0.50), 3)
             out["window_p95_ms"] = round(percentile(wlat, 0.95), 3)
+    # Closed-loop online adaptation: labels received, fine-tune activity
+    # (adaptation_start begins a fine-tune, adaptation_candidate lands
+    # its stamped checkpoint), the rolling shadow agreement between live
+    # and candidate predictions over every teed comparison, and the
+    # promotion gate's decision counts — only reported for streams the
+    # adaptation loop actually touched, so other rows stay compact.
+    labels = [e for e in events if e["event"] == "session_label"]
+    adapt_starts = [e for e in events if e["event"] == "adaptation_start"]
+    candidates = [e for e in events
+                  if e["event"] == "adaptation_candidate"]
+    shadow_evals = [e for e in events if e["event"] == "shadow_eval"]
+    promotions = [e for e in events if e["event"] == "promotion"]
+    if labels or adapt_starts or candidates or shadow_evals or promotions:
+        out["session_labels"] = len(labels)
+        out["adapt_runs"] = len(adapt_starts)
+        out["adapt_candidates"] = len(candidates)
+        out["shadow_evals"] = len(shadow_evals)
+        agree = [e["agree"] for e in shadow_evals
+                 if isinstance(e.get("agree"), numbers.Real)]
+        if agree:
+            # Per-window weighting: each shadow_eval covers n_trials
+            # comparisons, so weight by it where present.
+            weights = [e["n_trials"] if isinstance(e.get("n_trials"),
+                                                   numbers.Real) else 1
+                       for e in shadow_evals
+                       if isinstance(e.get("agree"), numbers.Real)]
+            total = sum(weights) or 1
+            out["shadow_agreement"] = round(
+                sum(a * w for a, w in zip(agree, weights)) / total, 4)
+        out["promotions"] = sum(1 for e in promotions
+                                if e.get("action") == "promote")
+        out["promotion_refusals"] = sum(1 for e in promotions
+                                        if e.get("action") == "refused")
+        out["rollbacks"] = sum(1 for e in promotions
+                               if e.get("action") == "rollback")
     # Tracing: how many sampled (or anomaly-flushed) traces this stream
     # holds — the obs_report "traces" column; stitch with trace_report.
     spans = [e for e in events if e["event"] == "span"]

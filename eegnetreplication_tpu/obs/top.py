@@ -46,6 +46,7 @@ def _run_row(r: dict) -> list[str]:
     members = r.get("members") or {}
     probes = r.get("probes") or {}
     scale = r.get("scale") or {}
+    adapt = r.get("adapt") or {}
     return [
         _short(r.get("run_id")), r.get("role", "run"),
         r.get("status", "?"),
@@ -61,12 +62,19 @@ def _run_row(r: dict) -> list[str]:
         _cell(r.get("fold_epochs_per_s")),
         (f"{probes.get('window')}w/{probes.get('failures')}f"
          if probes else "-"),
+        # Closed-loop adaptation: candidates fine-tuned, rolling shadow
+        # agreement, and promote/rollback counts (compound like scale).
+        _cell(adapt.get("candidates")),
+        _cell(adapt.get("shadow_agreement")),
+        (f"{adapt.get('promotions')}p/{adapt.get('refusals')}r"
+         f"/{adapt.get('rollbacks')}b" if adapt else "-"),
     ]
 
 
 _HEADERS = ["run", "role", "status", "rps", "p50_ms", "p95_ms", "non_ok",
             "members", "scale", "circuit", "ejected", "slo_breach",
-            "fold-ep/s", "probes"]
+            "fold-ep/s", "probes", "candidates", "shadow_agree",
+            "promote/ref/rb"]
 
 
 def render(snap: dict) -> str:

@@ -90,6 +90,24 @@ site                  action     effect
                                  models a hang-during-drain, which must
                                  time out into a forced-but-journaled
                                  retirement)
+``session.drift``     drift      deterministic mid-stream distribution
+                                 shift: the session-ingest path catches
+                                 :class:`DriftInjected` and applies
+                                 ``x*scale + offset`` to the incoming
+                                 chunk — the within-session EEG
+                                 non-stationarity the online-adaptation
+                                 loop exists to absorb.  ``scale=`` /
+                                 ``offset=`` are parse-time validated
+                                 (finite, scale > 0)
+``adapt.train``       corrupt    garble the just-written candidate
+                                 checkpoint the AdaptationWorker produced
+                                 (the bad-candidate shape the shadow gate
+                                 must refuse); ``action=raise`` aborts
+                                 the fine-tune instead
+``adapt.promote``     raise      ``RuntimeError`` inside the promotion
+                                 gate's reload — a promotion that dies
+                                 mid-swap must leave the prior tenant
+                                 serving untouched
 ====================  =========  ==========================================
 
 Unlike ``sleep=`` (an unbounded silent stall — the watchdog/supervisor
@@ -125,10 +143,11 @@ SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
          "checkpoint.write_async", "host.preempt", "train.chunk",
          "serve.forward", "train.hang", "serve.hang", "session.snapshot",
          "session.restore", "serve.degrade", "replica.network",
-         "cell.partition", "fleet.scale")
+         "cell.partition", "fleet.scale", "session.drift", "adapt.train",
+         "adapt.promote")
 
 ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate",
-           "refuse")
+           "refuse", "drift")
 
 # Default hang duration for action="sleep" when the spec sets none: long
 # enough that any sane watchdog budget expires first, short enough that a
@@ -140,11 +159,30 @@ DEFAULT_HANG_S = 60.0
 # or watchdog budget — slow, not stuck.
 DEFAULT_SLOW_S = 0.25
 
+# Default mid-stream drift for action="drift" when the spec sets none:
+# large enough that a model calibrated pre-drift visibly misclassifies
+# (the slow session EMS cannot re-standardize it away within a drill),
+# small enough to stay numerically tame.
+DEFAULT_DRIFT_SCALE = 3.0
+DEFAULT_DRIFT_OFFSET = 2.0
+
 
 class ResponseTruncated(Exception):
     """Control-flow signal raised by ``action="truncate"``: the
     instrumented reply path catches it and sends a cut-off body over a
     closed connection instead of the real response."""
+
+
+class DriftInjected(Exception):
+    """Control-flow signal raised by ``action="drift"``: the session
+    ingest path catches it and applies ``chunk*scale + offset`` to the
+    incoming samples — a payload-carrying injection (like
+    :class:`ResponseTruncated`), not a failure."""
+
+    def __init__(self, message: str, scale: float, offset: float):
+        super().__init__(message)
+        self.scale = float(scale)
+        self.offset = float(offset)
 
 _EXC_TYPES: dict[str, type[Exception]] = {
     "RuntimeError": RuntimeError,
@@ -191,6 +229,12 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                        "injected partition: cell.partition (hit {hit})"),
     "fleet.scale": ("raise", "RuntimeError",
                     "injected fault: fleet.scale (hit {hit})"),
+    "session.drift": ("drift", None,
+                      "injected drift: session.drift (hit {hit})"),
+    "adapt.train": ("corrupt", "OSError",
+                    "injected fault: adapt.train (hit {hit})"),
+    "adapt.promote": ("raise", "RuntimeError",
+                      "injected fault: adapt.promote (hit {hit})"),
 }
 
 
@@ -215,6 +259,8 @@ class FaultSpec:
     refuse: int | None = None   # refuse=1 selects action="refuse"
     every: int | None = None    # fire only on every Nth due hit
     if_tag: str | None = None   # only hits whose ctx tag= matches
+    scale: float | None = None  # action="drift": multiplicative magnitude
+    offset: float | None = None  # action="drift": additive magnitude
 
     def __post_init__(self):
         if self.site not in SITES:
@@ -255,6 +301,29 @@ class FaultSpec:
                     f"{field_name} must be a non-negative finite number "
                     f"of seconds, got {value}")
             setattr(self, field_name, value)
+        # Drift magnitudes validate at plan-parse time too: NaN/inf would
+        # silently poison every window downstream, and a non-positive
+        # scale is a sign flip/erasure a plan almost never means — reject
+        # them before the drill starts, not mid-stream.  offset may be
+        # any finite number (negative baseline shifts are real drift).
+        for field_name in ("scale", "offset"):
+            value = getattr(self, field_name)
+            if value is None:
+                continue
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"{field_name} must be a finite number, got "
+                    f"{getattr(self, field_name)!r}") from None
+            if not math.isfinite(value):
+                raise ValueError(
+                    f"{field_name} must be finite, got {value}")
+            setattr(self, field_name, value)
+        if self.scale is not None and self.scale <= 0:
+            raise ValueError(
+                f"scale must be > 0 (a drift multiplies the signal), "
+                f"got {self.scale}")
         # refuse= gets the same parse-time strictness: it is a selector,
         # not a count — anything but 1 is a plan typo (refuse=0 would be
         # "arm a fault that does nothing", which misreports the plan).
@@ -442,6 +511,16 @@ def fire(site: str, **ctx) -> None:
         return
     if action == "truncate":
         raise ResponseTruncated(message)
+    if action == "drift":
+        # Payload-carrying control flow (the truncate pattern): the
+        # session-ingest caller catches DriftInjected and applies the
+        # scale/offset to the chunk it was about to ingest — the fault
+        # mutates data deterministically rather than failing anything.
+        raise DriftInjected(
+            message,
+            spec.scale if spec.scale is not None else DEFAULT_DRIFT_SCALE,
+            spec.offset if spec.offset is not None
+            else DEFAULT_DRIFT_OFFSET)
     if action == "refuse":
         # The connection-refused shape a dead/partitioned process shows a
         # client: an OSError subtype, so the fleet/cell dispatch path

@@ -428,8 +428,12 @@ class _FleetHandler(JsonRequestHandler):
                 if len(parts) == 2 and parts[1] == "import":
                     self._session_import()
                     return
-                if len(parts) == 3 and parts[2] in ("samples", "close",
-                                                    "discard"):
+                if len(parts) == 3 and parts[2] in ("samples", "label",
+                                                    "close", "discard"):
+                    # label rides the same sticky-replica forward as the
+                    # sample stream: the replica holding the session's
+                    # decision history (and its adaptation buffer) must
+                    # be the one that pairs the ground truth.
                     self._session_forward(parts[1], "POST", self.path,
                                           body=self._read_body(),
                                           drop=parts[2] in ("close",

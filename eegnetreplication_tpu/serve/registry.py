@@ -296,6 +296,11 @@ class ModelZoo:
         self._build_lock = threading.Lock()  # serializes engine builds
         self._reload_lock = threading.Lock()  # serializes reload/restack
         self._stacked = None                # the one-program hot path
+        # Non-serving shadow candidates (online adaptation): tenant id ->
+        # (engine, digest).  Deliberately OUTSIDE tenant_ids/resolve/the
+        # stack — a shadow must be unaddressable by requests and invisible
+        # to the program budget's LRU (it is short-lived by construction).
+        self._shadows: dict[str, tuple[InferenceEngine, str]] = {}
         self.last_stack_gate = None
         self.last_gate: QuantGateResult | None = None  # registry compat
         self._swaps = 0
@@ -703,6 +708,55 @@ class ModelZoo:
                 self._restack(reason=f"reload:{entry.model_id}", warm=warm)
             return new_digest
 
+    # -- shadows (online adaptation) ---------------------------------------
+    def register_shadow(self, model_id: str, checkpoint: str | Path) -> str:
+        """Load an adaptation candidate as a NON-serving shadow for
+        ``model_id``.  The shadow is integrity-verified and geometry-gated
+        exactly like a reload — a corrupted candidate raises here and
+        never sees traffic — but it is unaddressable by requests (not in
+        ``tenant_ids``), excluded from the stack and the LRU budget, and
+        compiled on the single-trial bucket only (the tee scores one
+        window at a time).  Returns the shadow's digest."""
+        resolved = self.resolve(model_id)
+        model, params, batch_stats = load_model_from_checkpoint(checkpoint)
+        if (model.n_channels, model.n_times) != self.geometry:
+            raise ValueError(
+                f"shadow geometry mismatch: serving {self.geometry}, "
+                f"candidate {checkpoint} is "
+                f"{(model.n_channels, model.n_times)}")
+        digest = variables_digest(params, batch_stats)
+        engine = InferenceEngine(model, params, batch_stats, (1,),
+                                 precision="fp32", digest=digest,
+                                 source=str(checkpoint),
+                                 journal=self._journal)
+        engine.warmup()
+        with self._lock:
+            self._shadows[resolved] = (engine, digest)
+        self._journal.event("model_load", model=resolved, digest=digest,
+                            shadow=True, checkpoint=str(checkpoint))
+        self._journal.metrics.inc("zoo_shadow_loads")
+        logger.info("Zoo shadow registered for %s: %s", resolved,
+                    digest[:12])
+        return digest
+
+    def shadow_infer(self, model_id: str, trials: np.ndarray) -> np.ndarray:
+        """Route a batch through the tenant's shadow engine (raises
+        KeyError when none is registered)."""
+        with self._lock:
+            engine, _ = self._shadows[self.resolve(model_id)]
+        return engine.infer(trials)
+
+    def shadow_digest(self, model_id: str) -> str | None:
+        with self._lock:
+            entry = self._shadows.get(self.resolve(model_id))
+            return None if entry is None else entry[1]
+
+    def drop_shadow(self, model_id: str) -> bool:
+        """Retire the tenant's shadow (no-op when none is registered)."""
+        with self._lock:
+            return self._shadows.pop(self.resolve(model_id), None) \
+                is not None
+
     def retune(self, buckets: tuple[int, ...], *, warm: bool = True):
         """Adopt a new bucket ladder (the LadderTuner's primitive): the
         stacked engine rebuilds on the new ladder off the hot path (same
@@ -778,4 +832,7 @@ class ModelZoo:
                 "resident_programs": self._resident_programs_locked(),
                 "max_programs": self.max_programs,
                 "restacks": self._restacks,
+                "shadows": [{"model": mid, "digest": digest}
+                            for mid, (_, digest)
+                            in self._shadows.items()],
                 "tenants": tenants}

@@ -78,6 +78,7 @@ from pathlib import Path
 
 import numpy as np
 
+from eegnetreplication_tpu.adapt import AdaptationController, PromotionGate
 from eegnetreplication_tpu.obs import journal as obs_journal
 from eegnetreplication_tpu.obs import probe as obs_probe
 from eegnetreplication_tpu.obs import slo as obs_slo
@@ -110,6 +111,7 @@ from eegnetreplication_tpu.serve.sessions.session import (
     STATUS_ERROR,
     STATUS_EXPIRED,
     STATUS_OK,
+    LabelConflict,
 )
 from eegnetreplication_tpu.serve.tuner import LadderTuner
 from eegnetreplication_tpu.utils.logging import logger
@@ -217,7 +219,15 @@ class ServeApp:
                  admission_target_ms: float = 0.0,
                  chaos_tag: str | None = None,
                  zoo=None, default_model: str | None = None,
-                 max_programs: int = 0, stack: bool = True):
+                 max_programs: int = 0, stack: bool = True,
+                 adapt: bool = False,
+                 adapt_dir: str | Path | None = None,
+                 adapt_trigger_labels: int = 16,
+                 adapt_steps: int = 60, adapt_lr: float = 1e-3,
+                 adapt_batch: int = 32, adapt_sample_every: int = 1,
+                 adapt_min_shadow: int = 12, adapt_min_labeled: int = 8,
+                 adapt_accuracy_floor: float = 0.55,
+                 adapt_agreement_floor: float = 0.0):
         self.journal = journal if journal is not None \
             else obs_journal.current()
         # precision="int8" requests the quantized engine; the registry
@@ -262,6 +272,34 @@ class ServeApp:
             journal=self.journal)
         if resume:
             self.sessions.restore()
+        # Closed-loop online adaptation (opt-in): labeled replay buffer +
+        # background fine-tune + shadow scoring + gated promotion.  Zoo
+        # serving is required — the shadow registers as a non-serving
+        # tenant and promotion rides the zoo's zero-drop reload (the CLI
+        # auto-wraps a single --checkpoint into a one-tenant zoo).
+        self.adapt: AdaptationController | None = None
+        if adapt:
+            if self.zoo is None:
+                raise ValueError(
+                    "online adaptation requires zoo serving (pass zoo=, "
+                    "or let the CLI wrap --checkpoint into a one-tenant "
+                    "zoo)")
+            adapt_root = (Path(adapt_dir) if adapt_dir
+                          else (self.sessions_dir / "adapt"
+                                if self.sessions_dir
+                                else Path(tempfile.mkdtemp(
+                                    prefix="eegtpu_adapt_"))))
+            self.adapt = AdaptationController(
+                self.zoo, adapt_root,
+                trigger_labels=adapt_trigger_labels,
+                sample_every=adapt_sample_every,
+                gate=PromotionGate(
+                    min_samples=adapt_min_shadow,
+                    min_labeled=adapt_min_labeled,
+                    accuracy_floor=adapt_accuracy_floor,
+                    agreement_floor=adapt_agreement_floor),
+                learning_rate=adapt_lr, steps=adapt_steps,
+                batch_size=adapt_batch, journal=self.journal)
         # Liveness + failure-domain hardening: the worker's heartbeat (an
         # in-process emitter, plus the EEGTPU_HEARTBEAT_FILE file when a
         # supervisor configured one) feeds /healthz staleness; the
@@ -398,6 +436,7 @@ class ServeApp:
                      if self.zoo is not None else None),
             stacked=(self.zoo.stacked is not None
                      if self.zoo is not None else None),
+            adaptation=self.adapt is not None,
             host=self.address[0], port=self.address[1])
         logger.info("Serving %s at %s (buckets %s, %s)", self.checkpoint,
                     self.url, self.registry.engine.buckets,
@@ -428,6 +467,8 @@ class ServeApp:
             self._httpd.shutdown()
             self._httpd.server_close()
         self.batcher.close(drain=drain)
+        if self.adapt is not None:
+            self.adapt.close()
         with self._idle:
             if not self._idle.wait_for(lambda: self._inflight == 0,
                                        timeout=handler_timeout_s):
@@ -621,9 +662,9 @@ class ServeApp:
                                           priority=True, tenant=tenant)
             except Rejected:
                 fut = None
-            submitted.append((index, start, t0, deadline, fut))
+            submitted.append((index, start, win, t0, deadline, fut))
         decisions = []
-        for index, start, t0, deadline, fut in submitted:
+        for index, start, win, t0, deadline, fut in submitted:
             status, pred = STATUS_ERROR, -1
             if fut is not None:
                 try:
@@ -668,6 +709,15 @@ class ServeApp:
                 self._n_session_windows += 1
                 if status == STATUS_EXPIRED:
                     self._n_windows_expired += 1
+            if self.adapt is not None and status == STATUS_OK:
+                # Closed-loop capture: the adaptation buffer stores the
+                # STANDARDIZED window the model actually classified (so a
+                # fine-tune trains on the serving distribution), and an
+                # active shadow candidate gets a sampled tee of the same
+                # live decision — both O(1) enqueues off the hot path.
+                self.adapt.observe_window(
+                    self.zoo.default_id, session.session_id, index, win,
+                    pred)
         return decisions
 
     def count_session_opened(self) -> None:
@@ -717,9 +767,12 @@ class JsonRequestHandler(BaseHTTPRequestHandler):
 class _ServeHandler(JsonRequestHandler):
     """One request; instances live on the ThreadingHTTPServer's threads.
 
-    Handler threads do not inherit the main thread's contextvars, so all
-    journaling goes through ``self.app.journal`` explicitly (the batcher
-    worker, by contrast, carries the context — see batcher.py).
+    Handler threads do not inherit the main thread's contextvars, so
+    journaling goes through ``self.app.journal`` explicitly, and
+    ``do_POST`` additionally binds that journal as the context-active
+    one (``obs_journal.bound``) so context-reached instrumentation —
+    ``inject.fire``'s ``fault_injected`` events — lands in the run
+    journal instead of the NullJournal.
     """
 
     app: ServeApp = None  # bound by ServeApp.start()
@@ -857,6 +910,13 @@ class _ServeHandler(JsonRequestHandler):
         if self.path == "/metrics":
             self._reply_metrics(app.journal)
             return
+        if self.path == "/adapt/status":
+            if app.adapt is None:
+                self._reply(404, {"error": "adaptation not enabled; "
+                                           "start with --adapt"})
+                return
+            self._reply(200, app.adapt.status())
+            return
         parts = self.path.strip("/").split("/")
         if len(parts) == 3 and parts[0] == "session" and parts[2] == "state":
             self._session_state(app, parts[1])
@@ -870,37 +930,51 @@ class _ServeHandler(JsonRequestHandler):
         app = self.app
         # In-flight tracking brackets everything that journals, so
         # ServeApp.stop() can hold serve_end until these threads finish.
+        # The journal bind makes context-reached instrumentation
+        # (inject.fire's fault_injected events) land in THIS app's
+        # journal — handler threads have no inherited contextvars.
         app.begin_request()
         try:
-            if self.path == "/predict":
-                self._predict(app)
-                return
-            if self.path == "/reload":
-                self._reload(app)
-                return
-            if self.path == "/profile":
-                self._profile(app)
-                return
-            parts = self.path.strip("/").split("/")
-            if parts[0] == "session":
-                if len(parts) == 2 and parts[1] == "open":
-                    self._session_open(app)
-                    return
-                if len(parts) == 2 and parts[1] == "import":
-                    self._session_import(app)
-                    return
-                if len(parts) == 3 and parts[2] == "samples":
-                    self._session_samples(app, parts[1])
-                    return
-                if len(parts) == 3 and parts[2] == "close":
-                    self._session_close(app, parts[1])
-                    return
-                if len(parts) == 3 and parts[2] == "discard":
-                    self._session_discard(app, parts[1])
-                    return
-            self._reply(404, {"error": f"unknown path {self.path}"})
+            with obs_journal.bound(app.journal):
+                self._route_post(app)
         finally:
             app.end_request()
+
+    def _route_post(self, app: ServeApp) -> None:
+        if self.path == "/predict":
+            self._predict(app)
+            return
+        if self.path == "/reload":
+            self._reload(app)
+            return
+        if self.path == "/profile":
+            self._profile(app)
+            return
+        parts = self.path.strip("/").split("/")
+        if parts[0] == "adapt":
+            if len(parts) == 2 and parts[1] == "rollback":
+                self._adapt_rollback(app)
+                return
+        if parts[0] == "session":
+            if len(parts) == 2 and parts[1] == "open":
+                self._session_open(app)
+                return
+            if len(parts) == 2 and parts[1] == "import":
+                self._session_import(app)
+                return
+            if len(parts) == 3 and parts[2] == "samples":
+                self._session_samples(app, parts[1])
+                return
+            if len(parts) == 3 and parts[2] == "label":
+                self._session_label(app, parts[1])
+                return
+            if len(parts) == 3 and parts[2] == "close":
+                self._session_close(app, parts[1])
+                return
+            if len(parts) == 3 and parts[2] == "discard":
+                self._session_discard(app, parts[1])
+                return
+        self._reply(404, {"error": f"unknown path {self.path}"})
 
     def _deadline_ms(self, payload_deadline) -> float | None:
         """The request's deadline budget in ms: ``X-Deadline-Ms`` header
@@ -1070,6 +1144,10 @@ class _ServeHandler(JsonRequestHandler):
             return
         app.record_request(len(x), latency_ms, "ok", probe=is_probe,
                            model=model_id)
+        if app.adapt is not None and model_id is not None and not is_probe:
+            # Shadow tee for bulk /predict traffic: sampled, non-blocking
+            # — the reply below never waits on shadow scoring.
+            app.adapt.tee_predictions(model_id, x, preds)
         reply = {
             "predictions": [int(p) for p in preds],
             "class_names": list(CLASS_NAMES), "n": len(x),
@@ -1243,6 +1321,17 @@ class _ServeHandler(JsonRequestHandler):
             except Exception as exc:  # noqa: BLE001 — client error
                 self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
                 return
+            # Synthetic mid-stream distribution shift (chaos drills):
+            # an armed session.drift mutates the incoming chunk to
+            # x*scale + offset BEFORE the EMS carry sees it — the slow
+            # standardizer cannot absorb it within a drill, so the model
+            # visibly misclassifies until the adaptation loop catches up.
+            # fire() already journaled the fault_injected event.
+            try:
+                inject.fire("session.drift", session=sid,
+                            n_samples=int(chunk.shape[1]))
+            except inject.DriftInjected as drift:
+                chunk = chunk * drift.scale + drift.offset
             with session.lock:
                 ready = session.ingest(chunk)
                 decisions = app.decide_windows(session, ready)
@@ -1251,6 +1340,102 @@ class _ServeHandler(JsonRequestHandler):
                     decisions=[d.as_json() for d in decisions])
         app.sessions.maybe_snapshot()
         self._reply(200, reply)
+
+    def _session_label(self, app: ServeApp, sid: str) -> None:
+        """``POST /session/<id>/label`` — ``{"window": i, "label": c}``:
+        pair a client-side ground-truth label (BCI cue schedules know the
+        intended class) with an already-decided window.
+
+        Contract: unknown session → 404; window not yet decided → 404;
+        malformed body / out-of-range label → 400; conflicting duplicate
+        or a window with no OK prediction → 409; exact duplicate → 200
+        (idempotent, ``fresh: false``).  Labels are durable session state
+        (they ride the snapshot/export arrays); feeding the adaptation
+        loop is a side effect, not a dependency — labeling works (and
+        persists) even when --adapt is off.
+        """
+        session = self._get_session(app, sid)
+        if session is None:
+            return
+        try:
+            payload = json.loads(self._read_body().decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            if "window" not in payload or "label" not in payload:
+                raise ValueError('body must carry {"window": i, "label": c}')
+            window = int(payload["window"])
+            label = int(payload["label"])
+            if not 0 <= label < len(CLASS_NAMES):
+                raise ValueError(
+                    f"label must be in [0, {len(CLASS_NAMES) - 1}], "
+                    f"got {label}")
+        except Exception as exc:  # noqa: BLE001 — client error
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        with session.lock:
+            try:
+                fresh = session.label(window, label)
+            except LabelConflict as exc:
+                self._reply(409, {"error": str(exc)})
+                return
+            except KeyError as exc:
+                self._reply(404, {"error": str(exc.args[0])})
+                return
+            except ValueError as exc:
+                self._reply(400, {"error": str(exc)})
+                return
+            # The live model's decision for this window, while the
+            # retained history still has it — the shadow evaluator's
+            # agreement reference for the labeled tee.
+            live_pred = None
+            rel = window - session.preds_offset
+            if 0 <= rel < len(session.decisions):
+                decision = session.decisions[rel]
+                if decision.status == STATUS_OK:
+                    live_pred = int(decision.pred)
+        if fresh:
+            app.journal.event("session_label", session=sid, window=window,
+                              label=label, live_pred=live_pred)
+            app.journal.metrics.inc("session_labels")
+        paired = False
+        if app.adapt is not None:
+            paired = app.adapt.on_label(
+                app.zoo.default_id, sid, window, label,
+                live_pred=live_pred)
+        self._reply(200, {"session": sid, "window": window, "label": label,
+                          "fresh": fresh, "paired": paired,
+                          "labels": len(session.labels)})
+
+    def _adapt_rollback(self, app: ServeApp) -> None:
+        """``POST /adapt/rollback`` — ``{"model": id?}``: restore the
+        tenant's pre-promotion checkpoint through the same zero-drop
+        reload.  409 when no promotion is on the stack, 404 for an
+        unknown tenant."""
+        if app.adapt is None:
+            self._reply(404, {"error": "adaptation not enabled; start "
+                                       "with --adapt"})
+            return
+        try:
+            payload = json.loads(self._read_body().decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+            model = payload.get("model")
+        except Exception as exc:  # noqa: BLE001 — client error
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        try:
+            result = app.adapt.rollback(model)
+        except LookupError as exc:
+            if isinstance(exc, KeyError):
+                self._reply(404, {"error": str(exc.args[0])})
+            else:
+                self._reply(409, {"error": str(exc)})
+            return
+        except Exception as exc:  # noqa: BLE001 — reload must not 500
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        self._reply(200, {"status": "ok", **result,
+                          "model_swaps": app.registry.swaps})
 
     def _session_state(self, app: ServeApp, sid: str) -> None:
         app.begin_request()
@@ -1506,6 +1691,43 @@ def main(argv=None) -> int:
                              "sliding window of client-vantage outcomes "
                              "(availability / error_rate / pNN_latency_"
                              "ms).")
+    parser.add_argument("--adapt", action="store_true",
+                        help="Closed-loop online adaptation: accumulate "
+                             "POST /session/<id>/label ground truth, "
+                             "fine-tune the tenant off the hot path, "
+                             "score the candidate as a non-serving "
+                             "shadow on sampled live traffic, and "
+                             "promote through the zero-drop reload only "
+                             "when the gate floors clear.  A single "
+                             "--checkpoint is auto-wrapped into a "
+                             "one-tenant zoo.")
+    parser.add_argument("--adaptDir", type=str, default=None,
+                        help="Candidate/promoted checkpoint directory "
+                             "(default: <sessionsDir>/adapt).")
+    parser.add_argument("--adaptTriggerLabels", type=int, default=16,
+                        help="Fresh labels that trigger a fine-tune.")
+    parser.add_argument("--adaptSteps", type=int, default=60,
+                        help="Fine-tune optimization steps per "
+                             "candidate.")
+    parser.add_argument("--adaptLr", type=float, default=1e-3,
+                        help="Fine-tune learning rate (the reference "
+                             "Adam).")
+    parser.add_argument("--adaptSampleEvery", type=int, default=1,
+                        help="Tee every Nth live window to the shadow "
+                             "(labeled windows are always teed).")
+    parser.add_argument("--adaptMinShadow", type=int, default=12,
+                        help="Minimum shadow forwards before the "
+                             "promotion gate decides.")
+    parser.add_argument("--adaptMinLabeled", type=int, default=8,
+                        help="Minimum ground-truth shadow evals before "
+                             "the promotion gate decides.")
+    parser.add_argument("--adaptAccuracyFloor", type=float, default=0.55,
+                        help="Labeled-accuracy floor the candidate must "
+                             "clear to promote (refused below it).")
+    parser.add_argument("--adaptAgreementFloor", type=float, default=0.0,
+                        help="Live-agreement floor (0 disables: after a "
+                             "real drift the live model is the wrong "
+                             "reference).")
     parser.add_argument("--resume", action="store_true",
                         help="Restore streaming sessions from the newest "
                              "valid snapshot generation in --sessionsDir "
@@ -1535,6 +1757,34 @@ def main(argv=None) -> int:
                     f"tenant (have {list(zoo_spec)})")
         except ValueError as exc:
             parser.error(f"--zoo: {exc}")
+
+    if args.adapt:
+        if zoo_spec is None:
+            # Adaptation needs zoo mechanics (shadow tenant, per-tenant
+            # reload); a single checkpoint becomes a one-tenant zoo with
+            # unchanged request semantics (it is the default tenant).
+            zoo_spec = {"default": args.checkpoint}
+            args.checkpoint = None
+        try:
+            # Parse-time strictness for the gate/loop knobs: the
+            # constructors validate ranges, so a bad floor fails HERE.
+            PromotionGate(min_samples=args.adaptMinShadow,
+                          min_labeled=args.adaptMinLabeled,
+                          accuracy_floor=args.adaptAccuracyFloor,
+                          agreement_floor=args.adaptAgreementFloor)
+            if args.adaptTriggerLabels < 1:
+                raise ValueError(
+                    f"--adaptTriggerLabels must be >= 1, got "
+                    f"{args.adaptTriggerLabels}")
+            if args.adaptSampleEvery < 1:
+                raise ValueError(
+                    f"--adaptSampleEvery must be >= 1, got "
+                    f"{args.adaptSampleEvery}")
+            if args.adaptSteps < 1:
+                raise ValueError(
+                    f"--adaptSteps must be >= 1, got {args.adaptSteps}")
+        except ValueError as exc:
+            parser.error(f"--adapt: {exc}")
 
     try:
         buckets = (tuple(sorted({int(b) for b in args.buckets.split(",")}))
@@ -1591,7 +1841,15 @@ def main(argv=None) -> int:
                        chaos_tag=args.chaosTag,
                        zoo=zoo_spec, default_model=args.defaultModel,
                        max_programs=args.maxPrograms,
-                       stack=not args.noStack)
+                       stack=not args.noStack,
+                       adapt=args.adapt, adapt_dir=args.adaptDir,
+                       adapt_trigger_labels=args.adaptTriggerLabels,
+                       adapt_steps=args.adaptSteps, adapt_lr=args.adaptLr,
+                       adapt_sample_every=args.adaptSampleEvery,
+                       adapt_min_shadow=args.adaptMinShadow,
+                       adapt_min_labeled=args.adaptMinLabeled,
+                       adapt_accuracy_floor=args.adaptAccuracyFloor,
+                       adapt_agreement_floor=args.adaptAgreementFloor)
         app.start()
         print(f"serving at {app.url}", flush=True)
         # Self-probing: an outside-in canary loop against this server's

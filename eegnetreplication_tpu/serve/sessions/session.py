@@ -40,6 +40,13 @@ _STATUS_CODES = {STATUS_OK: 0, STATUS_EXPIRED: 1, STATUS_ERROR: 2}
 _CODE_STATUS = {v: k for k, v in _STATUS_CODES.items()}
 
 
+class LabelConflict(ValueError):
+    """A label that contradicts session state: the window expired/errored
+    (there is no prediction to pair the label with), or a duplicate label
+    disagrees with the one already recorded.  The HTTP layer maps this to
+    409 — a client error, never a 500."""
+
+
 @dataclass
 class WindowDecision:
     """The outcome of one window: the class prediction (``-1`` when the
@@ -109,6 +116,11 @@ class StreamSession:
         self.n_expired = 0
         self.decision_history = max(1, int(decision_history))
         self._decisions: list[WindowDecision] = []
+        # Cue-schedule labels (BCI trials know the true class per cue):
+        # window index -> label, fed by POST /session/<id>/label.  Part of
+        # the durable snapshot state (state_arrays), so labels survive
+        # snapshot/resume and export/import migration.
+        self._labels: dict[int, int] = {}
 
     # -- introspection ----------------------------------------------------
     @property
@@ -135,6 +147,52 @@ class StreamSession:
         (``-1`` for expired/error windows), covering windows
         ``[preds_offset, windows_decided)``."""
         return np.asarray([d.pred for d in self._decisions], np.int64)
+
+    @property
+    def labels(self) -> dict[int, int]:
+        """Recorded cue labels: window index -> class label (a copy)."""
+        return dict(self._labels)
+
+    # -- labeling ---------------------------------------------------------
+    def label(self, window: int, label: int) -> bool:
+        """Record the true class for one DECIDED window.
+
+        Returns ``True`` when the label is new, ``False`` for an exact
+        duplicate (idempotent — a retried POST must not error).  Raises
+        ``KeyError`` for a window that has no decision yet (unknown from
+        the labeling contract's point of view), :class:`LabelConflict`
+        for a window whose decision expired/errored (no prediction exists
+        to pair with) or a duplicate that disagrees, and ``ValueError``
+        for non-integer input.  Caller holds ``lock``.
+        """
+        window = int(window)
+        label = int(label)
+        if window < 0:
+            raise ValueError(f"window must be >= 0, got {window}")
+        if label < 0:
+            raise ValueError(f"label must be >= 0, got {label}")
+        if window >= self.windows_decided:
+            raise KeyError(
+                f"window {window} has no decision yet (decided frontier "
+                f"is {self.windows_decided})")
+        existing = self._labels.get(window)
+        if existing is not None:
+            if existing != label:
+                raise LabelConflict(
+                    f"window {window} already labeled {existing}; "
+                    f"refusing conflicting label {label}")
+            return False
+        # Only windows still inside the retained decision history can be
+        # status-checked; older ones were decided long ago and their
+        # record aged out — accept the label (the decision happened).
+        rel = window - self.preds_offset
+        if 0 <= rel < len(self._decisions) \
+                and self._decisions[rel].status != STATUS_OK:
+            raise LabelConflict(
+                f"window {window} {self._decisions[rel].status} — there "
+                f"is no prediction to label")
+        self._labels[window] = label
+        return True
 
     # -- streaming --------------------------------------------------------
     def ingest(self, chunk) -> list[tuple[int, int, np.ndarray]]:
@@ -218,6 +276,11 @@ class StreamSession:
                 [_STATUS_CODES[d.status] for d in self._decisions], np.int8),
             "dec_latency_ms": np.asarray(
                 [d.latency_ms for d in self._decisions], np.float32),
+            # Labels serialize sorted by window index: the byte-identical
+            # round-trip the export/import migration contract requires.
+            "lab_window": np.asarray(sorted(self._labels), np.int64),
+            "lab_label": np.asarray(
+                [self._labels[w] for w in sorted(self._labels)], np.int64),
         })
         return flat
 
@@ -248,6 +311,13 @@ class StreamSession:
                            status=_CODE_STATUS[int(statuses[i])],
                            latency_ms=float(latencies[i]))
             for i in range(len(preds))]
+        if "lab_window" in flat:
+            # Pre-adaptation snapshots have no label arrays: restore to
+            # an empty label table rather than failing the whole session.
+            lab_w = np.asarray(flat["lab_window"], np.int64)
+            lab_l = np.asarray(flat["lab_label"], np.int64)
+            session._labels = {int(w): int(v)
+                               for w, v in zip(lab_w, lab_l)}
         # The produced cursor restarts at the decided frontier: in-flight
         # windows at crash time are re-extracted on the next ingest.
         session.windows_produced = session.windows_decided

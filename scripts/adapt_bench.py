@@ -1,0 +1,645 @@
+#!/usr/bin/env python
+"""Closed-loop adaptation bench: drift -> fine-tune -> shadow -> promote.
+
+The ISSUE-18 acceptance drill, measured: a live session whose signal
+DRIFTS mid-stream (the ``session.drift`` inject site: an affine
+``x*scale + offset`` on every raw chunk) loses accuracy against the cue
+schedule, the labels the client posts drive a background fine-tune, the
+candidate clears the shadow gate on live drifted traffic, promotion
+rides the zoo's zero-drop reload, and the post-promotion decision stream
+recovers accuracy — all while serving latency stays within tolerance of
+a no-adaptation baseline, and with the whole causal chain provable from
+the journal event ORDER (``fault_injected(session.drift)`` before
+``adaptation_start`` before ``adaptation_candidate`` before
+``shadow_eval`` before ``promotion(action=promote)``), not from logs.
+
+Three legs, one artifact (``BENCH_ADAPT.json``):
+
+1. **baseline** — the same drifted recording against a ServeApp with
+   adaptation OFF: the latency reference and the no-loop control.
+2. **recovery** — adaptation ON: client streams, labels every drifted
+   window from its cue schedule, and measures per-phase accuracy
+   (pre-drift / drifted-before-promotion / after-promotion).
+3. **rollback** — ``POST /adapt/rollback`` under concurrent ``/predict``
+   load: the pre-promotion digest comes back with zero failed requests.
+
+The serving model is TRAINED here (not random init): windows carry a
+class-dependent oscillation, so accuracy against the schedule is a real
+measurement.  ``--selftest`` is the seconds-sized tier-1 shape
+(``tests/test_adapt.py`` invokes it; the ``adapt`` stage of
+``rehearsal_product_path.py`` runs it too); the full run writes the
+committed artifact ``scripts/bench_gate.py`` holds the floors against.
+
+Usage:
+    python scripts/adapt_bench.py --out BENCH_ADAPT.json
+    python scripts/adapt_bench.py --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+from eegnetreplication_tpu.obs.stats import (  # noqa: E402
+    percentile as _percentile,
+)
+
+HEADSET_RATE_HZ = 250.0
+# Class-signature frequencies (Hz): far enough apart that a 64-sample
+# (0.256 s) window holds distinguishable cycle counts (1/2/4/6).
+CLASS_FREQS = (4.0, 8.0, 16.0, 24.0)
+SIGNAL_AMPLITUDE = 9.0
+NOISE_STD = 4.0
+DC_OFFSET = 7.5
+
+
+def _cue_window(n_channels: int, window: int, k: int, label: int,
+                seed: int) -> np.ndarray:
+    """Window ``k`` of the cue recording: class-frequency oscillation
+    (absolute time, so phase is continuous across windows) over noise.
+    Deterministic per ``(seed, k)`` so a stream can generate windows on
+    demand without pre-building the whole recording."""
+    rng = np.random.RandomState((seed * 100003 + k) % (2 ** 31 - 1))
+    x = rng.randn(n_channels, window).astype(np.float32) * NOISE_STD
+    t = (np.arange(k * window, (k + 1) * window)) / HEADSET_RATE_HZ
+    for c in range(n_channels):
+        x[c] += (SIGNAL_AMPLITUDE * np.sin(
+            2 * np.pi * CLASS_FREQS[int(label)] * t + 0.7 * c)
+        ).astype(np.float32)
+    return x + DC_OFFSET
+
+
+def make_cue_recording(n_channels: int, window: int, labels, seed: int = 0
+                       ) -> np.ndarray:
+    """A continuous ``(C, len(labels)*window)`` recording where segment
+    ``k`` (one window, hop == window) carries class ``labels[k]`` as a
+    class-frequency oscillation over noise — the cue schedule a BCI
+    client knows and can post back as ground truth."""
+    return np.concatenate(
+        [_cue_window(n_channels, window, k, int(label), seed)
+         for k, label in enumerate(labels)], axis=1)
+
+
+class _CueStream:
+    """An endless labeled cue stream: window ``k`` and its ground-truth
+    label, generated lazily — the adaptation loop's duration (compile +
+    fine-tune wall) decides how long phase B runs, not a pre-built
+    recording."""
+
+    def __init__(self, n_channels: int, window: int, seed: int):
+        self.n_channels, self.window, self.seed = n_channels, window, seed
+        self._label_rng = np.random.RandomState(seed + 7919)
+        self.labels: list[int] = []
+
+    def label(self, k: int) -> int:
+        while k >= len(self.labels):
+            self.labels.append(int(self._label_rng.randint(0, 4)))
+        return self.labels[k]
+
+    def chunk(self, k: int) -> np.ndarray:
+        return _cue_window(self.n_channels, self.window, k,
+                           self.label(k), self.seed)
+
+
+def train_baseline_checkpoint(root: Path, n_channels: int, window: int, *,
+                              steps: int, init_block: int,
+                              seed: int = 0) -> tuple[Path, dict]:
+    """Train an EEGNet on clean cue windows standardized exactly like the
+    serving session (same EMS recurrence, same init block), so the
+    serving-time distribution matches and measured accuracy is real."""
+    import jax
+
+    from eegnetreplication_tpu.models import EEGNet
+    from eegnetreplication_tpu.ops.ems import (
+        raw_exponential_moving_standardize,
+    )
+    from eegnetreplication_tpu.training.checkpoint import save_checkpoint
+    from eegnetreplication_tpu.training.steps import (
+        TrainState,
+        eval_forward,
+        make_optimizer,
+        train_step,
+    )
+
+    rng = np.random.RandomState(seed)
+    n_train, n_eval = 160, 48
+    labels = rng.randint(0, 4, size=n_train + n_eval)
+    x = make_cue_recording(n_channels, window, labels, seed=seed + 1)
+    std = raw_exponential_moving_standardize(x, init_block_size=init_block,
+                                             method="scan")
+    wins = np.stack([std[:, k * window:(k + 1) * window]
+                     for k in range(len(labels))]).astype(np.float32)
+    X, y = wins[:n_train], labels[:n_train].astype(np.int32)
+    Xe, ye = wins[n_train:], labels[n_train:].astype(np.int32)
+
+    model = EEGNet(n_channels=n_channels, n_times=window)
+    variables = model.init(jax.random.PRNGKey(seed),
+                           np.zeros((1, n_channels, window), np.float32),
+                           train=False)
+    tx = make_optimizer(learning_rate=1e-3)
+    state = TrainState.create(
+        {"params": variables["params"],
+         "batch_stats": variables["batch_stats"]}, tx)
+    key = jax.random.PRNGKey(seed + 2)
+    batch = 32
+    w = np.ones(batch, np.float32)
+    for step in range(steps):
+        idx = rng.choice(n_train, size=batch, replace=False)
+        key, sub = jax.random.split(key)
+        state, _ = train_step(model, tx, state, X[idx], y[idx], w, sub)
+    logits = eval_forward(model, state.params, state.batch_stats, Xe)
+    acc = float(np.mean(np.argmax(np.asarray(logits), axis=-1) == ye))
+    path = save_checkpoint(
+        root / "adapt_bench_model.npz", state.params, state.batch_stats,
+        metadata={"model": "eegnet", "n_channels": n_channels,
+                  "n_times": window, "F1": model.F1, "D": model.D})
+    return path, {"train_steps": steps, "n_train_windows": n_train,
+                  "holdout_accuracy": round(acc, 4)}
+
+
+# ---------------------------------------------------------------------------
+# HTTP client (stdlib only, serve_bench/stream_bench idiom).
+
+
+def _post(url: str, data: bytes, ctype: str = "application/json",
+          timeout: float = 60.0) -> dict:
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _get(url: str, timeout: float = 30.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def _accuracy(preds, labels) -> float | None:
+    pairs = [(p, int(t)) for p, t in zip(preds, labels) if p >= 0]
+    if not pairs:
+        return None
+    return round(float(np.mean([p == t for p, t in pairs])), 4)
+
+
+def run_adaptation_loop(checkpoint: Path, *, root: Path, journal,
+                        n_channels: int, window: int,
+                        clean_windows: int, max_drift_windows: int,
+                        post_windows: int,
+                        drift_scale: float, drift_offset: float,
+                        trigger_labels: int, adapt_steps: int,
+                        min_shadow: int, min_labeled: int,
+                        accuracy_floor: float,
+                        adapt: bool = True, expect: str = "promote",
+                        pace_s: float = 0.05, seed: int = 7,
+                        ems_factor: float = 1e-4,
+                        deadline_s: float = 300.0) -> dict:
+    """Drive one drifted session against an in-process ServeApp.
+
+    Phase A (``clean_windows``): clean stream, no labels — the pre-drift
+    accuracy reference.  Phase B: the ``session.drift`` site is armed
+    (affine corruption of every raw chunk); the client streams PACED
+    windows (``pace_s``) and labels each decided one from its cue
+    schedule until the loop reaches the ``expect`` outcome ("promote" or
+    "refused") — the stream is lazy, so phase B lasts exactly as long as
+    the fine-tune + shadow evaluation does, bounded by
+    ``max_drift_windows``/``deadline_s``.  Phase C (``post_windows``):
+    drift still armed, no more labels — the recovered-accuracy
+    measurement.  With ``adapt=False`` the same phases run label-free
+    against a loop-less app (the latency baseline: ``max_drift_windows``
+    becomes the literal phase-B length there, so pass a modest number).
+    The caller owns any extra inject arming (e.g. the ``adapt.train``
+    corruption for the refusal leg) and the journal.
+    """
+    from eegnetreplication_tpu.resil import inject
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    cue = _CueStream(n_channels, window, seed)
+    tag = "adapt" if adapt else "baseline"
+    app = ServeApp(
+        zoo={"default": str(checkpoint)}, port=0, buckets=(1, 8),
+        max_wait_ms=1.0, trace_sample=0.0, journal=journal,
+        sessions_dir=root / f"sessions_{tag}_{expect}",
+        adapt=adapt, adapt_dir=root / f"adapt_{tag}_{expect}",
+        adapt_trigger_labels=trigger_labels, adapt_steps=adapt_steps,
+        adapt_batch=16, adapt_min_shadow=min_shadow,
+        adapt_min_labeled=min_labeled,
+        adapt_accuracy_floor=accuracy_floor).start()
+    prior_digest = app.zoo.digest_for(app.zoo.default_id)
+    sid = f"drift_{tag}_{expect}"
+    base = app.url
+    decided = 0            # windows decided so far == next window index
+    labeled = 0
+    http_failures = 0
+    latencies: list[tuple[int, float]] = []   # (window, ok latency_ms)
+    statuses: list[str] = []
+    drift_start = promote_seen = None
+
+    def stream(n_windows: int, *, label: bool, paced: bool = False,
+               stop_fn=None) -> None:
+        nonlocal decided, labeled, http_failures
+        for _ in range(n_windows):
+            if stop_fn is not None and stop_fn():
+                return
+            if paced and pace_s > 0:
+                time.sleep(pace_s)
+            chunk = cue.chunk(decided)
+            reply = _post(f"{base}/session/{sid}/samples",
+                          chunk.astype("<f4").tobytes(),
+                          "application/octet-stream")
+            for d in reply["decisions"]:
+                statuses.append(d["status"])
+                if d["status"] == "ok":
+                    latencies.append((d["window"], d["latency_ms"]))
+                if label and d["status"] == "ok":
+                    try:
+                        _post(f"{base}/session/{sid}/label", json.dumps(
+                            {"window": d["window"],
+                             "label": cue.label(d["window"])}).encode())
+                        labeled += 1
+                    except urllib.error.HTTPError:
+                        http_failures += 1
+            decided += len(reply["decisions"])
+
+    def loop_state() -> dict:
+        st = app.adapt.status()["models"]
+        return st.get(app.zoo.default_id, {})
+
+    try:
+        # The slow standardizer (factor 1e-4, ~10k-sample time constant)
+        # is what makes the drift PERSISTENT: a faster EMS would absorb
+        # the affine corruption before the adaptation loop even finished
+        # compiling, and the bench would prove nothing.
+        _post(f"{base}/session/open", json.dumps(
+            {"session": sid, "hop": window,
+             "ems_factor_new": ems_factor,
+             "ems_init_block_size": window}).encode())
+        stream(clean_windows, label=False)      # phase A
+        drift_start = decided
+        with inject.scoped(inject.FaultSpec(
+                site="session.drift", times=0,
+                scale=drift_scale, offset=drift_offset)):
+            if not adapt:                       # the control: drift only
+                stream(max_drift_windows + post_windows, label=False,
+                       paced=True)
+            else:
+                def done() -> bool:
+                    st = loop_state()
+                    if expect == "promote":
+                        return st.get("promotions", 0) >= 1
+                    return st.get("refusals", 0) >= 1
+
+                def stop_labels() -> bool:
+                    # Refusal leg: exactly one trigger's worth of labels,
+                    # so precisely one (corrupted) candidate is built.
+                    return (expect == "refused"
+                            and labeled >= trigger_labels)
+
+                # Phase B: paced labeled streaming until the loop lands
+                # (windows keep flowing DURING the fine-tune, so the
+                # latency numbers include its background contention).
+                deadline = time.monotonic() + deadline_s
+                while not done():
+                    if (decided - drift_start >= max_drift_windows
+                            or time.monotonic() > deadline):
+                        raise AssertionError(
+                            f"adaptation never reached {expect!r} after "
+                            f"{decided - drift_start} drifted windows: "
+                            f"{loop_state()}")
+                    stream(1, label=not stop_labels(), paced=True)
+                promote_seen = decided
+                stream(post_windows, label=False)       # phase C
+        final = _post(f"{base}/session/{sid}/close", b"{}")
+        status_http = _get(f"{base}/adapt/status") if adapt else None
+        if adapt:
+            app.adapt.drain(timeout=120.0)
+    finally:
+        app.stop()
+
+    preds = np.asarray(final["preds"], np.int64)
+    truth = [cue.label(k) for k in range(len(preds))]
+    record = {
+        "windows_decided": int(final["windows"]),
+        "failed_requests": http_failures
+        + sum(1 for s in statuses if s != "ok"),
+        "labels_posted": labeled,
+        "pre_drift_accuracy": _accuracy(preds[:drift_start],
+                                        truth[:drift_start]),
+        "p95_ms": round(_percentile(
+            sorted(lat for _, lat in latencies), 0.95), 3),
+        "drift_p95_ms": round(_percentile(
+            sorted(lat for w, lat in latencies if w >= drift_start),
+            0.95), 3),
+    }
+    if adapt:
+        st = loop_state()
+        record.update({
+            "drifted_accuracy": _accuracy(
+                preds[drift_start:promote_seen],
+                truth[drift_start:promote_seen]),
+            "recovered_accuracy": _accuracy(
+                preds[promote_seen:], truth[promote_seen:]),
+            "recovered_windows": int(len(preds) - promote_seen),
+            "promotions": st.get("promotions", 0),
+            "promotion_refusals": st.get("refusals", 0),
+            "promotion_errors": st.get("errors", 0),
+            "digest_changed": bool(
+                app.zoo.digest_for(app.zoo.default_id) != prior_digest),
+            "status_route_ok": bool(
+                status_http and "models" in status_http),
+        })
+    else:
+        record["drifted_accuracy"] = _accuracy(preds[drift_start:],
+                                               truth[drift_start:])
+    return record
+
+
+def journal_order(events: list[dict]) -> dict:
+    """The causal-chain proof: first-occurrence indices of the loop's
+    five journal landmarks, in strict order."""
+    def first(pred) -> int | None:
+        return next((i for i, e in enumerate(events) if pred(e)), None)
+
+    indices = {
+        "session_drift": first(
+            lambda e: e["event"] == "fault_injected"
+            and e.get("site") == "session.drift"),
+        "adaptation_start": first(
+            lambda e: e["event"] == "adaptation_start"),
+        "adaptation_candidate": first(
+            lambda e: e["event"] == "adaptation_candidate"),
+        "shadow_eval": first(lambda e: e["event"] == "shadow_eval"),
+        "promotion": first(
+            lambda e: e["event"] == "promotion"
+            and e.get("action") == "promote"),
+    }
+    seq = list(indices.values())
+    ok = (all(i is not None for i in seq)
+          and all(a < b for a, b in zip(seq, seq[1:])))
+    return {"indices": indices, "ordered": ok}
+
+
+def run_rollback_leg(checkpoint: Path, *, root: Path, journal,
+                     record_recovery: dict | None = None,
+                     n_requests: int = 80, submitters: int = 2) -> dict:
+    """``POST /adapt/rollback`` under live ``/predict`` load: the prior
+    digest must come back with ZERO failed requests.  Reuses a tiny
+    promote loop (trigger/gate floors at their minimums) to create the
+    promotion to roll back."""
+    import serve_bench
+
+    from eegnetreplication_tpu.obs import schema
+    from eegnetreplication_tpu.resil import inject
+    from eegnetreplication_tpu.serve.service import ServeApp
+
+    app = ServeApp(
+        zoo={"default": str(checkpoint)}, port=0, buckets=(1, 8),
+        max_wait_ms=1.0, trace_sample=0.0, journal=journal,
+        sessions_dir=root / "sessions_rollback",
+        adapt=True, adapt_dir=root / "adapt_rollback",
+        adapt_trigger_labels=8, adapt_steps=20, adapt_batch=8,
+        adapt_min_shadow=4, adapt_min_labeled=4,
+        adapt_accuracy_floor=0.0).start()
+    try:
+        model_id = app.zoo.default_id
+        prior_digest = app.zoo.digest_for(model_id)
+        geometry = app.zoo.geometry
+        window = int(geometry[1])
+        cue = _CueStream(int(geometry[0]), window, seed=12)
+        sid = "rollback"
+        _post(f"{app.url}/session/open", json.dumps(
+            {"session": sid, "hop": window,
+             "ems_init_block_size": window}).encode())
+        decided = 0
+        deadline = time.monotonic() + 300.0
+        # session.drift stays cold here: this leg is about the swap, not
+        # the signal — labels alone drive the tiny promote loop.  The cue
+        # stream is lazy, so labeled windows keep flowing through the
+        # fine-tune and the shadow until the promotion lands.
+        while app.adapt.status()["models"].get(
+                model_id, {}).get("promotions", 0) < 1:
+            if time.monotonic() > deadline or decided > 400:
+                raise AssertionError(
+                    f"rollback leg never promoted after {decided} "
+                    f"windows: {app.adapt.status()['models']}")
+            time.sleep(0.05)
+            reply = _post(f"{app.url}/session/{sid}/samples",
+                          cue.chunk(decided).astype("<f4").tobytes(),
+                          "application/octet-stream")
+            for d in reply["decisions"]:
+                if d["status"] == "ok":
+                    _post(f"{app.url}/session/{sid}/label",
+                          json.dumps({
+                              "window": d["window"],
+                              "label": cue.label(d["window"]),
+                          }).encode())
+            decided += len(reply["decisions"])
+        app.adapt.drain(timeout=120.0)
+        promoted_digest = app.zoo.digest_for(model_id)
+        assert promoted_digest != prior_digest
+
+        trials = np.random.RandomState(3).randn(
+            8, int(geometry[0]), window).astype(np.float32)
+        bodies = serve_bench._npz_bodies(trials, 2)
+        failures = [0] * submitters
+        ok = [0] * submitters
+        rolled: dict = {}
+
+        def load(slot: int) -> None:
+            for i in range(n_requests // submitters):
+                try:
+                    _post(f"{app.url}/predict", bodies[i % len(bodies)],
+                          "application/octet-stream")
+                    ok[slot] += 1
+                except Exception:  # noqa: BLE001 — counted, not raised
+                    failures[slot] += 1
+
+        threads = [threading.Thread(target=load, args=(i,))
+                   for i in range(submitters)]
+        for t in threads:
+            t.start()
+        time.sleep(0.05)      # land the swap mid-load
+        rolled = _post(f"{app.url}/adapt/rollback", b"{}")
+        for t in threads:
+            t.join()
+        restored = app.zoo.digest_for(model_id)
+        inject.disarm_all()
+        events = schema.read_events(journal.events_path, complete=False,
+                                    lenient_tail=True)
+        rollback_events = [e for e in events if e["event"] == "promotion"
+                           and e.get("action") == "rollback"]
+        return {
+            "requests": sum(ok) + sum(failures),
+            "failed_requests": sum(failures),
+            "digest_restored": bool(
+                restored == prior_digest
+                and rolled.get("digest") == prior_digest),
+            "rollback_journaled": len(rollback_events) >= 1,
+        }
+    finally:
+        app.stop()
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    platform = select_platform()
+
+    parser = argparse.ArgumentParser(
+        description="Closed-loop adaptation bench: drift -> fine-tune -> "
+                    "shadow -> promote -> (rollback).")
+    parser.add_argument("--out", default=None,
+                        help="Artifact path (default BENCH_ADAPT.json in "
+                             "the repo root; selftest defaults to a temp "
+                             "file).")
+    parser.add_argument("--checkpoint", default=None,
+                        help="Serve this checkpoint instead of training "
+                             "the cue-schedule baseline (accuracy floors "
+                             "assume the trained baseline).")
+    parser.add_argument("--trainSteps", type=int, default=None,
+                        help="Baseline training steps (default 300; "
+                             "selftest 200).")
+    parser.add_argument("--selftest", action="store_true",
+                        help="Seconds-sized run; assert the acceptance "
+                             "floors (tier-1).")
+    args = parser.parse_args(argv)
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.obs import schema
+
+    root = Path(tempfile.mkdtemp(prefix="eegtpu_adapt_bench_"))
+    n_channels, window = 4, 64
+    init_block = window
+    train_steps = args.trainSteps or (200 if args.selftest else 300)
+    # max_drift_windows caps the lazily-paced phase B for the adapt leg
+    # (the outcome ends it early) and is the literal phase-B length for
+    # the no-adapt baseline leg.
+    sizes = (dict(clean_windows=10, max_drift_windows=400,
+                  post_windows=16, trigger_labels=12, adapt_steps=60,
+                  min_shadow=8, min_labeled=6)
+             if args.selftest else
+             dict(clean_windows=16, max_drift_windows=500,
+                  post_windows=24, trigger_labels=16, adapt_steps=80,
+                  min_shadow=12, min_labeled=8))
+    baseline_sizes = dict(sizes, max_drift_windows=60)
+
+    if args.checkpoint:
+        checkpoint, model_record = Path(args.checkpoint), {}
+    else:
+        checkpoint, model_record = train_baseline_checkpoint(
+            root, n_channels, window, steps=train_steps,
+            init_block=init_block)
+    print(f"[adapt_bench] baseline model: {model_record}", flush=True)
+
+    record: dict = {
+        "platform": platform, "selftest": bool(args.selftest),
+        "n_channels": n_channels, "window": window,
+        "drift": {"scale": 0.25, "offset": -2.0, "ems_factor_new": 1e-4},
+        "gate": {"min_shadow": sizes["min_shadow"],
+                 "min_labeled": sizes["min_labeled"],
+                 "accuracy_floor": 0.55},
+        "model": model_record,
+    }
+    common = dict(root=root, n_channels=n_channels, window=window,
+                  drift_scale=0.25, drift_offset=-2.0,
+                  accuracy_floor=0.55)
+
+    with obs_journal.run(root / "obs_baseline", config={}) as jr:
+        baseline = run_adaptation_loop(checkpoint, journal=jr,
+                                       adapt=False, **common,
+                                       **baseline_sizes)
+    print(f"[adapt_bench] baseline: {baseline}", flush=True)
+
+    with obs_journal.run(root / "obs_recovery", config={}) as jr:
+        recovery = run_adaptation_loop(checkpoint, journal=jr,
+                                       adapt=True, **common, **sizes)
+        events = schema.read_events(jr.events_path, complete=False,
+                                    lenient_tail=True)
+    order = journal_order(events)
+    recovery["journal_order_ok"] = order["ordered"]
+    print(f"[adapt_bench] recovery: {recovery}", flush=True)
+    print(f"[adapt_bench] journal order: {order}", flush=True)
+
+    with obs_journal.run(root / "obs_rollback", config={}) as jr:
+        rollback = run_rollback_leg(checkpoint, root=root, journal=jr)
+    print(f"[adapt_bench] rollback: {rollback}", flush=True)
+
+    record["recovery"] = recovery
+    record["rollback"] = rollback
+    record["latency"] = {
+        "baseline_p95_ms": baseline["drift_p95_ms"],
+        "adapt_p95_ms": recovery["drift_p95_ms"],
+        "overhead_x": round(
+            recovery["drift_p95_ms"] / max(baseline["drift_p95_ms"],
+                                           1e-9), 3),
+        "no_adapt_control_accuracy": baseline["drifted_accuracy"],
+    }
+
+    out = Path(args.out) if args.out else (
+        root / "BENCH_ADAPT_selftest.json"
+        if args.selftest else REPO / "BENCH_ADAPT.json")
+    schema.write_json_artifact(out, record, kind="bench", indent=1)
+    print(f"[adapt_bench] wrote {out}", flush=True)
+
+    if args.selftest:
+        failures = []
+        if (model_record
+                and model_record["holdout_accuracy"] < 0.7):
+            failures.append(
+                f"baseline model holdout accuracy "
+                f"{model_record['holdout_accuracy']} < 0.7 (the bench's "
+                "accuracy measurements would be meaningless)")
+        if recovery["promotions"] < 1:
+            failures.append("no promotion happened")
+        if recovery["promotion_errors"]:
+            failures.append(
+                f"{recovery['promotion_errors']} promotion error(s)")
+        if recovery["failed_requests"]:
+            failures.append(
+                f"{recovery['failed_requests']} failed request(s) during "
+                "the loop")
+        if not recovery["journal_order_ok"]:
+            failures.append(f"journal order violated: {order}")
+        if (recovery["recovered_accuracy"] or 0.0) < 0.55:
+            failures.append(
+                f"recovered accuracy {recovery['recovered_accuracy']} "
+                "below the 0.55 promotion-gate floor")
+        pre = recovery["pre_drift_accuracy"] or 0.0
+        drifted = recovery["drifted_accuracy"] or 1.0
+        if drifted >= pre:
+            failures.append(
+                f"drift did not cost accuracy (pre {pre}, "
+                f"drifted {drifted}) — the recovery proves nothing")
+        if not recovery["digest_changed"]:
+            failures.append("promotion did not change the serving digest")
+        if rollback["failed_requests"]:
+            failures.append(
+                f"{rollback['failed_requests']} request(s) failed during "
+                "rollback")
+        if not rollback["digest_restored"]:
+            failures.append("rollback did not restore the prior digest")
+        if failures:
+            print("[adapt_bench] SELFTEST FAIL:\n  - "
+                  + "\n  - ".join(failures))
+            return 1
+        print("[adapt_bench] SELFTEST PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -76,6 +76,34 @@ SPECS: dict[str, list[tuple[str, str, float]]] = {
         ("journal.max_replicas_reached", "floor", 2.0),
         ("ramp.completed", "higher", 0.30),
     ],
+    # Closed-loop adaptation (ISSUE 18 acceptance): the drifted session
+    # must RECOVER labeled accuracy after promotion (absolute floor), the
+    # loop must never error a promotion or drop a request during it, and
+    # serving p95 while the loop runs must stay within tolerance of the
+    # no-adaptation baseline (explicit spec: the overhead leaf is a
+    # latency multiple, which neither name heuristic classifies).
+    "BENCH_ADAPT.json": [
+        ("recovery.recovered_accuracy", "floor", 0.55),
+        ("recovery.promotions", "floor", 1.0),
+        ("recovery.promotion_errors", "ceiling", 0.0),
+        ("recovery.failed_requests", "ceiling", 0.0),
+        ("rollback.failed_requests", "ceiling", 0.0),
+        # overhead_x = adapt-leg serving p95 / no-adaptation baseline
+        # p95 while the loop runs.  On a CPU-only container the
+        # background fine-tune genuinely contends for the serving cores
+        # (~2.7x observed); the ceiling proves the loop cannot WEDGE
+        # serving, not that adaptation is free — on TPU the fine-tune
+        # runs beside the serving program and the ratio collapses.
+        ("latency.overhead_x", "ceiling", 4.0),
+        # p95 leaves are explicit with loose tolerance: adapt-leg tails
+        # depend on where the fine-tune's compile lands relative to the
+        # paced stream, far noisier than the steady-state serving
+        # benches the 60% "lower" heuristic was tuned for.
+        ("latency.adapt_p95_ms", "lower", 1.5),
+        ("latency.baseline_p95_ms", "lower", 1.5),
+        ("recovery.p95_ms", "lower", 1.5),
+        ("recovery.drift_p95_ms", "lower", 1.5),
+    ],
 }
 
 

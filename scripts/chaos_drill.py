@@ -943,6 +943,91 @@ def leg_combined(root: Path) -> None:
         float(result.avg_test_acc), 2)
 
 
+def _train_adapt_checkpoint(root: Path) -> Path:
+    """One trained cue-schedule model shared by the adaptation legs (the
+    drill asserts on journal order and gate decisions, so the model must
+    actually classify — a random-init net would make the shadow gate's
+    accuracy floor meaningless)."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import adapt_bench
+
+    ckpt = root / "adapt_model" / "adapt_bench_model.npz"
+    if not ckpt.exists():
+        ckpt.parent.mkdir(parents=True, exist_ok=True)
+        path, rec = adapt_bench.train_baseline_checkpoint(
+            ckpt.parent, 4, 64, steps=200, init_block=64)
+        assert path == ckpt and rec["holdout_accuracy"] >= 0.7, rec
+    return ckpt
+
+
+def leg_adapt_promote(root: Path) -> None:
+    """Armed adapt.promote (first promotion attempt raises mid-reload) ->
+    the error is journaled, the PRIOR model keeps serving, and the next
+    scored shadow window retries and promotes.  Asserts the full causal
+    journal order: fault_injected(session.drift) < adaptation_start <
+    adaptation_candidate < shadow_eval < promotion(action=promote), with
+    the armed promotion error in between."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import adapt_bench
+
+    ckpt = _train_adapt_checkpoint(root)
+    with obs.run(root / "obs" / "adapt_promote") as jr:
+        with inject.scoped(inject.FaultSpec(site="adapt.promote", times=1)):
+            rec = adapt_bench.run_adaptation_loop(
+                ckpt, root=root / "adapt_promote", journal=jr,
+                n_channels=4, window=64, clean_windows=8,
+                max_drift_windows=400, post_windows=8,
+                drift_scale=0.25, drift_offset=-2.0,
+                trigger_labels=12, adapt_steps=60,
+                min_shadow=6, min_labeled=4, accuracy_floor=0.55)
+    events = _events(jr)
+    order = adapt_bench.journal_order(events)
+    assert order["ordered"], order
+    assert rec["promotions"] >= 1 and rec["failed_requests"] == 0, rec
+    assert rec["promotion_errors"] >= 1, rec
+    fired = [e for e in events if e["event"] == "fault_injected"
+             and e.get("site") == "adapt.promote"]
+    assert fired, "armed adapt.promote never fired"
+    promos = [e for e in events if e["event"] == "promotion"]
+    i_err = [i for i, e in enumerate(promos)
+             if e["action"] == "error" and e.get("stage") == "reload"]
+    i_ok = [i for i, e in enumerate(promos) if e["action"] == "promote"]
+    assert i_err and i_ok and i_err[0] < i_ok[0], promos
+
+
+def leg_adapt_train(root: Path) -> None:
+    """Armed adapt.train corrupts every candidate checkpoint the
+    fine-tune writes -> shadow registration's integrity-verified load
+    REFUSES it: journaled as promotion(action=refused, stage=shadow_load),
+    never promoted, never serving — the serving digest is unchanged."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import adapt_bench
+
+    ckpt = _train_adapt_checkpoint(root)
+    with obs.run(root / "obs" / "adapt_train") as jr:
+        with inject.scoped(inject.FaultSpec(site="adapt.train", times=0)):
+            rec = adapt_bench.run_adaptation_loop(
+                ckpt, root=root / "adapt_train", journal=jr,
+                n_channels=4, window=64, clean_windows=8,
+                max_drift_windows=400, post_windows=4,
+                drift_scale=0.25, drift_offset=-2.0,
+                trigger_labels=12, adapt_steps=40,
+                min_shadow=6, min_labeled=4, accuracy_floor=0.55,
+                expect="refused")
+    events = _events(jr)
+    fired = [e for e in events if e["event"] == "fault_injected"
+             and e.get("site") == "adapt.train"]
+    assert fired, "armed adapt.train never fired"
+    refusals = [e for e in events if e["event"] == "promotion"
+                and e.get("action") == "refused"]
+    assert refusals and refusals[0].get("stage") == "shadow_load", refusals
+    promotes = [e for e in events if e["event"] == "promotion"
+                and e.get("action") == "promote"]
+    assert not promotes, promotes
+    assert rec["promotions"] == 0 and rec["promotion_refusals"] >= 1, rec
+    assert rec["digest_changed"] is False, rec
+
+
 LEGS = {
     "train.step": leg_train_step,
     "train.chunk": leg_train_chunk,
@@ -959,6 +1044,8 @@ LEGS = {
     "fleet.scale_kill": leg_fleet_scale_kill,
     "fleet.scale_resync": leg_fleet_scale_resync,
     "fleet.drain": leg_fleet_drain,
+    "adapt.promote": leg_adapt_promote,
+    "adapt.train": leg_adapt_train,
     "combined": leg_combined,
 }
 

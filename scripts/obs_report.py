@@ -130,6 +130,13 @@ _COLUMNS = (
     # holds (stitch them with scripts/trace_report.py) and the worst SLO
     # breach the run journaled (blank when every objective held).
     ("traces", "traces"), ("worst_slo", "slo"),
+    # Closed-loop adaptation (adaptation_*/shadow_eval/promotion events):
+    # candidates fine-tuned, shadow argmax agreement with the live model,
+    # and the gate's promote/rollback counts.  Non-adaptation rows show
+    # "-" across all four.
+    ("adapt_candidates", "candidates"),
+    ("shadow_agreement", "shadow_agree"),
+    ("promotions", "promotions"), ("rollbacks", "rollbacks"),
 )
 
 

@@ -29,6 +29,11 @@ end-to-end instead, timing every stage and leaving the artifacts on disk:
   6c. ``scripts/trace_report.py --require-cross-process``: stitch the
      fleet-kill run's router + replica journals into per-trace trees and
      require >= 1 complete cross-process trace (``trace-stitch`` stage)
+  6d. ``scripts/adapt_bench.py --selftest``: closed-loop online
+     adaptation — a drifted session loses accuracy, labeled replay
+     fine-tunes a candidate off the hot path, the shadow gate promotes
+     it, accuracy recovers, and a mid-load rollback restores the prior
+     digest with zero failed requests (``adapt-loop`` stage)
   7. viz figures (temporal/spatial/PSD) saved from the trained checkpoint
 
 Stage walls and exit codes land in ``<root>/rehearsal.json``.  Run on the
@@ -225,6 +230,17 @@ def main(argv=None) -> int:
          str(fleet_dir), "--require-cross-process",
          "--chrome", str(root / "fleet_trace.chrome.json")],
         root, record, platform="cpu")
+    # Closed-loop adaptation drill: a live session drifts (EMS-resistant
+    # affine corruption), accuracy collapses, posted labels trigger a
+    # background fine-tune, the candidate earns promotion through the
+    # shadow gate, and post-promotion accuracy recovers — then a rollback
+    # under concurrent load restores the prior digest with zero failed
+    # requests.  Selftest asserts every floor and the causal journal
+    # order (drift -> adaptation -> shadow -> promotion).
+    ok = ok and run_stage(
+        "adapt-loop",
+        [py, str(REPO / "scripts" / "adapt_bench.py"), "--selftest"],
+        root, record, platform=args.platform, timeout=1800.0)
     # Bench regression sentinel: the fresh BENCH artifacts this rehearsal
     # just measured must sit within tolerance of the committed perf
     # trajectory (same-platform pairs only — cross-platform pairs skip).

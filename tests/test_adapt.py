@@ -1,0 +1,399 @@
+"""Closed-loop online adaptation: gate policy, replay buffer bounds, the
+``POST /session/<id>/label`` contract, label durability across
+snapshot/resume and export/import, and the ``adapt_bench.py --selftest``
+acceptance leg (drift -> labeled replay -> fine-tune -> shadow ->
+promotion -> recovery, plus rollback under load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import jax  # noqa: E402
+
+from eegnetreplication_tpu.adapt.buffer import ReplayBuffer  # noqa: E402
+from eegnetreplication_tpu.adapt.gate import PromotionGate  # noqa: E402
+from eegnetreplication_tpu.models import EEGNet  # noqa: E402
+from eegnetreplication_tpu.obs import journal as obs_journal  # noqa: E402
+from eegnetreplication_tpu.obs import schema  # noqa: E402
+from eegnetreplication_tpu.serve.service import ServeApp  # noqa: E402
+from eegnetreplication_tpu.serve.sessions import (  # noqa: E402
+    SessionStore,
+    StreamSession,
+    WindowDecision,
+)
+from eegnetreplication_tpu.serve.sessions.session import (  # noqa: E402
+    STATUS_EXPIRED,
+    STATUS_OK,
+    LabelConflict,
+)
+from eegnetreplication_tpu.training.checkpoint import (  # noqa: E402
+    save_checkpoint,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+C, T = 4, 64
+HOP = 16
+BLOCK = 256
+
+
+# ---------------------------------------------------------------------------
+# PromotionGate: pure policy over the evaluator's cumulative stats.
+
+
+def _stats(n_trials=20, labeled_n=10, agreement=0.8, accuracy=0.9):
+    return {"n_trials": n_trials, "labeled_n": labeled_n,
+            "agreement": agreement, "accuracy": accuracy}
+
+
+class TestPromotionGate:
+    def test_waits_for_shadow_samples_then_labeled_evidence(self):
+        gate = PromotionGate(min_samples=12, min_labeled=8)
+        d = gate.decide(_stats(n_trials=11))
+        assert d.action == "wait" and "shadow samples" in d.reason
+        d = gate.decide(_stats(n_trials=12, labeled_n=7))
+        assert d.action == "wait" and "labeled evals" in d.reason
+
+    def test_promotes_only_above_accuracy_floor(self):
+        gate = PromotionGate(min_samples=4, min_labeled=4,
+                             accuracy_floor=0.55)
+        good = gate.decide(_stats(n_trials=8, labeled_n=8, accuracy=0.75))
+        assert good.action == "promote"
+        assert good.labeled_n == 8 and good.accuracy == 0.75
+        bad = gate.decide(_stats(n_trials=8, labeled_n=8, accuracy=0.5))
+        assert bad.action == "refuse" and "accuracy" in bad.reason
+
+    def test_agreement_floor_disabled_by_default(self):
+        """After a real drift the live model is the wrong reference, so
+        agreement must not gate by default — only when opted into."""
+        gate = PromotionGate(min_samples=1, min_labeled=1)
+        assert gate.decide(_stats(agreement=0.0)).action == "promote"
+        canary = PromotionGate(min_samples=1, min_labeled=1,
+                               agreement_floor=0.6)
+        d = canary.decide(_stats(agreement=0.3))
+        assert d.action == "refuse" and "agreement" in d.reason
+
+    def test_constructor_validation(self):
+        for kw in ({"min_samples": 0}, {"min_labeled": 0},
+                   {"accuracy_floor": 1.5}, {"agreement_floor": -0.1}):
+            with pytest.raises(ValueError):
+                PromotionGate(**kw)
+
+    def test_config_roundtrip(self):
+        gate = PromotionGate(min_samples=3, min_labeled=2,
+                             accuracy_floor=0.6, agreement_floor=0.1)
+        assert gate.config() == {"min_samples": 3, "min_labeled": 2,
+                                 "accuracy_floor": 0.6,
+                                 "agreement_floor": 0.1}
+
+
+# ---------------------------------------------------------------------------
+# ReplayBuffer: bounded capture ring + labeled set.
+
+
+def _win(seed: int) -> np.ndarray:
+    return np.random.RandomState(seed).randn(C, T).astype(np.float32)
+
+
+class TestReplayBuffer:
+    def test_observe_then_label_pairs_the_exact_window(self):
+        buf = ReplayBuffer()
+        w = _win(0)
+        buf.observe("m", "s", 0, w)
+        assert buf.label("m", "s", 0, 2) is True
+        assert buf.n_labeled("m") == 1
+        x, y = buf.dataset("m")
+        np.testing.assert_array_equal(x[0], w)
+        assert y.tolist() == [2]
+        np.testing.assert_array_equal(buf.window_for("m", "s", 0), w)
+
+    def test_label_without_capture_is_counted_not_fatal(self):
+        buf = ReplayBuffer()
+        assert buf.label("m", "s", 99, 1) is False
+        assert buf.stats("m")["unpaired_labels"] == 1
+        assert buf.n_labeled("m") == 0
+
+    def test_capture_ring_evicts_oldest(self):
+        buf = ReplayBuffer(window_capacity=4)
+        for i in range(6):
+            buf.observe("m", "s", i, _win(i))
+        # Windows 0 and 1 aged out of the ring: labeling them finds
+        # nothing to train on, the newest four still pair.
+        assert buf.label("m", "s", 0, 1) is False
+        assert buf.label("m", "s", 5, 1) is True
+
+    def test_labeled_set_is_bounded_fifo(self):
+        buf = ReplayBuffer(window_capacity=16, labeled_capacity=3)
+        for i in range(5):
+            buf.observe("m", "s", i, _win(i))
+            buf.label("m", "s", i, i % 4)
+        assert buf.n_labeled("m") == 3
+        x, y = buf.dataset("m")
+        assert y.tolist() == [2 % 4, 3 % 4, 4 % 4]
+
+    def test_relabel_of_paired_window_overwrites_y(self):
+        buf = ReplayBuffer()
+        buf.observe("m", "s", 0, _win(0))
+        buf.label("m", "s", 0, 1)
+        # The session layer enforces idempotence/conflicts; the buffer
+        # treats a re-label as an overwrite of y only.
+        assert buf.label("m", "s", 0, 3) is True
+        _, y = buf.dataset("m")
+        assert y.tolist() == [3]
+        assert buf.n_labeled("m") == 1
+
+    def test_tenants_are_isolated_and_clearable(self):
+        buf = ReplayBuffer()
+        buf.observe("a", "s", 0, _win(0))
+        buf.label("a", "s", 0, 1)
+        assert buf.n_labeled("b") == 0
+        buf.clear("a")
+        assert buf.n_labeled("a") == 0
+        assert buf.dataset("a")[0].shape == (0,)
+
+
+# ---------------------------------------------------------------------------
+# Session-layer label semantics (unit level, incl. the expired case the
+# HTTP path can't trigger deterministically).
+
+
+def _decided_session(n_windows: int = 4,
+                     store: SessionStore | None = None) -> StreamSession:
+    kwargs = dict(n_channels=C, window=T, hop=HOP,
+                  ems_init_block_size=BLOCK)
+    if store is None:
+        session = StreamSession("s", **kwargs)
+    else:
+        session, resumed = store.open("s", **kwargs)
+        assert not resumed
+    rng = np.random.RandomState(3)
+    ready = session.ingest(rng.randn(C, BLOCK + T + HOP * n_windows)
+                           .astype(np.float32))
+    for idx, start, _ in ready[:n_windows]:
+        session.record(WindowDecision(index=idx, start=start, pred=idx % 4,
+                                      status=STATUS_OK, latency_ms=1.0))
+    assert session.windows_decided >= n_windows
+    return session
+
+
+class TestSessionLabelSemantics:
+    def test_expired_window_is_a_conflict_not_a_crash(self):
+        session = StreamSession("s", n_channels=C, window=T, hop=HOP)
+        session.record(WindowDecision(index=0, start=0, pred=-1,
+                                      status=STATUS_EXPIRED, latency_ms=9.0))
+        with pytest.raises(LabelConflict, match="expired"):
+            session.label(0, 2)
+
+    def test_unknown_window_raises_keyerror_with_frontier(self):
+        session = _decided_session()
+        with pytest.raises(KeyError, match="frontier"):
+            session.label(session.windows_decided, 0)
+
+    def test_duplicate_and_conflict(self):
+        session = _decided_session()
+        assert session.label(1, 3) is True
+        assert session.label(1, 3) is False      # idempotent retry
+        with pytest.raises(LabelConflict, match="refusing"):
+            session.label(1, 2)
+
+    def test_labels_survive_state_roundtrip(self):
+        session = _decided_session()
+        session.label(0, 2)
+        session.label(3, 1)
+        restored = StreamSession.from_state("s", session.state_arrays())
+        assert restored.labels == {0: 2, 3: 1}
+        # And the restored session still enforces the conflict contract.
+        assert restored.label(0, 2) is False
+        with pytest.raises(LabelConflict):
+            restored.label(3, 0)
+
+    def test_pre_adaptation_snapshot_restores_labelless(self):
+        session = _decided_session()
+        session.label(0, 2)
+        flat = session.state_arrays()
+        del flat["lab_window"], flat["lab_label"]
+        assert StreamSession.from_state("s", flat).labels == {}
+
+    def test_labels_survive_store_snapshot_restore(self, tmp_path):
+        store = SessionStore(tmp_path / "sessions.npz")
+        session = _decided_session(store=store)
+        session.label(2, 3)
+        store.snapshot()
+        store.detach()
+        restored = SessionStore(tmp_path / "sessions.npz")
+        assert restored.restore() == ["s"]
+        assert restored.get("s").labels == {2: 3}
+        restored.detach()
+
+    def test_labels_survive_export_import(self, tmp_path):
+        source = SessionStore(tmp_path / "src.npz")
+        session = _decided_session(store=source)
+        session.label(1, 0)
+        wire = source.export_session("s")
+        target = SessionStore(tmp_path / "dst.npz")
+        imported = target.import_session(wire)
+        assert imported.labels == {1: 0}
+        source.detach()
+        target.detach()
+
+
+# ---------------------------------------------------------------------------
+# HTTP label endpoint contract.
+
+
+def _checkpoint(tmp_path: Path) -> Path:
+    model = EEGNet(n_channels=C, n_times=T)
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, C, T)),
+                           train=False)
+    return save_checkpoint(
+        tmp_path / "m.npz", variables["params"], variables["batch_stats"],
+        metadata={"model": "eegnet", "n_channels": C, "n_times": T,
+                  "F1": model.F1, "D": model.D})
+
+
+def _post(url, data, ctype="application/json"):
+    req = urllib.request.Request(url, data=data,
+                                 headers={"Content-Type": ctype})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read().decode())
+
+
+class TestLabelEndpointHTTP:
+    @pytest.fixture
+    def app(self, tmp_path):
+        with obs_journal.run(tmp_path / "obs", config={}) as jr:
+            app = ServeApp(_checkpoint(tmp_path), buckets=(1, 8),
+                           sessions_dir=tmp_path / "sess",
+                           journal=jr).start()
+            try:
+                yield app, jr
+            finally:
+                app.stop()
+
+    def _opened(self, app, sid="L1", n_windows=4):
+        _post(app.url + "/session/open", json.dumps(
+            {"session": sid, "hop": HOP,
+             "ems_init_block_size": BLOCK}).encode())
+        rec = np.random.RandomState(5).randn(
+            C, BLOCK + T + HOP * n_windows).astype(np.float32)
+        reply = _post(app.url + f"/session/{sid}/samples",
+                      rec.astype("<f4").tobytes(),
+                      "application/octet-stream")
+        assert len(reply["decisions"]) >= n_windows
+        return sid
+
+    def _label(self, app, sid, window, label):
+        return _post(app.url + f"/session/{sid}/label",
+                     json.dumps({"window": window, "label": label}).encode())
+
+    def test_label_idempotence_conflict_and_journal(self, app):
+        app, jr = app
+        sid = self._opened(app)
+        first = self._label(app, sid, 0, 2)
+        assert first["fresh"] is True and first["labels"] == 1
+        again = self._label(app, sid, 0, 2)
+        assert again["fresh"] is False and again["labels"] == 1
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._label(app, sid, 0, 3)
+        assert err.value.code == 409
+        events = schema.read_events(jr.events_path, complete=False)
+        labels = [e for e in events if e["event"] == "session_label"]
+        # The idempotent retry and the conflict journal nothing: exactly
+        # one session_label event for the one fresh label.
+        assert len(labels) == 1
+        assert labels[0]["window"] == 0 and labels[0]["label"] == 2
+        assert labels[0]["live_pred"] is not None
+
+    def test_unknown_window_and_session_are_404_not_500(self, app):
+        app, _ = app
+        sid = self._opened(app)
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._label(app, sid, 10_000, 1)
+        assert err.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as err:
+            self._label(app, "ghost", 0, 1)
+        assert err.value.code == 404
+
+    def test_malformed_bodies_are_400(self, app):
+        app, _ = app
+        sid = self._opened(app)
+        for body in (b"not json", b"[]", b'{"window": 0}',
+                     json.dumps({"window": 0, "label": 99}).encode(),
+                     json.dumps({"window": -1, "label": 0}).encode()):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(app.url + f"/session/{sid}/label", body)
+            assert err.value.code == 400, body
+
+    def test_labels_survive_http_export_import(self, app, tmp_path):
+        app, _ = app
+        sid = self._opened(app, sid="M1")
+        self._label(app, sid, 1, 3)
+        with urllib.request.urlopen(app.url + f"/session/{sid}/export",
+                                    timeout=30) as resp:
+            wire = resp.read()
+        target = ServeApp(_checkpoint(tmp_path / "t2"), buckets=(1, 8),
+                          sessions_dir=tmp_path / "t2_sess").start()
+        try:
+            _post(app.url + f"/session/{sid}/discard", b"{}")
+            _post(target.url + "/session/import", wire,
+                  "application/octet-stream")
+            # The migrated stream enforces the same label contract:
+            # idempotent duplicate, 409 conflict.
+            dup = _post(target.url + f"/session/{sid}/label",
+                        json.dumps({"window": 1, "label": 3}).encode())
+            assert dup["fresh"] is False and dup["labels"] == 1
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _post(target.url + f"/session/{sid}/label",
+                      json.dumps({"window": 1, "label": 0}).encode())
+            assert err.value.code == 409
+        finally:
+            target.stop()
+
+    def test_labeling_works_with_adapt_off(self, app):
+        """Labels are durable session state; the adaptation loop is a
+        side effect, not a dependency (the fixture app has no --adapt)."""
+        app, _ = app
+        sid = self._opened(app)
+        reply = self._label(app, sid, 2, 1)
+        assert reply["fresh"] is True and reply["paired"] is False
+
+
+# ---------------------------------------------------------------------------
+# The acceptance leg: drift -> labels -> fine-tune -> shadow -> promote ->
+# recover, no-adaptation control stays broken, rollback under load.
+
+
+class TestAdaptBenchSelftest:
+    def test_selftest_passes(self, tmp_path):
+        out = tmp_path / "BENCH_ADAPT_selftest.json"
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "adapt_bench.py"),
+             "--selftest", "--out", str(out)],
+            capture_output=True, text=True, timeout=900,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu"))
+        assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads(out.read_text())
+        rec = record["recovery"]
+        assert rec["promotions"] >= 1 and rec["promotion_errors"] == 0
+        assert rec["failed_requests"] == 0
+        assert rec["journal_order_ok"] is True
+        assert rec["recovered_accuracy"] >= 0.55
+        assert rec["drifted_accuracy"] < rec["pre_drift_accuracy"]
+        # The no-adaptation control proves recovery is causal, not the
+        # EMS healing the drift on its own.
+        assert record["latency"]["no_adapt_control_accuracy"] < 0.55
+        assert record["rollback"]["failed_requests"] == 0
+        assert record["rollback"]["digest_restored"] is True

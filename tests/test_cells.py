@@ -20,12 +20,15 @@ truth (real engines, real processes, real SIGKILL) is the selftest leg
 and the chaos drill's ``cell.failover`` leg.
 """
 
+import io
 import json
 import os
+import struct
 import subprocess
 import sys
 import threading
 import time
+import zipfile
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 
@@ -60,6 +63,24 @@ def _session_state(sid: str = "s1", acked: int = 160) -> dict:
         session.record(WindowDecision(index=idx, start=start, pred=1,
                                       status="ok", latency_ms=1.0))
     return session.state_arrays()
+
+
+def _tamper_payload_array(payload: bytes, name: str) -> bytes:
+    """Flip one byte in the middle of ``name``'s compressed data inside
+    a packed session export.  Targeting a real array entry (rather than
+    a fixed byte offset) keeps the tamper meaningful as the state layout
+    grows: a flip in zip bookkeeping like a mod-time field leaves the
+    restored content byte-identical, which the content digest rightly
+    accepts."""
+    zi = zipfile.ZipFile(io.BytesIO(payload)).getinfo(name)
+    # Local file header: data starts after the 30-byte fixed header plus
+    # the filename and extra fields (lengths at offsets 26 and 28).
+    n, m = struct.unpack(
+        "<HH", payload[zi.header_offset + 26:zi.header_offset + 30])
+    data_off = zi.header_offset + 30 + n + m
+    bad = bytearray(payload)
+    bad[data_off + zi.compress_size // 2] ^= 0xFF
+    return bytes(bad)
 
 
 class FakeCell:
@@ -513,9 +534,8 @@ class TestCellFrontSessions:
             fakes = {"c0": fake0, "c1": fake1}
             home = opened["cell"]
             good = session_store.pack_session("s1", _session_state("s1"))
-            bad = bytearray(good)
-            bad[len(bad) // 2] ^= 0xFF
-            fakes[home].export_payload = bytes(bad)
+            fakes[home].export_payload = _tamper_payload_array(
+                good, "s/s1/buf.npy")
             status, result = _post(f"{front.url}/cell/{home}/drain")
             assert status == 207 and result["failed"] == ["s1"], result
             assert front.cell_of("s1").cell_id == home
