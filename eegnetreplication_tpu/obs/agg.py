@@ -215,6 +215,20 @@ class _RunView:
         self.promotions = 0
         self.promotion_refusals = 0
         self.adapt_rollbacks = 0
+        # Front-tier HA (front_lease/affinity_replay events): who holds
+        # the fencing lease at what token, plus role-churn counters.
+        self.lease_owner: str | None = None
+        self.lease_token: int | None = None
+        self.lease_role: str | None = None
+        self.lease_takeovers = 0
+        self.lease_fenced = 0
+        self.affinity_replays = 0
+        # Rolling upgrades (cell_upgrade) + replicated spool
+        # (spool_mirror) activity.
+        self.upgrading_cell: str | None = None
+        self.cells_upgraded = 0
+        self.upgrade_rollbacks = 0
+        self.mirror_restores = 0
 
     # -- folding ----------------------------------------------------------
     def fold(self, events: list[dict]) -> None:
@@ -334,6 +348,39 @@ class _RunView:
         elif action == "rollback":
             self.adapt_rollbacks += 1
 
+    def _on_front_lease(self, ev, t):
+        action = ev.get("action")
+        self.lease_owner = ev.get("owner")
+        token = _num(ev.get("token"))
+        if token is not None:
+            self.lease_token = int(token)
+        self.lease_role = {"acquire": "active", "takeover": "active",
+                           "standby": "standby", "fenced": "fenced",
+                           "release": "released"}.get(action,
+                                                      self.lease_role)
+        if action == "takeover":
+            self.lease_takeovers += 1
+        elif action == "fenced":
+            self.lease_fenced += 1
+
+    def _on_affinity_replay(self, ev, t):
+        self.affinity_replays += 1
+
+    def _on_cell_upgrade(self, ev, t):
+        action = ev.get("action")
+        if action == "drain":
+            self.upgrading_cell = str(ev.get("cell"))
+        elif action == "undrain":
+            self.cells_upgraded += 1
+            self.upgrading_cell = None
+        elif action == "rollback":
+            self.upgrade_rollbacks += 1
+            self.upgrading_cell = None
+
+    def _on_spool_mirror(self, ev, t):
+        if ev.get("action") == "restored":
+            self.mirror_restores += 1
+
     def _on_probe(self, ev, t):
         if t is not None:
             self._probes.append((t, ev.get("status"),
@@ -400,6 +447,20 @@ class _RunView:
                             "ups": self.scale_ups,
                             "downs": self.scale_downs,
                             "forced": self.scale_forced}
+        if self.lease_owner is not None:
+            out["lease"] = {"owner": self.lease_owner,
+                            "token": self.lease_token,
+                            "role": self.lease_role,
+                            "takeovers": self.lease_takeovers,
+                            "fenced": self.lease_fenced,
+                            "replays": self.affinity_replays}
+        if (self.cells_upgraded or self.upgrade_rollbacks
+                or self.upgrading_cell):
+            out["upgrade"] = {"done": self.cells_upgraded,
+                              "rollbacks": self.upgrade_rollbacks,
+                              "draining": self.upgrading_cell}
+        if self.mirror_restores:
+            out["mirror_restores"] = self.mirror_restores
         if (self.adapt_candidates or self.promotions
                 or self.promotion_refusals or self.adapt_rollbacks
                 or self._shadow):

@@ -149,6 +149,18 @@ EVENT_REQUIRED: dict[str, tuple[str, ...]] = {
     "session_migrate": ("session", "from_cell", "to_cell"),
     "session_failover": ("session", "from_cell", "to_cell"),
     "cell_front_end": ("n_requests", "wall_s"),
+    # Front-tier HA + rolling upgrades (serve/cells/ha.py): fencing-
+    # lease transitions (acquire/standby/takeover/fenced/release — a
+    # takeover is journaled BEFORE the first request the new active
+    # serves), the standby's exact-table WAL replay at promotion, every
+    # rolling-upgrade step (drain/relaunch/live/shadow/undrain/timeout/
+    # abort/rollback, strictly serialized per cell), and mirror-spool
+    # activity (failover restores from the replica copy + failed mirror
+    # writes).
+    "front_lease": ("action", "owner", "token"),
+    "affinity_replay": ("n_records", "n_sessions"),
+    "cell_upgrade": ("cell", "action"),
+    "spool_mirror": ("action",),
     # Gray-failure defenses (ISSUE 10): latency-outlier ejection /
     # half-open re-admission of a degraded replica, every hedged
     # dispatch, and adaptive-admission decisions (AIMD limit moves +
@@ -689,6 +701,28 @@ def event_summary(events: list[dict]) -> dict[str, Any]:
                                   if e.get("state") == "failed")
         out["session_migrations"] = len(migrations)
         out["session_failovers"] = len(cell_failovers)
+        out["spool_errors"] = sum(1 for e in cell_failovers
+                                  if e.get("action") == "spool_error")
+    # Front-tier HA + rolling upgrades: lease role churn (takeovers and
+    # self-fencings), WAL replays at promotion, per-cell upgrade
+    # completions vs rollbacks, and mirror-spool fallback activity —
+    # only reported for HA/upgrade-active streams.
+    leases = [e for e in events if e["event"] == "front_lease"]
+    replays = [e for e in events if e["event"] == "affinity_replay"]
+    upgrades = [e for e in events if e["event"] == "cell_upgrade"]
+    mirrors = [e for e in events if e["event"] == "spool_mirror"]
+    if leases or replays or upgrades or mirrors:
+        out["lease_takeovers"] = sum(1 for e in leases
+                                     if e.get("action") == "takeover")
+        out["front_fenced"] = sum(1 for e in leases
+                                  if e.get("action") == "fenced")
+        out["affinity_replays"] = len(replays)
+        out["cells_upgraded"] = sum(1 for e in upgrades
+                                    if e.get("action") == "undrain")
+        out["upgrade_rollbacks"] = sum(1 for e in upgrades
+                                       if e.get("action") == "rollback")
+        out["mirror_restores"] = sum(1 for e in mirrors
+                                     if e.get("action") == "restored")
     # Gray-failure defenses: outlier ejections/readmissions, hedged
     # dispatches (and how many the hedge won), and AIMD admission moves —
     # only reported when the machinery actually acted, so other rows stay

@@ -47,6 +47,8 @@ def _run_row(r: dict) -> list[str]:
     probes = r.get("probes") or {}
     scale = r.get("scale") or {}
     adapt = r.get("adapt") or {}
+    lease = r.get("lease") or {}
+    upgrade = r.get("upgrade") or {}
     return [
         _short(r.get("run_id")), r.get("role", "run"),
         r.get("status", "?"),
@@ -68,13 +70,20 @@ def _run_row(r: dict) -> list[str]:
         _cell(adapt.get("shadow_agreement")),
         (f"{adapt.get('promotions')}p/{adapt.get('refusals')}r"
          f"/{adapt.get('rollbacks')}b" if adapt else "-"),
+        # Front-tier HA: the fencing-lease holder at its token epoch and
+        # its current role letter (act/sby/fen) — the column an operator
+        # watches during a failover drill.
+        (f"{lease.get('owner')}#{lease.get('token')}"
+         f"/{str(lease.get('role') or '?')[:3]}" if lease else "-"),
+        (f"{upgrade.get('done')}u/{upgrade.get('rollbacks')}b"
+         if upgrade else "-"),
     ]
 
 
 _HEADERS = ["run", "role", "status", "rps", "p50_ms", "p95_ms", "non_ok",
             "members", "scale", "circuit", "ejected", "slo_breach",
             "fold-ep/s", "probes", "candidates", "shadow_agree",
-            "promote/ref/rb"]
+            "promote/ref/rb", "leader", "upgrade"]
 
 
 def render(snap: dict) -> str:

@@ -108,6 +108,19 @@ site                  action     effect
                                  gate's reload — a promotion that dies
                                  mid-swap must leave the prior tenant
                                  serving untouched
+``front.lease``       raise      ``OSError`` at the HA front's fencing-
+                                 lease write — renews fail, driving the
+                                 active front through its self-fence
+                                 path (and, left armed, the standby
+                                 cannot acquire either: the pair
+                                 degrades to hints-only instead of
+                                 split-brain)
+``spool.mirror``      corrupt    garble the STAGED mirror-spool bytes
+                                 before ``tmp.replace`` — the torn
+                                 mirror write; the mirror's own
+                                 generation chain must absorb it, and a
+                                 primary+mirror double corruption is the
+                                 (journaled) restart-from-zero floor
 ====================  =========  ==========================================
 
 Unlike ``sleep=`` (an unbounded silent stall — the watchdog/supervisor
@@ -144,7 +157,7 @@ SITES = ("fetch.download", "data.read", "train.step", "checkpoint.write",
          "serve.forward", "train.hang", "serve.hang", "session.snapshot",
          "session.restore", "serve.degrade", "replica.network",
          "cell.partition", "fleet.scale", "session.drift", "adapt.train",
-         "adapt.promote")
+         "adapt.promote", "front.lease", "spool.mirror")
 
 ACTIONS = ("raise", "corrupt", "preempt", "sleep", "slow", "truncate",
            "refuse", "drift")
@@ -235,6 +248,10 @@ _DEFAULTS: dict[str, tuple[str, str | None, str | None]] = {
                     "injected fault: adapt.train (hit {hit})"),
     "adapt.promote": ("raise", "RuntimeError",
                       "injected fault: adapt.promote (hit {hit})"),
+    "front.lease": ("raise", "OSError",
+                    "injected fault: front.lease (hit {hit})"),
+    "spool.mirror": ("corrupt", "OSError",
+                     "injected fault: spool.mirror (hit {hit})"),
 }
 
 

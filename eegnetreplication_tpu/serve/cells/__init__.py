@@ -6,9 +6,23 @@ a thin :class:`~eegnetreplication_tpu.serve.cells.front.CellFront` that
 routes bulk traffic least-loaded and sessions by sticky affinity, with
 planned session migration (``/cell/<id>/drain``) and unplanned
 cross-cell session failover from each cell's snapshot spool.
+
+``cells/ha.py`` removes the front's own SPOF: two fronts run as an
+active/standby pair over a shared fencing lease + affinity WAL
+(:class:`~eegnetreplication_tpu.serve.cells.ha.HAController`), and the
+active orchestrates rolling cell upgrades
+(:class:`~eegnetreplication_tpu.serve.cells.ha.RollingUpgrade`, served
+as ``POST /cells/upgrade``).
 """
 
 from eegnetreplication_tpu.serve.cells.front import CellFront, MigrationError
+from eegnetreplication_tpu.serve.cells.ha import (
+    AffinityWAL,
+    FencingLease,
+    HAController,
+    RollingUpgrade,
+    UpgradeInProgress,
+)
 from eegnetreplication_tpu.serve.cells.membership import (
     CellMember,
     CellMembership,
@@ -16,5 +30,6 @@ from eegnetreplication_tpu.serve.cells.membership import (
     FAILED,
 )
 
-__all__ = ["CellFront", "CellMember", "CellMembership", "DISPATCHABLE",
-           "FAILED", "MigrationError"]
+__all__ = ["AffinityWAL", "CellFront", "CellMember", "CellMembership",
+           "DISPATCHABLE", "FAILED", "FencingLease", "HAController",
+           "MigrationError", "RollingUpgrade", "UpgradeInProgress"]

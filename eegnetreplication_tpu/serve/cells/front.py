@@ -106,6 +106,12 @@ class CellFront:
         self._session_locks: dict[str, threading.Lock] = {}
         self.sessions_migrated = 0
         self.sessions_failed_over = 0
+        # Front-tier HA (serve/cells/ha.py): an HAController makes this
+        # front one half of an active/standby pair — ``None`` keeps the
+        # single-front behaviour exactly (is_leader is then always
+        # true).  An attached RollingUpgrade serves POST /cells/upgrade.
+        self.ha = None
+        self.upgrader = None
         self._host, self._port = host, int(port)
         self._httpd: ThreadingHTTPServer | None = None
         self._listener: threading.Thread | None = None
@@ -209,6 +215,36 @@ class CellFront:
         else:
             trace.flush_if_anomalous(status, journal=self.journal)
 
+    # -- HA role -----------------------------------------------------------
+    @property
+    def is_leader(self) -> bool:
+        """Single fronts are always leader; an HA front serves traffic
+        only while its controller holds the fencing lease."""
+        return self.ha is None or self.ha.role == "active"
+
+    def _wal_append(self, op: str, sid: str, cell_id: str | None = None,
+                    resync: bool = False) -> None:
+        """Append one affinity mutation to the HA WAL — called UNDER the
+        table lock so WAL order is exactly table-mutation order.  Gated
+        on the live leader check: a standby installing a replay writes
+        the table directly and must never echo records back, and a
+        fenced ex-active must not extend the log the new leader owns."""
+        ha = self.ha
+        if ha is None or ha.role != "active":
+            return
+        try:
+            ha.wal.append(op, sid, cell_id, resync=resync)
+        except OSError as exc:
+            logger.warning("Affinity WAL append (%s %s) failed: %s", op,
+                           sid, exc)
+
+    def _install_affinity(self, affinity: dict[str, str],
+                          resync: set[str]) -> None:
+        """Replace the whole routing table (the standby's WAL replay)."""
+        with self._table_lock:
+            self._affinity = dict(affinity)
+            self._needs_resync = set(resync)
+
     # -- affinity ----------------------------------------------------------
     def _session_lock(self, sid: str) -> threading.Lock:
         with self._table_lock:
@@ -250,8 +286,10 @@ class CellFront:
         failover for everything stuck to it.  Runs on a background
         thread — the hook fires from the health poller AND from dispatch
         threads (dead-connection pulls), and neither may block on N
-        import round-trips."""
-        if state != cms.FAILED:
+        import round-trips.  Leader-gated: the standby polls cell health
+        too (so its view is warm at takeover) but must not consume
+        spools or move sessions — promotion re-runs this scan."""
+        if state != cms.FAILED or not self.is_leader:
             return
         sids = self._sessions_on(cell.cell_id)
         if not sids:
@@ -289,8 +327,34 @@ class CellFront:
                     data = session_store.read_spooled_session(
                         from_cell.spool, sid)
                 except Exception as exc:  # noqa: BLE001 — spool best-effort
+                    # Journaled, not just logged: a spool-read failure is
+                    # the precursor to a session restarting from zero —
+                    # drills and event_summary assert on it.
+                    self.journal.event(
+                        "session_failover", session=sid,
+                        from_cell=from_cell.cell_id,
+                        to_cell=target.cell_id, action="spool_error",
+                        reason=f"{type(exc).__name__}: {exc}"[:200])
                     logger.warning("Reading spool %s for session %s "
                                    "failed: %s", from_cell.spool, sid, exc)
+            mirror = getattr(from_cell, "mirror", None)
+            if data is None and mirror is not None:
+                # Replicated spool: the primary copy is missing, torn, or
+                # quarantined — the write-both mirror answers, and the
+                # fallback is journaled so H3 pins it.
+                try:
+                    data = session_store.read_spooled_session(mirror, sid)
+                except Exception as exc:  # noqa: BLE001 — same containment
+                    self.journal.event(
+                        "spool_mirror", action="error", session=sid,
+                        cell=from_cell.cell_id,
+                        reason=f"{type(exc).__name__}: {exc}"[:200])
+                else:
+                    if data is not None:
+                        self.journal.event("spool_mirror",
+                                           action="restored", session=sid,
+                                           cell=from_cell.cell_id)
+                        self.journal.metrics.inc("spool_mirror_restores")
             restored, acked = False, None
             if data is not None:
                 try:
@@ -314,6 +378,7 @@ class CellFront:
                 self._affinity[sid] = target.cell_id
                 self._needs_resync.add(sid)
                 self.sessions_failed_over += 1
+                self._wal_append("flip", sid, target.cell_id, resync=True)
             self.journal.event("session_failover", session=sid,
                                from_cell=from_cell.cell_id,
                                to_cell=target.cell_id,
@@ -354,6 +419,7 @@ class CellFront:
                 # No resync: the export captured the client's exact
                 # position (the stream was quiesced under our lock).
                 self._needs_resync.discard(sid)
+                self._wal_append("flip", sid, target.cell_id)
             try:
                 source.client.request("POST", f"/session/{sid}/discard",
                                       body=b"")
@@ -400,10 +466,15 @@ class CellFront:
                 "migrated": migrated, "failed": failed}
 
     def undrain_cell(self, cell: cms.CellMember) -> None:
-        """Release an operator drain; the next healthy poll re-LIVEs it."""
+        """Release an operator drain; the next healthy poll re-LIVEs it.
+
+        FAILED is also a legal source: a rolling upgrade retires the
+        drained cell's process, and the kill flips the pinned cell
+        DRAINING -> FAILED (dead connection / dark healthz) — a state
+        the pinned poller then never leaves on its own."""
         cell.pinned = False
         self.membership.set_state(cell, cms.JOINING, "undrained",
-                                  only_from=(cms.DRAINING,))
+                                  only_from=(cms.DRAINING, cms.FAILED))
 
     # -- resync handshake --------------------------------------------------
     def needs_resync(self, sid: str) -> bool:
@@ -419,6 +490,7 @@ class CellFront:
             self._affinity.pop(sid, None)
             self._needs_resync.discard(sid)
             self._session_locks.pop(sid, None)
+            self._wal_append("drop", sid)
 
 
 class _CellFrontHandler(JsonRequestHandler):
@@ -468,8 +540,16 @@ class _CellFrontHandler(JsonRequestHandler):
             n_live = sum(1 for c in snapshot if c["state"] == cms.LIVE)
             with front._table_lock:
                 n_sessions = len(front._affinity)
-            self._reply(200 if n_live else 503, {
+            # A standby/fenced front answers 200: its healthz is how
+            # clients DISCOVER the pair's roles and the leader hint —
+            # only the leader's health couples to cell liveness.
+            healthy = bool(n_live) or not front.is_leader
+            self._reply(200 if healthy else 503, {
                 "status": "ok" if n_live else "no_live_cells",
+                "role": ("active" if front.ha is None
+                         else front.ha.role),
+                "leader": (front.ha.leader_hint()
+                           if front.ha is not None else None),
                 "n_cells": len(snapshot), "n_live": n_live,
                 "sessions": n_sessions,
                 "sessions_migrated": front.sessions_migrated,
@@ -483,6 +563,8 @@ class _CellFrontHandler(JsonRequestHandler):
             return
         parts = self.path.strip("/").split("/")
         if len(parts) == 3 and parts[0] == "session" and parts[2] == "state":
+            if not self._leader_gate():
+                return
             # Bracketed like do_POST: stop() must wait for this forward
             # or closing the pooled clients mid-flight would fail it with
             # an OSError that marks a healthy cell unreachable.
@@ -498,6 +580,8 @@ class _CellFrontHandler(JsonRequestHandler):
 
     def do_POST(self):  # noqa: N802 — stdlib naming
         front = self.front
+        if not self._leader_gate():
+            return
         front.begin_request()
         try:
             parts = self.path.strip("/").split("/")
@@ -505,6 +589,8 @@ class _CellFrontHandler(JsonRequestHandler):
                 self._predict()
             elif self.path == "/session/open":
                 self._session_open()
+            elif self.path == "/cells/upgrade":
+                self._upgrade()
             elif len(parts) == 3 and parts[0] == "session" \
                     and parts[2] == "samples":
                 self._session_samples(parts[1])
@@ -523,6 +609,22 @@ class _CellFrontHandler(JsonRequestHandler):
                 self._reply(404, {"error": f"unknown path {self.path}"})
         finally:
             front.end_request()
+
+    def _leader_gate(self) -> bool:
+        """Non-leader fronts serve NOTHING but discovery: every serving
+        and operator route answers 503 with the advertised leader URL so
+        the client's next attempt lands on the right half of the pair.
+        The body is drained first — an unread body desyncs keep-alive
+        clients."""
+        front = self.front
+        if front.is_leader:
+            return True
+        self._read_body()
+        ha = front.ha
+        self._reply(503, {"error": f"front {ha.owner!r} is {ha.role}, "
+                                   "not the leader",
+                          "role": ha.role, "leader": ha.leader_hint()})
+        return False
 
     # -- bulk --------------------------------------------------------------
     def _predict(self) -> None:
@@ -681,6 +783,7 @@ class _CellFrontHandler(JsonRequestHandler):
             if status == 200:
                 with front._table_lock:
                     front._affinity[sid] = cell.cell_id
+                    front._wal_append("assign", sid, cell.cell_id)
                 front.clear_resync(sid)
                 try:
                     reply = json.loads(data.decode())
@@ -763,3 +866,40 @@ class _CellFrontHandler(JsonRequestHandler):
             return
         self.front.undrain_cell(cell)
         self._reply(200, {"cell": cell.cell_id, "state": cell.state})
+
+    def _upgrade(self) -> None:
+        """POST /cells/upgrade: front-orchestrated rolling upgrade.
+        Blocks until the loop finishes (strictly serialized, so wall is
+        cells x drain+relaunch) and replies the terminal status —
+        ``rolled_back`` is a 200: the rollback SUCCEEDING is the safe
+        outcome the operator asked this orchestrator to guarantee."""
+        front = self.front
+        body = self._read_body()
+        if front.upgrader is None:
+            self._reply(501, {"error": "no upgrader wired: this front "
+                                       "does not supervise its cells"})
+            return
+        try:
+            payload = json.loads(body.decode() or "{}")
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, UnicodeDecodeError) as exc:
+            self._reply(400, {"error": f"{type(exc).__name__}: {exc}"})
+            return
+        serve_args = payload.get("serveArgs")
+        if serve_args is not None and (
+                not isinstance(serve_args, list)
+                or not all(isinstance(a, str) for a in serve_args)):
+            self._reply(400, {"error": "serveArgs must be a list of "
+                                       "strings"})
+            return
+        from eegnetreplication_tpu.serve.cells.ha import UpgradeInProgress
+        try:
+            result = front.upgrader.run(
+                checkpoint=payload.get("checkpoint"),
+                serve_args=serve_args,
+                live_timeout_s=payload.get("liveTimeoutS"))
+        except UpgradeInProgress as exc:
+            self._reply(409, {"error": str(exc)})
+            return
+        self._reply(200, result)

@@ -88,10 +88,15 @@ class CellMember(ms.Replica):
     shared storage (what unplanned failover restores from)."""
 
     def __init__(self, cell_id: str, url: str, *,
-                 spool: str | Path | None = None, journal=None):
+                 spool: str | Path | None = None,
+                 mirror: str | Path | None = None, journal=None):
         super().__init__(cell_id, url, journal=journal)
         self.client = _PartitionableClient(self.url, cell_id)
         self.spool = Path(spool) if spool is not None else None
+        # Replicated spool (PR 20): where the cell's SessionStore
+        # mirrors its snapshots — failover's fallback when the primary
+        # copy is missing or quarantined.
+        self.mirror = Path(mirror) if mirror is not None else None
         self.n_live: int | None = None      # fleet cells: live replicas
         self.n_sessions: int | None = None  # advertised open sessions
         self.slo_any_breached = False
@@ -113,7 +118,8 @@ class CellMember(ms.Replica):
                     n_sessions=self.n_sessions,
                     slo_any_breached=self.slo_any_breached,
                     pinned=self.pinned,
-                    spool=str(self.spool) if self.spool else None)
+                    spool=str(self.spool) if self.spool else None,
+                    mirror=str(self.mirror) if self.mirror else None)
         return snap
 
 

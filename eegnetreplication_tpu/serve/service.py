@@ -206,6 +206,7 @@ class ServeApp:
                  breaker_reset_s: float = 30.0,
                  watchdog_thresholds: dict | None = None,
                  sessions_dir: str | Path | None = None,
+                 sessions_mirror: str | Path | None = None,
                  session_snapshot_every: int = 50,
                  resume: bool = False,
                  precision: str = "fp32",
@@ -265,9 +266,13 @@ class ServeApp:
         # newest valid snapshot generation BEFORE the listener binds, so a
         # resuming client's first poll already sees its acked cursor.
         self.sessions_dir = Path(sessions_dir) if sessions_dir else None
+        self.sessions_mirror = (Path(sessions_mirror) if sessions_mirror
+                                else None)
         self.sessions = SessionStore(
             self.sessions_dir / "sessions.npz" if self.sessions_dir
             else None,
+            mirror=(self.sessions_mirror / "sessions.npz"
+                    if self.sessions_mirror else None),
             snapshot_every_windows=session_snapshot_every,
             journal=self.journal)
         if resume:
@@ -1677,6 +1682,12 @@ def main(argv=None) -> int:
                         help="Snapshot session state every N decided "
                              "windows (plus at every close and at the "
                              "SIGTERM drain).")
+    parser.add_argument("--sessionsMirror", type=str, default=None,
+                        help="Second directory (ideally another disk or "
+                             "share) every session snapshot is ALSO "
+                             "written to — the replicated spool cell "
+                             "failover falls back to when the primary "
+                             "copy is corrupt or missing.")
     parser.add_argument("--probeIntervalS", type=float, default=0.0,
                         help="Black-box self-probing interval in seconds "
                              "(0 = off): POST a known-answer canary to "
@@ -1829,6 +1840,7 @@ def main(argv=None) -> int:
                        breaker_threshold=args.breakerThreshold,
                        breaker_reset_s=args.breakerResetS,
                        sessions_dir=sessions_dir,
+                       sessions_mirror=args.sessionsMirror,
                        session_snapshot_every=args.sessionSnapshotEvery,
                        resume=args.resume, journal=journal,
                        precision=args.precision,

@@ -206,8 +206,15 @@ class SessionStore:
     """
 
     def __init__(self, path: str | Path | None, *, keep: int | None = None,
+                 mirror: str | Path | None = None,
                  snapshot_every_windows: int = 50, journal=None):
         self.path = Path(path) if path is not None else None
+        # Replicated spool: every snapshot is ALSO written (same stamped
+        # bytes, same atomic discipline) to this second path — ideally a
+        # different disk/share — so failover survives the primary copy
+        # being corrupt or missing.  Mirror failures never fail the
+        # primary write; they journal a ``spool_mirror`` event instead.
+        self.mirror = Path(mirror) if mirror is not None else None
         self.keep = keep
         self.snapshot_every_windows = max(1, int(snapshot_every_windows))
         self._journal = journal if journal is not None \
@@ -431,6 +438,8 @@ class SessionStore:
             tmp.replace(self.path)
             self.snapshots += 1
             self._windows_at_last_snap = total_windows
+            if self.mirror is not None:
+                self._write_mirror(flat, n_sessions)
             # Journal INSIDE the write lock: a background periodic
             # snapshot racing the drain snapshot must emit its event
             # before the drain's (and so always before serve_end).
@@ -442,6 +451,31 @@ class SessionStore:
                          "window(s) -> %s", n_sessions, total_windows,
                          self.path)
         return self.path
+
+    def _write_mirror(self, flat: dict, n_sessions: int) -> None:
+        """Write-both half of the replicated spool: the SAME stamped
+        flat mapping the primary just persisted, atomic tmp+replace,
+        under the snapshot lock.  Fires the ``spool.mirror`` chaos site
+        (default: corrupt the staged bytes) so drills can prove the
+        mirror's own generation-chain fallback.  Failure is contained —
+        the primary snapshot already landed."""
+        try:
+            self.mirror.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.mirror.with_suffix(self.mirror.suffix + ".tmp")
+            with open(tmp, "wb") as fh:
+                np.savez(fh, **flat)
+            inject.fire("spool.mirror", path=tmp, n_sessions=n_sessions)
+            rotate_generations(
+                self.mirror, self.keep if self.keep is not None
+                else snapshot_keep())
+            tmp.replace(self.mirror)
+            self._journal.metrics.inc("session_mirror_writes")
+        except Exception as exc:  # noqa: BLE001 — mirror is best-effort
+            self._journal.event("spool_mirror", action="write_failed",
+                                path=str(self.mirror),
+                                reason=f"{type(exc).__name__}: {exc}"[:200])
+            logger.warning("Session mirror write to %s failed: %s",
+                           self.mirror, exc)
 
     def maybe_snapshot(self) -> bool:
         """Kick off a BACKGROUND snapshot when ``snapshot_every_windows``

@@ -496,6 +496,88 @@ def leg_cell_failover(root: Path) -> None:
             and e.get("restored")], "failover did not restore from spool"
 
 
+def leg_front_failover(root: Path) -> None:
+    """The zero-SPOF front drill (ISSUE 20 H1): two real front processes
+    over two real cells, SIGKILL the ACTIVE front under mixed
+    bulk+session load.  The standby must promote off the fencing lease,
+    rebuild the exact affinity table from the WAL, and its own journal
+    must pin ``front_lease takeover`` (preceded by ``affinity_replay``)
+    strictly before ANY request it serves; the resumed stream is
+    byte-equal with zero conflicts and bulk completes with zero failures
+    after at most one hinted leader switch."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import serve_bench
+    import stream_bench
+
+    leg_root = root / "front_failover"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    ckpt = serve_bench.make_synthetic_checkpoint(leg_root, 4, 64)
+    x = stream_bench.make_recording(4, 1500, seed=7)
+    record = serve_bench.run_ha_failover_leg(
+        ckpt, x, hop=16, init_block=375, chunk=25, rate_hz=500.0,
+        root=leg_root, ttl_s=1.0, bulk_requests=120)
+    assert record["lease_takeovers"] >= 1, record
+    assert record["takeover_before_first_request"], record
+    assert record["replayed_sessions"] >= 1, record
+    assert record["decisions_equal"], record
+    assert record["duplicate_conflicts"] == 0, record
+    assert record["bulk"]["failures"] == 0, record["bulk"]
+    assert record["bulk"]["max_hint_retries"] <= 1, record["bulk"]
+    # The standby's journal additionally pins replay-before-takeover:
+    # the table is exact BEFORE the new active answers anything.
+    events = serve_bench._front_events(leg_root / "f1_obs")
+    kinds = [e["event"] for e in events]
+    assert "affinity_replay" in kinds and "front_lease" in kinds, set(kinds)
+    takeover_at = min(i for i, e in enumerate(events)
+                      if e["event"] == "front_lease"
+                      and e.get("action") == "takeover")
+    assert kinds.index("affinity_replay") < takeover_at, (
+        kinds.index("affinity_replay"), takeover_at)
+
+
+def leg_cell_upgrade(root: Path) -> None:
+    """The wedged-rolling-upgrade drill (ISSUE 20): POST /cells/upgrade
+    pointing at a missing checkpoint, under live session load.  The
+    upgraded cell can never come healthy, so the orchestrator must walk
+    drain -> relaunch -> timeout -> rollback, relaunch the OLD spec, and
+    journal the rollback with the cell recovered — zero session loss,
+    decision stream byte-equal."""
+    sys.path.insert(0, str(REPO / "scripts"))
+    import serve_bench
+    import stream_bench
+
+    leg_root = root / "cell_upgrade"
+    shutil.rmtree(leg_root, ignore_errors=True)
+    leg_root.mkdir(parents=True)
+    ckpt = serve_bench.make_synthetic_checkpoint(leg_root, 4, 64)
+    x = stream_bench.make_recording(4, 1500, seed=9)
+    with obs.run(root / "obs" / "cell_upgrade") as jr:
+        record = serve_bench.run_ha_upgrade_leg(
+            ckpt, x, hop=16, init_block=375, chunk=25, root=leg_root,
+            journal=jr, target_wall_s=20.0, bulk_requests=60,
+            upgrade_body={"checkpoint": str(leg_root / "missing.npz"),
+                          "liveTimeoutS": 20})
+    assert record["upgrade"].get("status") == "rolled_back", record
+    assert record["upgrade"].get("upgraded") == [], record
+    assert record["decisions_equal"], record
+    assert record["duplicate_conflicts"] == 0, record
+    events = _events(jr)
+    steps = [(e["cell"], e["action"]) for e in events
+             if e["event"] == "cell_upgrade"]
+    cell = record["upgrade"]["failed_cell"]
+    actions = [a for c, a in steps if c == cell]
+    for need in ("drain", "relaunch", "timeout", "rollback"):
+        assert need in actions, (need, actions)
+    assert actions.index("timeout") < actions.index("rollback"), actions
+    rollback = [e for e in events if e["event"] == "cell_upgrade"
+                and e["action"] == "rollback" and e["cell"] == cell]
+    assert rollback and rollback[-1].get("recovered"), rollback
+    # The cell came back serving the OLD model: its post-rollback digest
+    # matches what the never-upgraded sibling serves.
+    assert rollback[-1].get("digest"), rollback
+
+
 def _build_scale_fleet(root: Path, leg: str, jr, n: int = 1,
                        poll_s: float = 0.05):
     """An in-process elastic fleet for the autoscaler drills: real
@@ -1040,6 +1122,8 @@ LEGS = {
     "session.resume": leg_session_resume,
     "gray": leg_gray,
     "cell.failover": leg_cell_failover,
+    "front.failover": leg_front_failover,
+    "cell.upgrade": leg_cell_upgrade,
     "fleet.scale": leg_fleet_scale,
     "fleet.scale_kill": leg_fleet_scale_kill,
     "fleet.scale_resync": leg_fleet_scale_resync,
@@ -1054,8 +1138,9 @@ LEGS = {
 # single-sourced here so a site rename (or a typo'd new leg) breaks the
 # drill at import, not by silently never matching a site.
 _SCENARIO_LEGS = ("supervisor.hang", "session.resume", "gray",
-                  "cell.failover", "fleet.scale_kill",
-                  "fleet.scale_resync", "fleet.drain", "combined")
+                  "cell.failover", "front.failover", "cell.upgrade",
+                  "fleet.scale_kill", "fleet.scale_resync",
+                  "fleet.drain", "combined")
 _bad_legs = [name for name in LEGS
              if name not in _SCENARIO_LEGS and name not in inject.SITES]
 if _bad_legs:  # a plain raise survives python -O, an assert would not

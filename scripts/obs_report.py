@@ -137,6 +137,15 @@ _COLUMNS = (
     ("adapt_candidates", "candidates"),
     ("shadow_agreement", "shadow_agree"),
     ("promotions", "promotions"), ("rollbacks", "rollbacks"),
+    # Front-tier HA + rolling upgrades (front_lease/affinity_replay/
+    # cell_upgrade/spool_mirror events): lease takeovers and
+    # self-fencings, exact-table WAL replays at promotion, per-cell
+    # upgrade completions vs rollbacks, and mirror-spool fallback
+    # restores (plus journaled primary spool-read errors).
+    ("lease_takeovers", "takeovers"), ("front_fenced", "fenced"),
+    ("affinity_replays", "replays"),
+    ("cells_upgraded", "upgraded"), ("upgrade_rollbacks", "upg_rb"),
+    ("mirror_restores", "mirror"), ("spool_errors", "spool_err"),
 )
 
 

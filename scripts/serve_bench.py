@@ -2761,32 +2761,68 @@ def run_cells_migration_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
 
 def _run_cells_bulk(front_url: str, bodies: list[bytes], n_requests: int,
                     submitters: int, stop_flag: dict,
-                    per_request_deadline_s: float = 60.0) -> dict:
+                    per_request_deadline_s: float = 60.0,
+                    alternates=()) -> dict:
     """Bulk /predict load through the front's HTTP endpoint.  429/503 and
     transport blips are retried within a per-request deadline (the
     detection window is the front's to absorb); a request that exhausts
-    it — or any other HTTP status — is a client-visible FAILURE."""
+    it — or any other HTTP status — is a client-visible FAILURE.
+
+    ``alternates`` (the other fronts of an HA pair) turns a dead or
+    non-leader front into a routing event instead of retry heat: the
+    retry path re-resolves whichever front's healthz reports the active
+    role and continues there.  ``max_hint_retries`` is the worst
+    per-request count of such leader switches — the H1 acceptance bound
+    (one SIGKILL must cost each in-flight request at most ONE)."""
     import urllib.error
 
     lock = threading.Lock()
     counter, ok, retried = [0], [0], [0]
+    current = [front_url]
+    leader_switches, max_hint_retries = [0], [0]
     failures: list[str] = []
+
+    def find_leader() -> None:
+        """Point ``current`` at the front whose healthz reports the
+        active role (or no role at all — a non-HA front)."""
+        for url in [current[0], *alternates, front_url]:
+            try:
+                with urllib.request.urlopen(url + "/healthz",
+                                            timeout=2.0) as resp:
+                    rec = json.loads(resp.read().decode())
+            except Exception:  # noqa: BLE001 — dead/booting candidate
+                continue
+            if rec.get("role") in (None, "active"):
+                with lock:
+                    if url != current[0]:
+                        current[0] = url
+                        leader_switches[0] += 1
+                return
 
     def one(body: bytes) -> None:
         deadline = time.monotonic() + per_request_deadline_s
+        my_url, switches = current[0], 0
         while time.monotonic() < deadline:
+            url = current[0]
+            if url != my_url:
+                my_url = url
+                switches += 1
             try:
                 req = urllib.request.Request(
-                    front_url + "/predict", data=body,
+                    url + "/predict", data=body,
                     headers={"Content-Type": "application/octet-stream"})
                 with urllib.request.urlopen(req, timeout=30.0):
                     with lock:
                         ok[0] += 1
+                        max_hint_retries[0] = max(max_hint_retries[0],
+                                                  switches)
                     return
             except urllib.error.HTTPError as err:
                 if err.code in (429, 503):
                     with lock:
                         retried[0] += 1
+                    if alternates:
+                        find_leader()
                     time.sleep(0.01)
                     continue
                 with lock:
@@ -2795,6 +2831,8 @@ def _run_cells_bulk(front_url: str, bodies: list[bytes], n_requests: int,
             except (urllib.error.URLError, ConnectionError, OSError) as exc:
                 with lock:
                     retried[0] += 1
+                if alternates:
+                    find_leader()
                 time.sleep(0.02)
                 del exc
                 continue
@@ -2821,6 +2859,8 @@ def _run_cells_bulk(front_url: str, bodies: list[bytes], n_requests: int,
     return {"n_requests": counter[0], "completed": ok[0],
             "failures": len(failures), "failure_samples": failures[:3],
             "availability_retries": retried[0],
+            "leader_switches": leader_switches[0],
+            "max_hint_retries": max_hint_retries[0],
             "wall_s": round(wall, 3),
             "rps": round(ok[0] / max(wall, 1e-9), 2)}
 
@@ -3031,6 +3071,556 @@ def run_cells_bench(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --ha: zero-SPOF front tier (BENCH_HA.json legs H1/H2/H3).
+
+
+def _ha_env(root: Path) -> dict:
+    env = dict(os.environ, PYTHONPATH=f"{REPO}:"
+               f"{os.environ.get('PYTHONPATH', '')}")
+    env.setdefault("EEGTPU_COMPILE_CACHE", str(root / "xla_cache"))
+    return env
+
+
+def _ha_cell_procs(checkpoint: Path, root: Path, env: dict, *,
+                   snapshot_every: int, n: int = 2):
+    """N serve subprocesses with write-both session spools (primary +
+    mirror) — the cell layer every HA leg runs over.  Returns
+    ``(procs, specs)`` with ``specs[i] = (cell_id, url, spool, mirror)``.
+    """
+    import subprocess
+
+    from eegnetreplication_tpu.serve.fleet.service import free_port
+
+    procs, specs = [], []
+    for i in range(n):
+        port = free_port()
+        spool = root / "cells" / f"c{i}" / "sessions"
+        mirror = root / "cells" / f"c{i}" / "sessions_mirror"
+        # The HA legs only ever exercise batch-1 stream windows and
+        # batch-2 bulk (bucket 8): skip warm-compiling the big buckets.
+        cmd = [sys.executable, "-m", "eegnetreplication_tpu.serve",
+               "--checkpoint", str(checkpoint), "--port", str(port),
+               "--buckets", "1,8",
+               "--metricsDir", str(root / f"c{i}_obs"),
+               "--sessionsDir", str(spool / "r0"),
+               "--sessionsMirror", str(mirror / "r0"),
+               "--sessionSnapshotEvery", str(snapshot_every)]
+        procs.append(subprocess.Popen(cmd, env=env))
+        specs.append((f"c{i}", f"http://127.0.0.1:{port}", spool, mirror))
+    return procs, specs
+
+
+def _wait_role(base: str, role: str, timeout_s: float = 180.0) -> None:
+    """Poll ``/healthz`` until the front reports ``role``."""
+    import urllib.error
+
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            with urllib.request.urlopen(base + "/healthz",
+                                        timeout=2.0) as resp:
+                if json.loads(resp.read().decode()).get("role") == role:
+                    return
+        except Exception:  # noqa: BLE001 — still booting
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"front at {base} never reported role {role!r}")
+
+
+def _front_events(obs_root: Path) -> list[dict]:
+    """Every event a (possibly SIGKILLed) front journaled under its
+    metricsDir, in order — ``lenient_tail`` because H1's whole point is
+    that the active died mid-write."""
+    from eegnetreplication_tpu.obs import schema as obs_schema
+    from eegnetreplication_tpu.obs.agg import discover_runs
+
+    events = []
+    for run_dir in discover_runs([obs_root]):
+        events += obs_schema.read_events(run_dir / "events.jsonl",
+                                         complete=False, lenient_tail=True)
+    return events
+
+
+def run_ha_failover_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+                        init_block: int, chunk: int, rate_hz: float,
+                        root: Path, ttl_s: float, bulk_requests: int,
+                        bulk_submitters: int = 4, bulk_batch: int = 2,
+                        kill_after_frac: float = 0.4) -> dict:
+    """H1: SIGKILL the ACTIVE front of an HA pair under a paced session
+    plus concurrent bulk.  The standby must promote within (about) one
+    lease TTL, rebuild the exact affinity table from the WAL, and serve;
+    the resumed stream is byte-equal with zero conflicts and every bulk
+    request completes after at most one hinted leader switch.  The
+    journal-order proof (takeover strictly before the first
+    standby-served request) is read from the standby's own journal."""
+    import subprocess
+
+    from eegnetreplication_tpu.serve.engine import load_model_from_checkpoint
+    from eegnetreplication_tpu.serve.fleet.service import free_port
+
+    stream_bench = _stream_bench()
+    env = _ha_env(root)
+    procs, specs = _ha_cell_procs(checkpoint, root, env,
+                                  snapshot_every=4)
+    attach = ",".join(f"{cid}|{url}|{spool}|{mirror}"
+                      for cid, url, spool, mirror in specs)
+    fronts, front_urls = [], []
+    promote_latency = [None]
+    try:
+        for _, url, _, _ in specs:
+            stream_bench._wait_healthy(url, timeout_s=180.0)
+        # f0 first and alone until ACTIVE, so the pair's initial roles
+        # are deterministic; f1 then parks as the standby.
+        for i in range(2):
+            fport = free_port()
+            cmd = [sys.executable, "-m",
+                   "eegnetreplication_tpu.serve.cells",
+                   "--attachCells", attach, "--port", str(fport),
+                   "--pollS", "0.1",
+                   "--ha", str(root / "ha_dir"), "--haOwner", f"f{i}",
+                   "--haTtlS", str(ttl_s),
+                   "--metricsDir", str(root / f"f{i}_obs")]
+            fronts.append(subprocess.Popen(cmd, env=env))
+            front_urls.append(f"http://127.0.0.1:{fport}")
+            _wait_role(front_urls[i], "active" if i == 0 else "standby")
+        active, standby = front_urls
+        model, _, _ = load_model_from_checkpoint(checkpoint)
+        trials = np.random.RandomState(0).randn(
+            max(16, 4 * bulk_batch), model.n_channels,
+            model.n_times).astype(np.float32)
+        bodies = _npz_bodies(trials, bulk_batch)
+        opened = _cells_post(active + "/session/open", json.dumps(
+            {"session": "hares", "hop": hop,
+             "ems_init_block_size": init_block}).encode())
+        kill_at = int(kill_after_frac * x.shape[1])
+        killed = {"done": False}
+
+        def watch_promotion(t_kill: float) -> None:
+            try:
+                _wait_role(standby, "active", timeout_s=120.0)
+                promote_latency[0] = round(time.monotonic() - t_kill, 3)
+            except TimeoutError:
+                pass
+
+        stop_flag: dict = {}
+        bulk_result: dict = {}
+
+        def bulk() -> None:
+            bulk_result.update(_run_cells_bulk(
+                active, bodies, bulk_requests, bulk_submitters, stop_flag,
+                alternates=(standby,)))
+
+        bulk_thread = threading.Thread(target=bulk, daemon=True)
+
+        def on_chunk(pos: int) -> None:
+            if not killed["done"] and pos >= kill_at:
+                killed["done"] = True
+                fronts[0].kill()  # SIGKILL: no release, lease must expire
+                threading.Thread(target=watch_promotion,
+                                 args=(time.monotonic(),),
+                                 daemon=True).start()
+                # The bulk starts AT the kill, still pointed at the dead
+                # active: every request must ride the leaderless gap and
+                # land on the standby via at most one hinted switch.
+                bulk_thread.start()
+
+        log = stream_bench.DecisionLog()
+        final = stream_bench._stream_session(
+            active, "hares", x, hop=hop, init_block=init_block,
+            chunk=chunk, rate_hz=rate_hz, deadline_ms=None, log=log,
+            on_chunk=on_chunk, alternates=(standby,))
+        bulk_thread.join(timeout=600.0)
+        stop_flag["stop"] = True
+    finally:
+        for proc in fronts:
+            proc.terminate()  # graceful: the standby seals its journal
+        for proc in fronts:
+            try:
+                proc.wait(timeout=60.0)
+            except Exception:  # noqa: BLE001 — then the hard way
+                proc.kill()
+        for proc in procs:
+            proc.kill()
+            proc.wait(timeout=30.0)
+    window = int(final["window"])
+    reference = stream_bench.offline_reference(
+        checkpoint, x, window=window, hop=hop, init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    events = _front_events(root / "f1_obs")
+    takeover_idx = next((i for i, e in enumerate(events)
+                         if e["event"] == "front_lease"
+                         and e.get("action") == "takeover"), None)
+    request_idx = next((i for i, e in enumerate(events)
+                        if e["event"] in ("request", "session_failover",
+                                          "session_migrate")), None)
+    replay = next((e for e in events if e["event"] == "affinity_replay"),
+                  {})
+    return {
+        "n_samples": int(x.shape[1]), "hop": hop, "window": window,
+        "rate_hz": rate_hz, "ttl_s": ttl_s,
+        "home_cell": opened["cell"], "killed_at_sample": kill_at,
+        "promote_latency_s": promote_latency[0],
+        "lease_takeovers": sum(1 for e in events
+                               if e["event"] == "front_lease"
+                               and e.get("action") == "takeover"),
+        "replayed_sessions": int(replay.get("n_sessions", 0)),
+        "takeover_before_first_request": int(
+            takeover_idx is not None
+            and (request_idx is None or takeover_idx < request_idx)),
+        "bulk": bulk_result,
+        "n_windows": int(final["windows"]),
+        "duplicate_conflicts": len(log.conflicts),
+        "healed_redeliveries": log.healed,
+        "decisions_equal": int(len(streamed) == len(reference)
+                               and np.array_equal(streamed, reference)),
+    }
+
+
+def _upgrade_serialized(events: list[dict]) -> bool:
+    """True iff every upgraded cell's ``cell_upgrade`` steps contain
+    ``drain -> relaunch -> live -> undrain`` in order AND no two cells'
+    step spans interleave — the strict one-cell-at-a-time proof."""
+    steps: dict[str, list[tuple[int, str]]] = {}
+    for i, e in enumerate(events):
+        if e.get("event") == "cell_upgrade":
+            steps.setdefault(e["cell"], []).append((i, e["action"]))
+    if not steps:
+        return False
+    spans = []
+    for cell, cell_steps in steps.items():
+        actions = iter(a for _, a in cell_steps)
+        if not all(need in actions
+                   for need in ("drain", "relaunch", "live", "undrain")):
+            return False
+        spans.append((cell_steps[0][0], cell_steps[-1][0]))
+    spans.sort()
+    return all(s2 >= e1 for (_, e1), (s2, _) in zip(spans, spans[1:]))
+
+
+def run_ha_upgrade_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+                       init_block: int, chunk: int, root: Path, journal,
+                       snapshot_every: int = 4,
+                       target_wall_s: float = 30.0,
+                       bulk_requests: int = 120, bulk_submitters: int = 2,
+                       bulk_batch: int = 2, upgrade_body: dict
+                       | None = None) -> dict:
+    """H2: front-orchestrated rolling upgrade of a 2-cell deployment
+    under a live paced session + light bulk.  Same checkpoint (digest
+    unchanged -> no shadow gate), so the assertable surface is pure
+    orchestration: zero expirations, zero failed requests, and the
+    journal's strictly-serialized per-cell drain -> relaunch -> live ->
+    undrain.  ``upgrade_body`` overrides the POST body — the chaos
+    drill's wedge leg points it at a missing checkpoint to force the
+    drain_timeout -> rollback path."""
+    from eegnetreplication_tpu.serve.cells import CellFront, RollingUpgrade
+    from eegnetreplication_tpu.serve.cells.service import spawn_cells
+    from eegnetreplication_tpu.serve.engine import load_model_from_checkpoint
+
+    stream_bench = _stream_bench()
+    # Same bucket trim as ``_ha_cell_procs``: the leg never batches
+    # past 2, and relaunched children reuse these args, so every boot
+    # (including the mid-upgrade relaunches) warm-starts from the same
+    # two cached compiles.
+    serve_args: list[str] = ["--buckets", "1,8"]
+    os.environ.update(_ha_env(root))  # supervised children inherit this
+    sup, members, spec_fns = spawn_cells(
+        str(checkpoint), 2, run_dir=root / "run", cells_dir=root / "cells",
+        serve_args=serve_args, session_snapshot_every=snapshot_every,
+        journal=journal)
+    sup_thread = threading.Thread(target=sup.run, name="ha-upgrade-sup",
+                                  daemon=True)
+    sup_thread.start()
+    front = CellFront(members, port=0, poll_s=0.1, journal=journal)
+    upgrade_result: dict = {}
+    try:
+        front.membership.start()
+        front.membership.wait_live(2, timeout_s=180.0)
+        front.start()
+        front.upgrader = RollingUpgrade(
+            front, sup,
+            lambda cid, ck, sa: spec_fns[cid](
+                ck or str(checkpoint),
+                sa if sa is not None else serve_args),
+            journal=journal, poll_s=0.1)
+        for m in members:
+            front.upgrader.set_current(m.cell_id, str(checkpoint),
+                                       serve_args)
+        model, _, _ = load_model_from_checkpoint(checkpoint)
+        trials = np.random.RandomState(0).randn(
+            max(16, 4 * bulk_batch), model.n_channels,
+            model.n_times).astype(np.float32)
+        bodies = _npz_bodies(trials, bulk_batch)
+        rate_hz = x.shape[1] / target_wall_s
+        deadline_ms = 4000.0 * hop / rate_hz
+
+        def do_upgrade() -> None:
+            try:
+                upgrade_result.update(_cells_post(
+                    front.url + "/cells/upgrade",
+                    json.dumps(upgrade_body or {}).encode(),
+                    timeout=600.0))
+            except Exception as exc:  # noqa: BLE001 — recorded, asserted
+                upgrade_result["error"] = f"{type(exc).__name__}: {exc}"
+
+        upgrade_thread = threading.Thread(target=do_upgrade, daemon=True)
+        started = {"done": False}
+
+        def on_chunk(pos: int) -> None:
+            if not started["done"] and pos >= int(0.1 * x.shape[1]):
+                started["done"] = True
+                upgrade_thread.start()
+
+        stop_flag: dict = {}
+        bulk_result: dict = {}
+
+        def bulk() -> None:
+            bulk_result.update(_run_cells_bulk(
+                front.url, bodies, bulk_requests, bulk_submitters,
+                stop_flag))
+
+        bulk_thread = threading.Thread(target=bulk, daemon=True)
+        bulk_thread.start()
+        log = stream_bench.DecisionLog()
+        final = stream_bench._stream_session(
+            front.url, "upgr", x, hop=hop, init_block=init_block,
+            chunk=chunk, rate_hz=rate_hz, deadline_ms=deadline_ms,
+            log=log, on_chunk=on_chunk)
+        upgrade_thread.join(timeout=600.0)
+        bulk_thread.join(timeout=600.0)
+        stop_flag["stop"] = True
+    finally:
+        front.stop()
+        sup.stop()
+        sup_thread.join(timeout=60.0)
+    window = int(final["window"])
+    reference = stream_bench.offline_reference(
+        checkpoint, x, window=window, hop=hop, init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    return {
+        "n_samples": int(x.shape[1]), "hop": hop, "window": window,
+        "rate_hz": round(rate_hz, 2), "deadline_ms": round(deadline_ms, 1),
+        "upgrade": upgrade_result,
+        "bulk": bulk_result,
+        "n_windows": int(final["windows"]),
+        "window_expirations": int(final["expired"]),
+        "sessions_migrated": front.sessions_migrated,
+        "duplicate_conflicts": len(log.conflicts),
+        "decisions_equal": int(len(streamed) == len(reference)
+                               and np.array_equal(streamed, reference)),
+    }
+
+
+def run_ha_mirror_leg(checkpoint: Path, x: np.ndarray, *, hop: int,
+                      init_block: int, chunk: int, root: Path, journal,
+                      snapshot_every: int = 4,
+                      corrupt_at_frac: float = 0.5) -> dict:
+    """H3: cell failover with the PRIMARY spool corrupted — every
+    ``sessions.npz*`` generation under the victim's spool is garbled
+    after the kill, so the restore can only come from the write-both
+    mirror (``spool_mirror action=restored`` journaled)."""
+    from eegnetreplication_tpu.serve.cells import CellFront, CellMember
+
+    stream_bench = _stream_bench()
+    env = _ha_env(root)
+    procs, specs = _ha_cell_procs(checkpoint, root, env,
+                                  snapshot_every=snapshot_every)
+    members = [CellMember(cid, url, spool=spool, mirror=mirror,
+                          journal=journal)
+               for cid, url, spool, mirror in specs]
+    front = CellFront(members, port=0, poll_s=0.1, journal=journal)
+    try:
+        for _, url, _, _ in specs:
+            stream_bench._wait_healthy(url, timeout_s=180.0)
+        front.membership.start()
+        front.membership.wait_live(2, timeout_s=60.0)
+        front.start()
+        opened = _cells_post(front.url + "/session/open", json.dumps(
+            {"session": "mirrorres", "hop": hop,
+             "ems_init_block_size": init_block}).encode())
+        victim = int(opened["cell"][1:])
+        victim_spool = specs[victim][2]
+        corrupt_at = int(corrupt_at_frac * x.shape[1])
+        done = {"corrupted": False}
+
+        def on_chunk(pos: int) -> None:
+            if not done["corrupted"] and pos >= corrupt_at:
+                done["corrupted"] = True
+                # Kill FIRST (no further snapshot can heal the damage),
+                # then corrupt every primary generation before the next
+                # client request can trigger the failover read.
+                procs[victim].kill()
+                procs[victim].wait(timeout=30.0)
+                for p in Path(victim_spool).rglob("sessions.npz*"):
+                    try:
+                        p.write_bytes(b"not-an-npz")
+                    except OSError:
+                        pass
+
+        log = stream_bench.DecisionLog()
+        final = stream_bench._stream_session(
+            front.url, "mirrorres", x, hop=hop, init_block=init_block,
+            chunk=chunk, rate_hz=0.0, deadline_ms=None, log=log,
+            on_chunk=on_chunk)
+    finally:
+        front.stop()
+        for proc in procs:
+            proc.kill()
+            proc.wait(timeout=30.0)
+    window = int(final["window"])
+    reference = stream_bench.offline_reference(
+        checkpoint, x, window=window, hop=hop, init_block=init_block)
+    streamed = np.asarray(final["preds"], np.int64)
+    return {
+        "n_samples": int(x.shape[1]), "hop": hop, "window": window,
+        "snapshot_every_windows": snapshot_every,
+        "killed_cell": f"c{victim}", "corrupted_at_sample": corrupt_at,
+        "sessions_failed_over": front.sessions_failed_over,
+        "n_windows": int(final["windows"]),
+        "duplicate_conflicts": len(log.conflicts),
+        "decisions_equal": int(len(streamed) == len(reference)
+                               and np.array_equal(streamed, reference)),
+    }
+
+
+def run_ha_bench(args) -> int:
+    """The --ha mode: H1 active-front SIGKILL failover, H2 rolling
+    upgrade under load, H3 mirror-spool restore; writes BENCH_HA.json."""
+    from eegnetreplication_tpu.utils.platform import select_platform
+
+    platform = select_platform()
+    os.environ.setdefault("EEGTPU_PLATFORM", platform)
+
+    from eegnetreplication_tpu.obs import journal as obs_journal
+    from eegnetreplication_tpu.obs import schema as obs_schema
+    from eegnetreplication_tpu.obs.schema import write_json_artifact
+
+    stream_bench = _stream_bench()
+    tmp = Path(args.workDir) if args.workDir \
+        else Path(tempfile.mkdtemp(prefix="ha_bench_"))
+    tmp.mkdir(parents=True, exist_ok=True)
+    os.environ.update(_ha_env(tmp))
+    checkpoint = (Path(args.checkpoint) if args.checkpoint
+                  else make_synthetic_checkpoint(tmp, args.channels,
+                                                 args.times))
+    n_channels, window = args.channels, args.times
+    if args.checkpoint:
+        from eegnetreplication_tpu.serve.engine import (
+            load_model_from_checkpoint,
+        )
+
+        model, _, _ = load_model_from_checkpoint(checkpoint)
+        n_channels, window = model.n_channels, model.n_times
+    hop = max(1, window // 4)
+    n_samples = int(args.haSeconds * stream_bench.HEADSET_RATE_HZ)
+    init_block = min(1000, max(window, n_samples // 4))
+    x = stream_bench.make_recording(n_channels, n_samples)
+    record: dict = {
+        "platform": platform, "selftest": bool(args.selftest),
+        "checkpoint": str(checkpoint),
+        "geometry": {"n_channels": n_channels, "n_times": window},
+        "hop": hop, "ems_init_block_size": init_block,
+        "ttl_s": args.haTtlS,
+    }
+    print(f"[ha] {n_channels}x{n_samples} recording, window {window}, "
+          f"hop {hop}, ttl {args.haTtlS}s", flush=True)
+    record["failover"] = run_ha_failover_leg(
+        checkpoint, x, hop=hop, init_block=init_block, chunk=25,
+        rate_hz=args.cellsRate, root=tmp / "h1", ttl_s=args.haTtlS,
+        bulk_requests=args.haBulkRequests)
+    print(f"[ha] failover: {record['failover']}", flush=True)
+    with obs_journal.run(tmp / "obs_upgrade", config={},
+                         role="ha_bench") as jr:
+        record["upgrade_leg"] = run_ha_upgrade_leg(
+            checkpoint, x, hop=hop, init_block=init_block, chunk=25,
+            root=tmp / "h2", journal=jr,
+            target_wall_s=(9.0 if args.selftest else 30.0),
+            bulk_requests=min(args.haBulkRequests, 120))
+        upgrade_events = obs_schema.read_events(jr.events_path,
+                                                complete=False)
+    record["upgrade_leg"]["serialized_ok"] = int(
+        _upgrade_serialized(upgrade_events))
+    print(f"[ha] upgrade: {record['upgrade_leg']}", flush=True)
+    with obs_journal.run(tmp / "obs_mirror", config={},
+                         role="ha_bench") as jr:
+        record["mirror_leg"] = run_ha_mirror_leg(
+            checkpoint, x, hop=hop, init_block=init_block, chunk=25,
+            root=tmp / "h3", journal=jr)
+        mirror_events = obs_schema.read_events(jr.events_path,
+                                               complete=False)
+    record["mirror_leg"]["mirror_restores"] = sum(
+        1 for e in mirror_events if e["event"] == "spool_mirror"
+        and e.get("action") == "restored")
+    print(f"[ha] mirror: {record['mirror_leg']}", flush=True)
+
+    out = Path(args.haOut) if args.haOut else (
+        tmp / "BENCH_HA_selftest.json" if args.selftest
+        else REPO / "BENCH_HA.json")
+    write_json_artifact(out, record, kind="bench", indent=1)
+    print(f"[ha] wrote {out}", flush=True)
+
+    if args.selftest:
+        failures = []
+        h1 = record["failover"]
+        h2 = record["upgrade_leg"]
+        h3 = record["mirror_leg"]
+        if h1["lease_takeovers"] < 1:
+            failures.append("no front_lease takeover journaled by the "
+                            "standby")
+        if not h1["takeover_before_first_request"]:
+            failures.append("journal does not pin takeover before the "
+                            "first standby-served request")
+        if not h1["decisions_equal"]:
+            failures.append("H1 resumed decision stream != offline "
+                            "reference")
+        if h1["duplicate_conflicts"]:
+            failures.append("H1 re-delivered decisions disagreed across "
+                            "the front failover")
+        if h1["bulk"].get("failures", 1):
+            failures.append(f"{h1['bulk'].get('failures')} bulk "
+                            "request(s) failed through the front kill")
+        if h1["bulk"].get("max_hint_retries", 9) > 1:
+            failures.append("a bulk request needed more than one hinted "
+                            "leader switch")
+        if h1["bulk"].get("leader_switches", 0) < 1:
+            failures.append("bulk never switched leader — the kill-time "
+                            "bulk failed to exercise the hint path")
+        if (h1["promote_latency_s"] is None
+                or h1["promote_latency_s"] > args.haTtlS + 2.0):
+            failures.append(f"standby promotion took "
+                            f"{h1['promote_latency_s']}s (ttl "
+                            f"{args.haTtlS}s + 2s grace)")
+        if h2["upgrade"].get("status") != "ok":
+            failures.append(f"rolling upgrade ended {h2['upgrade']}")
+        if sorted(h2["upgrade"].get("upgraded", [])) != ["c0", "c1"]:
+            failures.append("rolling upgrade did not upgrade both cells")
+        if h2["window_expirations"]:
+            failures.append(f"{h2['window_expirations']} window(s) "
+                            "expired during the rolling upgrade")
+        if h2["bulk"].get("failures", 1):
+            failures.append(f"{h2['bulk'].get('failures')} bulk "
+                            "request(s) failed during the upgrade")
+        if not h2["decisions_equal"]:
+            failures.append("H2 decision stream != offline reference")
+        if not h2["serialized_ok"]:
+            failures.append("journal does not pin strictly-serialized "
+                            "per-cell drain->relaunch->live->undrain")
+        if h3["mirror_restores"] < 1:
+            failures.append("no spool_mirror restore journaled with the "
+                            "primary spool corrupted")
+        if not h3["decisions_equal"]:
+            failures.append("H3 restored decision stream != offline "
+                            "reference")
+        if h3["duplicate_conflicts"]:
+            failures.append("H3 re-delivered decisions disagreed across "
+                            "the mirror restore")
+        if failures:
+            print("[ha] SELFTEST FAIL:\n  - " + "\n  - ".join(failures))
+            return 1
+        print("[ha] SELFTEST PASS")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="Benchmark the online serving subsystem.")
@@ -3140,6 +3730,27 @@ def main(argv=None) -> int:
     parser.add_argument("--cellsBulkRequests", type=int, default=400,
                         help="Bulk /predict requests riding the cell-kill "
                              "leg.")
+    parser.add_argument("--ha", action="store_true",
+                        help="Zero-SPOF front tier bench: H1 SIGKILL the "
+                             "active front of an HA pair (standby "
+                             "promotes off the lease + affinity WAL), "
+                             "H2 front-orchestrated rolling cell upgrade "
+                             "under load, H3 session restore from the "
+                             "mirror spool with the primary corrupted; "
+                             "writes BENCH_HA.json.")
+    parser.add_argument("--haOut", default=None,
+                        help="BENCH_HA.json path (default: repo root; a "
+                             "tempfile under --selftest).")
+    parser.add_argument("--haSeconds", type=float, default=12.0,
+                        help="Seconds of synthetic recording for the HA "
+                             "legs (selftest forces 6).")
+    parser.add_argument("--haTtlS", type=float, default=3.0,
+                        help="Fencing-lease TTL for the H1 pair "
+                             "(selftest forces <= 1.5 so promotion fits "
+                             "the short stream).")
+    parser.add_argument("--haBulkRequests", type=int, default=300,
+                        help="Concurrent bulk /predict load during the "
+                             "H1 failover (selftest caps at 120).")
     parser.add_argument("--fleetBatch", type=int, default=16,
                         help="Trials per request in the fleet legs.")
     parser.add_argument("--fleetRequests", type=int, default=600,
@@ -3199,6 +3810,15 @@ def main(argv=None) -> int:
             args.cellsBulkRequests = min(args.cellsBulkRequests, 120)
             args.cellsRate = max(args.cellsRate, 500.0)
         return run_cells_bench(args)
+
+    if args.ha:
+        if args.selftest:
+            args.channels, args.times = 4, 64
+            args.haSeconds = min(args.haSeconds, 4.0)
+            args.haBulkRequests = min(args.haBulkRequests, 60)
+            args.haTtlS = min(args.haTtlS, 1.2)
+            args.cellsRate = max(args.cellsRate, 500.0)
+        return run_ha_bench(args)
 
     if args.gray:
         if args.grayReplicas < 3:

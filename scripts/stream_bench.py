@@ -130,6 +130,47 @@ def _wait_healthy(base: str, timeout_s: float = 120.0) -> None:
     raise TimeoutError(f"server at {base} never became healthy")
 
 
+def _leader_hint(err) -> str | None:
+    """Extract the advertised leader URL from a standby front's 503 body.
+
+    An HA standby answers every data-plane request with
+    ``503 {"role": "standby", "leader": "<url>"}``; anything unparsable
+    (a plain overload 503, an empty body) yields None.
+    """
+    try:
+        return json.loads(err.read().decode()).get("leader") or None
+    except Exception:  # noqa: BLE001 — not a hint-carrying body
+        return None
+
+
+def _wait_leader(base: str, alternates, timeout_s: float,
+                 hint: str | None = None) -> str:
+    """Return the first URL whose ``/healthz`` answers with role absent
+    (a non-HA server) or ``"active"`` — the only peers allowed to serve.
+
+    Candidates are probed hint-first so a standby's leader hint is
+    honored immediately, but the hint is GATED on its own healthz: a
+    stale hint (pointing at the front that just died, or at a peer still
+    standby pre-promotion) must not ping-pong the client — we keep
+    cycling base + alternates until someone actually holds the lease.
+    """
+    candidates = []
+    for url in ([hint] if hint else []) + [base, *alternates]:
+        if url and url not in candidates:
+            candidates.append(url)
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        for url in candidates:
+            try:
+                rec = _get(url + "/healthz", timeout=2.0)
+            except Exception:  # noqa: BLE001 — down or still booting
+                continue
+            if rec.get("role") in (None, "active"):
+                return url
+        time.sleep(0.2)
+    raise TimeoutError(f"no active leader among {candidates}")
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -181,11 +222,16 @@ class DecisionLog:
 def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
                     init_block: int, chunk: int, rate_hz: float,
                     deadline_ms: float | None, log: DecisionLog,
-                    on_chunk=None, resume_poll_s: float = 120.0) -> dict:
+                    on_chunk=None, resume_poll_s: float = 120.0,
+                    alternates=()) -> dict:
     """Open (or re-attach) a session and stream ``x`` from the server's
     acked cursor, pacing to ``rate_hz`` (0 = flat out).  Transparent
     resume: a dropped connection polls the server back to health, reads
-    the acked cursor, and replays from there.  Returns the close reply.
+    the acked cursor, and replays from there.  With ``alternates`` (the
+    other fronts of an HA pair), a 503 leader hint or a dead base is
+    followed to whichever peer's healthz reports the active role — the
+    switch spends the same ``resume_poll_s`` budget, not a new one.
+    Returns the close reply.
     """
     c = x.shape[0]
     open_body = json.dumps({
@@ -248,9 +294,12 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
                 continue
             if err.code == 503:
                 # The session's cell/replica is momentarily down (front
-                # still up): wait for capacity and resync.
+                # still up), OR this front is an HA standby answering
+                # with a leader hint: follow the hint / find the active
+                # peer, then resync against it.
                 time.sleep(0.1)
-                _wait_healthy(base, resume_poll_s)
+                base = _wait_leader(base, alternates, resume_poll_s,
+                                    hint=_leader_hint(err))
                 pos = resync()
                 t0 = time.perf_counter()
                 sent0 = pos
@@ -266,9 +315,10 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
             sent0 = pos
             continue
         except (urllib.error.URLError, ConnectionError, OSError):
-            # Server down (killed / restarting): wait it out, then learn
-            # where to resume from — the acked cursor is the contract.
-            _wait_healthy(base, resume_poll_s)
+            # Server down (killed / restarting): wait for it — or, in an
+            # HA pair, for whichever peer promotes — then learn where to
+            # resume from; the acked cursor is the contract either way.
+            base = _wait_leader(base, alternates, resume_poll_s)
             pos = resync()
             t0 = time.perf_counter()
             sent0 = pos
@@ -282,13 +332,14 @@ def _stream_session(base: str, sid: str, x: np.ndarray, *, hop: int,
             final = _post(f"{base}/session/{sid}/close", b"{}")
             break
         except urllib.error.HTTPError as err:
-            if err.code == 503:  # the session's home is mid-relaunch
+            if err.code == 503:  # home mid-relaunch, or a standby hint
                 time.sleep(0.1)
-                _wait_healthy(base, resume_poll_s)
+                base = _wait_leader(base, alternates, resume_poll_s,
+                                    hint=_leader_hint(err))
                 continue
             raise  # protocol error: the close itself was rejected
         except (urllib.error.URLError, ConnectionError, OSError):
-            _wait_healthy(base, resume_poll_s)
+            base = _wait_leader(base, alternates, resume_poll_s)
     return final
 
 

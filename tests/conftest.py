@@ -27,6 +27,22 @@ if not os.environ.get("EEGTPU_TEST_TPU"):
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """Run the bench selftests last.
+
+    The ``*Selftest*`` legs are minutes-sized end-to-end subprocess
+    benches (real serve processes, real SIGKILL); everything else is a
+    seconds-sized unit surface.  A budgeted tier-1 run should buy the
+    fast feedback first and spend whatever time remains on the
+    end-to-end legs, so a timeout truncates the slowest tail instead of
+    starving the unit tests queued behind a bench boot.  The reorder is
+    stable: relative order within each group is unchanged.
+    """
+    tail = [it for it in items if "selftest" in it.nodeid.lower()]
+    head = [it for it in items if "selftest" not in it.nodeid.lower()]
+    items[:] = head + tail
+
+
 @pytest.fixture(autouse=True)
 def _resil_state_isolated():
     """The fault-injection registry, preemption flag, and process-default

@@ -1,0 +1,389 @@
+"""Zero-SPOF front tier (``eegnetreplication_tpu/serve/cells/ha.py``).
+
+Covers the ISSUE-20 surface: the fencing lease (token bumped on every
+acquisition, never on renew; torn/alien files read as *no lease*), the
+durable affinity WAL (writer fold == replay exactness through size
+rotation with snapshot-marker compaction; torn-tail records skipped on
+replay AND sealed before a successor's first append), the in-process
+active/standby pair (standby tails the WAL without echoing it, promotes
+only after lease expiry, and the journal pins ``affinity_replay``
+BEFORE the ``front_lease takeover``), the observability fold of the
+four new events at the deepest cells-run nesting, and the
+``serve_bench.py --ha`` tier-1 selftest (SIGKILL'd active front,
+rolling upgrade under load, mirror-spool restore).
+
+Everything above the selftest is pure stdlib + threads — no JAX, no
+subprocesses — so the suite stays fast; the end-to-end truth (real
+fronts, real SIGKILL, real engines) lives in the selftest leg and the
+chaos drill's ``front.failover``/``cell.upgrade`` legs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from eegnetreplication_tpu.obs import agg as obs_agg
+from eegnetreplication_tpu.obs import journal as obs_journal
+from eegnetreplication_tpu.obs import schema
+from eegnetreplication_tpu.serve.cells.front import CellFront
+from eegnetreplication_tpu.serve.cells.membership import CellMember
+from eegnetreplication_tpu.serve.cells.ha import (
+    AffinityWAL,
+    FencingLease,
+    HAController,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture
+def journal(tmp_path):
+    with obs_journal.run(tmp_path / "obs", config={}) as jr:
+        yield jr
+
+
+def _events(jr, kind=None):
+    events = schema.read_events(jr.events_path, complete=False)
+    if kind is None:
+        return events
+    return [e for e in events if e["event"] == kind]
+
+
+def _wait(predicate, timeout_s=10.0, poll_s=0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(poll_s)
+    return predicate()
+
+
+# ---------------------------------------------------------------------------
+# Fencing lease: shared storage as the arbiter.
+
+
+class TestFencingLease:
+    def test_acquire_bumps_token_every_epoch(self, tmp_path):
+        lease = FencingLease(tmp_path / "lease.json", owner="f0",
+                             ttl_s=5.0)
+        assert lease.try_acquire()
+        assert lease.token == 1
+        # Re-acquiring our OWN lease is a new fencing epoch (a restart
+        # lost the in-memory table) — the token must bump again.
+        assert lease.try_acquire()
+        assert lease.token == 2
+
+    def test_fresh_lease_blocks_other_owner(self, tmp_path):
+        a = FencingLease(tmp_path / "lease.json", owner="f0", ttl_s=5.0)
+        b = FencingLease(tmp_path / "lease.json", owner="f1", ttl_s=5.0)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        assert b.token == 0
+
+    def test_expired_lease_taken_with_monotonic_token(self, tmp_path):
+        a = FencingLease(tmp_path / "lease.json", owner="f0", ttl_s=0.05)
+        b = FencingLease(tmp_path / "lease.json", owner="f1", ttl_s=0.05)
+        assert a.try_acquire()
+        time.sleep(0.1)
+        assert b.try_acquire()
+        # The taker continues the dead owner's token sequence — the
+        # fencing order is total across owners.
+        assert b.token == a.token + 1
+
+    def test_renew_keeps_token_and_detects_loss(self, tmp_path):
+        a = FencingLease(tmp_path / "lease.json", owner="f0", ttl_s=0.05)
+        b = FencingLease(tmp_path / "lease.json", owner="f1", ttl_s=0.05)
+        assert a.try_acquire()
+        assert a.renew() == "ok"
+        assert a.token == 1
+        time.sleep(0.1)
+        assert b.try_acquire()
+        # The old active's next renew sees the usurper and must fence.
+        assert a.renew() == "lost"
+
+    def test_torn_lease_reads_as_absent(self, tmp_path):
+        path = tmp_path / "lease.json"
+        path.write_text('{"owner": "f0", "tok')
+        lease = FencingLease(path, owner="f1", ttl_s=5.0)
+        assert lease.read() is None
+        assert lease.expired()
+        assert lease.try_acquire()
+
+    def test_release_only_deletes_own_lease(self, tmp_path):
+        a = FencingLease(tmp_path / "lease.json", owner="f0", ttl_s=5.0)
+        b = FencingLease(tmp_path / "lease.json", owner="f1", ttl_s=5.0)
+        assert a.try_acquire()
+        b.release()  # not ours: must be a no-op
+        assert a.read()["owner"] == "f0"
+        a.release()
+        assert a.read() is None
+
+
+# ---------------------------------------------------------------------------
+# Affinity WAL: replay exactness is the whole contract.
+
+
+class TestAffinityWAL:
+    def _mutate(self, wal, n=0):
+        wal.append("assign", "s1", "c0")
+        wal.append("assign", "s2", "c1")
+        wal.append("flip", "s2", "c0", resync=True)
+        wal.append("assign", "s3", "c1")
+        wal.append("drop", "s3")
+        for i in range(n):
+            wal.append("assign", f"bulk{i:04d}", f"c{i % 3}")
+
+    def test_replay_matches_writer_fold(self, tmp_path):
+        wal = AffinityWAL(tmp_path / "affinity.wal")
+        self._mutate(wal)
+        wal.close()
+        affinity, resync, n = AffinityWAL(tmp_path / "affinity.wal").replay()
+        assert affinity == {"s1": "c0", "s2": "c0"}
+        assert resync == {"s2"}
+        assert n == 5
+
+    def test_rotation_compacts_exactly(self, tmp_path):
+        wal = AffinityWAL(tmp_path / "affinity.wal", max_bytes=2048)
+        self._mutate(wal, n=200)  # forces several rotations
+        writer_state = dict(wal._state)
+        writer_resync = set(wal._resync)
+        wal.close()
+        assert (tmp_path / "affinity.wal.1").exists()
+        # The live file opens with the snapshot marker followed by the
+        # compacted table — archives are pure history.
+        first = json.loads(
+            (tmp_path / "affinity.wal").read_text().splitlines()[0])
+        assert first["op"] == "snapshot"
+        affinity, resync, _ = AffinityWAL(tmp_path / "affinity.wal").replay()
+        assert affinity == writer_state
+        assert resync == writer_resync
+
+    def test_torn_tail_skipped_and_sealed(self, tmp_path):
+        path = tmp_path / "affinity.wal"
+        wal = AffinityWAL(path)
+        wal.append("assign", "s1", "c0")
+        wal.append("assign", "s2", "c1")
+        wal.close()
+        # A mid-append death leaves a torn final line with no newline.
+        with open(path, "a", encoding="utf-8") as fh:
+            fh.write('{"op":"assign","session":"s3","ce')
+        affinity, resync, n = AffinityWAL(path).replay()
+        assert affinity == {"s1": "c0", "s2": "c1"}
+        assert n == 2
+        # A successor's first append must not be spliced into (and lost
+        # with) the torn line: the lazy open seals it first.
+        successor = AffinityWAL(path)
+        successor.append("assign", "s4", "c0")
+        successor.close()
+        affinity, _, _ = AffinityWAL(path).replay()
+        assert affinity == {"s1": "c0", "s2": "c1", "s4": "c0"}
+
+    def test_reopened_writer_seeds_fold_for_compaction(self, tmp_path):
+        path = tmp_path / "affinity.wal"
+        wal = AffinityWAL(path)
+        self._mutate(wal)
+        wal.close()
+        # A restarted front re-opens the same WAL; its next rotation
+        # must compact the REAL table, not an empty one.
+        reopened = AffinityWAL(path, max_bytes=1)
+        reopened.append("assign", "s9", "c2")  # triggers rotation
+        reopened.close()
+        affinity, resync, _ = AffinityWAL(path).replay()
+        assert affinity == {"s1": "c0", "s2": "c0", "s9": "c2"}
+        assert resync == {"s2"}
+
+    def test_fingerprint_tracks_appends(self, tmp_path):
+        wal = AffinityWAL(tmp_path / "affinity.wal")
+        fp0 = wal.fingerprint()
+        wal.append("assign", "s1", "c0")
+        fp1 = wal.fingerprint()
+        assert fp1 != fp0
+        wal.close()
+
+
+# ---------------------------------------------------------------------------
+# Active/standby pair, in-process: promotion order and table exactness.
+
+
+class TestHAPairPromotion:
+    def test_standby_tails_then_promotes_exactly(self, tmp_path, journal):
+        ha_dir = tmp_path / "ha"
+        # The membership poller never runs (the fronts are not started),
+        # so an unreachable placeholder cell is inert.
+        f1 = CellFront([CellMember("c0", "http://127.0.0.1:1",
+                                   journal=journal)],
+                       port=0, poll_s=60.0, journal=journal)
+        ha1 = HAController(f1, ha_dir, owner="f1", url="http://f1",
+                           ttl_s=0.5, poll_s=0.05, journal=journal).start()
+        try:
+            assert ha1.role == "active"
+            assert ha1.leader_hint() == "http://f1"
+            # Mutations flow through the front's leader-gated WAL hook.
+            with f1._table_lock:
+                f1._affinity["s1"] = "c0"
+                f1._wal_append("assign", "s1", "c0")
+                f1._affinity["s2"] = "c1"
+                f1._wal_append("assign", "s2", "c1")
+                f1._affinity["s2"] = "c0"
+                f1._needs_resync.add("s2")
+                f1._wal_append("flip", "s2", "c0", resync=True)
+
+            f2 = CellFront([CellMember("c0", "http://127.0.0.1:1",
+                                       journal=journal)],
+                           port=0, poll_s=60.0, journal=journal)
+            ha2 = HAController(f2, ha_dir, owner="f2", url="http://f2",
+                               ttl_s=0.5, poll_s=0.05,
+                               journal=journal).start()
+            try:
+                assert ha2.role == "standby"
+                # The standby tails the WAL into its routing table...
+                assert _wait(lambda: f2._affinity == {"s1": "c0",
+                                                      "s2": "c0"})
+                assert f2._needs_resync == {"s2"}
+                # ...but must never echo records back into the log.
+                assert ha2.wal.appended == 0
+                f2._wal_append("assign", "sX", "c9")
+                assert ha2.wal.appended == 0
+
+                # Crash the active (no release): the standby may promote
+                # only after the lease expires.
+                ha1.close(release=False)
+                assert not ha1.lease.expired()
+                assert ha2.role == "standby"
+                assert _wait(lambda: ha2.role == "active", timeout_s=10.0)
+                assert f2._affinity == {"s1": "c0", "s2": "c0"}
+                assert f2._needs_resync == {"s2"}
+                assert ha2.lease.token == ha1.lease.token + 1
+                assert f2.is_leader
+            finally:
+                ha2.close()
+        finally:
+            ha1.close(release=False)
+
+        kinds = [(e["event"], e.get("action")) for e in _events(journal)
+                 if e["event"] in ("front_lease", "affinity_replay")]
+        assert ("front_lease", "acquire") in kinds
+        assert ("front_lease", "standby") in kinds
+        # The journal pins replay-before-takeover: the new active's
+        # table is exact BEFORE it may serve a single request.
+        replay_at = kinds.index(("affinity_replay", None))
+        takeover_at = kinds.index(("front_lease", "takeover"))
+        assert replay_at < takeover_at
+        replay = _events(journal, "affinity_replay")[0]
+        assert replay["n_sessions"] == 2
+        assert replay["n_resync"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Observability fold: the four new events through the deepest nesting.
+
+_T0 = 1700000000.0
+
+_RUN_START = {"event": "run_start", "schema_version": 1, "git_sha": "0" * 8,
+              "platform": "cpu", "device_kind": "cpu", "n_devices": 1,
+              "config": {}}
+
+
+def _write_run(run_dir, events):
+    run_dir.mkdir(parents=True)
+    lines = [json.dumps({"t": _T0 + i, "run_id": run_dir.name, **ev})
+             for i, ev in enumerate(events)]
+    (run_dir / "events.jsonl").write_text("\n".join(lines) + "\n")
+
+
+class TestAggHAFold:
+    def _populate(self, root):
+        # Front journal at metricsDir depth 1; a cell member's replica
+        # journal at the cells-run depth THREE (c0_obs/<cell_run>/
+        # replica_obs/<replica_run>) — discovery must walk both.
+        _write_run(root / "f1_obs" / "run_front", [
+            _RUN_START | {"run_id": "run_front"},
+            {"event": "front_lease", "action": "standby", "owner": "f1",
+             "token": 1},
+            {"event": "affinity_replay", "n_records": 3, "n_sessions": 2,
+             "n_resync": 1},
+            {"event": "front_lease", "action": "takeover", "owner": "f1",
+             "token": 2},
+            {"event": "spool_mirror", "action": "restored",
+             "session": "s1", "cell": "c0"},
+            {"event": "session_failover", "session": "s9",
+             "from_cell": "c0", "to_cell": "c1",
+             "action": "spool_error"},
+        ])
+        _write_run(root / "c0_obs" / "run_cell" / "replica_obs"
+                   / "run_replica", [
+            _RUN_START | {"run_id": "run_replica"},
+            {"event": "cell_upgrade", "cell": "c0", "action": "drain"},
+            {"event": "cell_upgrade", "cell": "c0", "action": "undrain"},
+            {"event": "cell_upgrade", "cell": "c1", "action": "drain"},
+            {"event": "cell_upgrade", "cell": "c1", "action": "rollback",
+             "recovered": 1, "digest": "abc"},
+        ])
+
+    def test_fleet_state_folds_ha_events(self, tmp_path):
+        self._populate(tmp_path)
+        snap = obs_agg.Aggregator([tmp_path]).poll()
+        assert snap["n_runs"] == 2
+        by_id = {r["run_id"]: r for r in snap["runs"]}
+        front = by_id["run_front"]
+        assert front["lease"] == {"owner": "f1", "token": 2,
+                                  "role": "active", "takeovers": 1,
+                                  "fenced": 0, "replays": 1}
+        assert front["mirror_restores"] == 1
+        replica = by_id["run_replica"]
+        assert replica["upgrade"] == {"done": 1, "rollbacks": 1,
+                                      "draining": None}
+
+    def test_event_summary_reports_ha_counters(self, tmp_path):
+        self._populate(tmp_path)
+        events = []
+        for path in sorted(tmp_path.rglob("events.jsonl")):
+            events.extend(schema.read_events(path, complete=False))
+        summary = schema.event_summary(events)
+        assert summary["lease_takeovers"] == 1
+        assert summary["front_fenced"] == 0
+        assert summary["affinity_replays"] == 1
+        assert summary["cells_upgraded"] == 1
+        assert summary["upgrade_rollbacks"] == 1
+        assert summary["mirror_restores"] == 1
+        assert summary["spool_errors"] == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end truth: the --ha selftest (real fronts, real SIGKILL, real
+# engines) must pass and leave a gate-shaped record behind.
+
+
+class TestHABenchSelftest:
+    def test_ha_selftest_passes(self, tmp_path):
+        out = tmp_path / "BENCH_HA_selftest.json"
+        proc = subprocess.run(
+            [sys.executable, str(REPO / "scripts" / "serve_bench.py"),
+             "--ha", "--selftest", "--haOut", str(out)],
+            capture_output=True, text=True, timeout=540,
+            env=dict(os.environ, EEGTPU_NO_LOG_FILE="1",
+                     EEGTPU_PLATFORM="cpu", JAX_PLATFORMS="cpu"))
+        assert proc.returncode == 0, (proc.stdout[-4000:]
+                                      + proc.stderr[-2000:])
+        assert "SELFTEST PASS" in proc.stdout
+        record = json.loads(out.read_text())
+        failover = record["failover"]
+        assert failover["lease_takeovers"] >= 1
+        assert failover["takeover_before_first_request"] == 1
+        assert failover["duplicate_conflicts"] == 0
+        assert failover["decisions_equal"] == 1
+        assert failover["bulk"]["failures"] == 0
+        assert failover["bulk"]["max_hint_retries"] <= 1
+        upgrade = record["upgrade_leg"]
+        assert upgrade["upgrade"]["status"] == "ok"
+        assert upgrade["upgrade"]["upgraded"] == ["c0", "c1"]
+        assert upgrade["window_expirations"] == 0
+        assert upgrade["serialized_ok"] == 1
+        mirror = record["mirror_leg"]
+        assert mirror["mirror_restores"] >= 1
+        assert mirror["decisions_equal"] == 1
